@@ -1,0 +1,29 @@
+"""multiverso_tpu — a TPU-native parameter-server-class training framework.
+
+A ground-up re-design of the capabilities of Microsoft Multiverso
+(liming-vie/multiverso) for TPU hardware: parameter tables are device-sharded
+``jax.Array``s over a ``jax.sharding.Mesh``, Add/Get lower to XLA collectives
+over ICI, server-side updaters are jitted per-shard functions, BSP is the
+hardware-native synchronization mode, and the bundled applications
+(LogisticRegression, WordEmbedding) train end-to-end with no MPI in the loop.
+"""
+
+from multiverso_tpu.api import (
+    MV_Aggregate, MV_Barrier, MV_CreateTable, MV_Init, MV_NumServers,
+    MV_NumWorkers, MV_Rank, MV_ServerId, MV_ShutDown, MV_Size, MV_WorkerId,
+    aggregate, barrier, create_table, init, is_master_worker, mesh,
+    num_servers, num_workers, rank, server_id, shutdown, size, worker_id,
+)
+from multiverso_tpu.table import Table
+from multiverso_tpu.tables import ArrayTable, KVTable, MatrixTable
+from multiverso_tpu.tables.array_table import ArrayTableOption
+from multiverso_tpu.tables.kv_table import KVTableOption
+from multiverso_tpu.tables.matrix_table import MatrixTableOption
+from multiverso_tpu.updaters import (
+    AdaGradUpdater, AdamUpdater, AddOption, MomentumUpdater, SGDUpdater,
+    Updater, get_updater, register_updater,
+)
+from multiverso_tpu.utils import config, dashboard, log
+from multiverso_tpu.zoo import Zoo
+
+__version__ = "0.1.0"

@@ -1,0 +1,157 @@
+"""Public API facade.
+
+TPU-native equivalent of the reference MV_* surface
+(ref: include/multiverso/multiverso.h:9-61, src/multiverso.cpp). Snake_case is
+the Python-native spelling; ``MV_*`` aliases are provided for drop-in parity
+with the reference bindings (ref binding/python/multiverso/api.py).
+
+The Net bind/connect calls (MV_NetBind/MV_NetConnect, ZMQ-without-machinefile
+membership) map onto JAX's distributed runtime initialization:
+``net_init(coordinator, num_processes, process_id)`` wraps
+``jax.distributed.initialize`` — pod/topology discovery replaces explicit
+endpoint wiring.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from multiverso_tpu.utils import config, log
+from multiverso_tpu.zoo import Zoo
+
+
+def init(argv: Optional[List[str]] = None,
+         mesh: Optional[jax.sharding.Mesh] = None,
+         sync: Optional[bool] = None,
+         updater: Optional[str] = None) -> None:
+    """ref MV_Init (src/multiverso.cpp:10). Keyword conveniences mirror the
+    Python binding's init(sync=...) -> '-sync=true' argv injection
+    (ref binding/python/multiverso/api.py:29-34)."""
+    if sync is not None:
+        config.set_flag("sync", sync)
+    if updater is not None:
+        config.set_flag("updater_type", updater)
+    Zoo.get().start(argv, mesh=mesh)
+
+
+def shutdown(finalize: bool = True) -> None:
+    """ref MV_ShutDown."""
+    Zoo.get().stop(finalize)
+
+
+def barrier() -> None:
+    """ref MV_Barrier."""
+    Zoo.get().barrier()
+
+
+def rank() -> int:
+    return Zoo.get().rank()
+
+
+def size() -> int:
+    return Zoo.get().size()
+
+
+def num_workers() -> int:
+    return Zoo.get().num_workers()
+
+
+def num_servers() -> int:
+    return Zoo.get().num_servers()
+
+
+def worker_id() -> int:
+    return Zoo.get().worker_id()
+
+
+def server_id() -> int:
+    return Zoo.get().server_id()
+
+
+def worker_id_to_rank(wid: int) -> int:
+    return Zoo.get().worker_id_to_rank(wid)
+
+
+def server_id_to_rank(sid: int) -> int:
+    return Zoo.get().server_id_to_rank(sid)
+
+
+def mesh() -> jax.sharding.Mesh:
+    return Zoo.get().mesh()
+
+
+def is_master_worker() -> bool:
+    """ref binding convention: worker 0 initializes shared values
+    (binding/python/multiverso/tables.py:50-57)."""
+    return worker_id() == 0
+
+
+def create_table(option: Any, name: Optional[str] = None):
+    """ref MV_CreateTable (multiverso.h:31-37): build from an Option struct and
+    barrier afterwards so every process sees the table."""
+    table = option.build(name) if name is not None else option.build()
+    barrier()
+    return table
+
+
+def aggregate(data: Union[np.ndarray, jax.Array], size: Optional[int] = None
+              ) -> np.ndarray:
+    """ref MV_Aggregate (src/multiverso.cpp, allreduce 'ma' mode): in-place sum
+    across workers. On TPU this is one psum over the mesh — the entire
+    Bruck/recursive-halving engine (src/net/allreduce_engine.cpp) and its
+    topology math collapse into a single XLA AllReduce routed on ICI.
+
+    Single-process: identity (one worker). Multi-process: sums the per-process
+    arrays over DCN/ICI via a tiny jitted collective.
+    """
+    arr = np.asarray(data)
+    if size is not None:
+        arr = arr.reshape(-1)[:size]
+    zoo = Zoo.get()
+    if zoo.size() == 1:
+        out = arr
+    else:
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(arr, tiled=False)
+        out = np.asarray(gathered).sum(axis=0).astype(arr.dtype)
+    if isinstance(data, np.ndarray):
+        # ndarray.flat assigns through views, so non-contiguous inputs
+        # (reshape(-1) would silently copy) still get the in-place write.
+        data.flat[: out.size] = out.reshape(-1)
+        return data
+    return out
+
+
+def net_init(coordinator_address: Optional[str] = None,
+             num_processes: Optional[int] = None,
+             process_id: Optional[int] = None) -> int:
+    """ref MV_NetBind/MV_NetConnect analogue: bring up the multi-controller
+    runtime explicitly when not launched under a pod scheduler."""
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+        return 0
+    except Exception as e:  # pragma: no cover - environment dependent
+        log.error("net_init failed: %s", e)
+        return -1
+
+
+# ---- MV_* parity aliases -------------------------------------------------- #
+MV_Init = init
+MV_ShutDown = shutdown
+MV_Barrier = barrier
+MV_Rank = rank
+MV_Size = size
+MV_NumWorkers = num_workers
+MV_NumServers = num_servers
+MV_WorkerId = worker_id
+MV_ServerId = server_id
+MV_WorkerIdToRank = worker_id_to_rank
+MV_ServerIdToRank = server_id_to_rank
+MV_CreateTable = create_table
+MV_Aggregate = aggregate

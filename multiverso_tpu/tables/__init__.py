@@ -1,0 +1,5 @@
+from multiverso_tpu.tables.array_table import ArrayTable
+from multiverso_tpu.tables.matrix_table import MatrixTable
+from multiverso_tpu.tables.kv_table import KVTable
+
+__all__ = ["ArrayTable", "MatrixTable", "KVTable"]

@@ -1,0 +1,109 @@
+"""KVTable: sparse key-value table.
+
+TPU-native equivalent of the reference KVTable
+(ref: include/multiverso/table/kv_table.h — a header-only
+``unordered_map<Key,Val>`` hash-sharded ``key % num_servers`` across servers,
+used as the global word-count aggregator in WordEmbedding). Scalar KV traffic
+has no business on the MXU; the idiomatic TPU design keeps it host-side: a
+process-local dict with reference Add/Get semantics, aggregated across
+processes on demand with a host allgather (the one place DCN, not ICI, is the
+right wire). ``store``/``load`` are actually implemented — the reference left
+them stubbed (kv_table.h:101-119).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, Optional, Tuple
+
+import numpy as np
+
+from multiverso_tpu.utils.dashboard import monitor
+from multiverso_tpu.zoo import Zoo
+
+
+class KVTable:
+    def __init__(self, dtype=np.int64, name: str = "kv"):
+        self.name = name
+        self.dtype = np.dtype(dtype)
+        self._store: Dict[int, float] = {}
+        self._lock = threading.Lock()
+        self._zoo = Zoo.get()
+        self.table_id = self._zoo.register_table(self)
+
+    def add(self, keys: Iterable[int], values: Iterable) -> None:
+        """ref kv_table.h Add: accumulate into the shard map."""
+        with monitor(f"table[{self.name}].add"), self._lock:
+            for k, v in zip(keys, values):
+                self._store[int(k)] = self._store.get(int(k), 0) + v
+
+    def get(self, keys: Optional[Iterable[int]] = None) -> Dict[int, float]:
+        """ref kv_table.h Get: pull requested keys (None = whole table) into
+        the worker-side cache; here it simply returns a dict."""
+        with monitor(f"table[{self.name}].get"), self._lock:
+            if keys is None:
+                return dict(self._store)
+            return {int(k): self._store.get(int(k), 0) for k in keys}
+
+    def raw(self) -> Dict[int, float]:
+        """ref kv_table.h raw(): the worker-local cache view."""
+        return self.get()
+
+    def __getitem__(self, key: int):
+        return self._store.get(int(key), 0)
+
+    def allreduce(self) -> Dict[int, float]:
+        """Aggregate counts across processes (multi-host path). With one
+        process this is a no-op view. Uses a host-side allgather over the JAX
+        distributed client rather than device collectives: KV payloads are
+        ragged and tiny."""
+        if self._zoo.size() == 1:
+            return self.get()
+        from jax.experimental import multihost_utils
+        items = sorted(self._store.items())
+        keys = np.array([k for k, _ in items], dtype=np.int64)
+        vals = np.array([v for _, v in items], dtype=np.float64)
+        # Host allgather needs identical shapes per process; key sets are
+        # ragged, so first agree on the max length, then pad with a -1
+        # sentinel key.
+        n = np.array([keys.size], dtype=np.int64)
+        max_n = int(np.max(multihost_utils.process_allgather(n, tiled=False)))
+        pad = max_n - keys.size
+        if pad:
+            keys = np.concatenate([keys, np.full(pad, -1, np.int64)])
+            vals = np.concatenate([vals, np.zeros(pad, np.float64)])
+        gk = multihost_utils.process_allgather(keys, tiled=False)
+        gv = multihost_utils.process_allgather(vals, tiled=False)
+        merged: Dict[int, float] = {}
+        for krow, vrow in zip(np.atleast_2d(gk), np.atleast_2d(gv)):
+            for k, v in zip(krow, vrow):
+                if k >= 0:
+                    merged[int(k)] = merged.get(int(k), 0) + v
+        with self._lock:
+            self._store = merged
+        return dict(merged)
+
+    # ------------------------------------------------------------------ #
+    # checkpoint — implemented, unlike the reference stub
+    # ------------------------------------------------------------------ #
+    def store(self, stream) -> None:
+        items = sorted(self._store.items())
+        np.save(stream, np.array([k for k, _ in items], dtype=np.int64),
+                allow_pickle=False)
+        np.save(stream, np.array([v for _, v in items], dtype=np.float64),
+                allow_pickle=False)
+
+    def load(self, stream) -> None:
+        keys = np.load(stream)
+        vals = np.load(stream)
+        with self._lock:
+            self._store = {int(k): self.dtype.type(v).item()
+                           for k, v in zip(keys, vals)}
+
+
+class KVTableOption:
+    def __init__(self, dtype=np.int64):
+        self.dtype = dtype
+
+    def build(self, name: str = "kv") -> KVTable:
+        return KVTable(dtype=self.dtype, name=name)

@@ -1,0 +1,4 @@
+from multiverso_tpu.utils import config, dashboard, log
+from multiverso_tpu.utils.timer import Timer
+
+__all__ = ["config", "dashboard", "log", "Timer"]

@@ -1,0 +1,188 @@
+"""Typed flag registry.
+
+TPU-native re-design of the reference's configure system
+(ref: include/multiverso/util/configure.h:65-112, src/util/configure.cpp:9-54):
+``define_*`` registers a typed flag with a default and help string,
+``parse_cmd_flags`` consumes ``-key=value`` argv entries (compacting argv, as the
+reference does), and ``set_flag`` is the programmatic override used by bindings
+and apps (ref: binding/python/multiverso/api.py:31, ps_model.cpp:24).
+
+Unlike the reference there is no static-initialization dance: the registry is a
+plain module-level dict, and flags may be (re)defined at import time by any
+subsystem. Types: bool, int, float, str.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+_TRUE_STRINGS = frozenset({"true", "1", "yes", "on"})
+_FALSE_STRINGS = frozenset({"false", "0", "no", "off"})
+
+
+@dataclass
+class _Flag:
+    name: str
+    value: Any
+    default: Any
+    type: type
+    help: str
+
+
+_registry: Dict[str, _Flag] = {}
+_lock = threading.RLock()
+
+
+class FlagError(KeyError):
+    """Raised for unknown flags or bad flag values."""
+
+
+def _define(name: str, default: Any, ftype: type, help: str) -> None:
+    with _lock:
+        if name in _registry and _registry[name].type is not ftype:
+            raise FlagError(
+                f"flag {name!r} redefined with different type "
+                f"({_registry[name].type.__name__} -> {ftype.__name__})"
+            )
+        _registry[name] = _Flag(name, default, default, ftype, help)
+
+
+def define_bool(name: str, default: bool, help: str = "") -> None:
+    _define(name, bool(default), bool, help)
+
+
+def define_int(name: str, default: int, help: str = "") -> None:
+    _define(name, int(default), int, help)
+
+
+def define_float(name: str, default: float, help: str = "") -> None:
+    _define(name, float(default), float, help)
+
+
+def define_string(name: str, default: str, help: str = "") -> None:
+    _define(name, str(default), str, help)
+
+
+def _coerce(flag: _Flag, value: Any) -> Any:
+    if flag.type is bool:
+        if isinstance(value, bool):
+            return value
+        s = str(value).strip().lower()
+        if s in _TRUE_STRINGS:
+            return True
+        if s in _FALSE_STRINGS:
+            return False
+        raise FlagError(f"bad boolean value {value!r} for flag {flag.name!r}")
+    try:
+        return flag.type(value)
+    except (TypeError, ValueError) as e:
+        raise FlagError(
+            f"bad {flag.type.__name__} value {value!r} for flag {flag.name!r}"
+        ) from e
+
+
+def get_flag(name: str) -> Any:
+    with _lock:
+        try:
+            return _registry[name].value
+        except KeyError:
+            raise FlagError(f"unknown flag {name!r}") from None
+
+
+def set_flag(name: str, value: Any) -> None:
+    """Programmatic override (ref SetCMDFlag, src/util/configure.cpp)."""
+    with _lock:
+        try:
+            flag = _registry[name]
+        except KeyError:
+            raise FlagError(f"unknown flag {name!r}") from None
+        flag.value = _coerce(flag, value)
+
+
+def has_flag(name: str) -> bool:
+    with _lock:
+        return name in _registry
+
+
+def reset_flags() -> None:
+    """Reset every flag to its default (test isolation helper)."""
+    with _lock:
+        for flag in _registry.values():
+            flag.value = flag.default
+
+
+def flags() -> Dict[str, Any]:
+    """Snapshot of the current flag values."""
+    with _lock:
+        return {name: f.value for name, f in _registry.items()}
+
+
+def parse_cmd_flags(argv: Optional[List[str]] = None) -> List[str]:
+    """Consume ``-key=value`` entries from ``argv``; return the remainder.
+
+    Mirrors the reference's argv compaction (src/util/configure.cpp:9-54):
+    recognized flags are removed, everything else is kept in order. Unknown
+    ``-key=value`` entries are kept (the reference warns and keeps them too).
+    """
+    if argv is None:
+        return []
+    remainder: List[str] = []
+    for arg in argv:
+        matched = False
+        if arg.startswith("-") and "=" in arg:
+            body = arg.lstrip("-")
+            key, _, value = body.partition("=")
+            with _lock:
+                if key in _registry:
+                    flag = _registry[key]
+                    flag.value = _coerce(flag, value)
+                    matched = True
+        if not matched:
+            remainder.append(arg)
+    return remainder
+
+
+def parse_config_file(path: str) -> Dict[str, str]:
+    """Parse a ``key=value`` config file (LR-app style, ref configure.cpp).
+
+    Lines starting with ``#`` and blank lines are skipped. Known flags are set;
+    all pairs are returned for app-level consumption.
+    """
+    out: Dict[str, str] = {}
+    with open(path, "r") as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            key, _, value = line.partition("=")
+            key, value = key.strip(), value.strip()
+            if not key:
+                continue
+            out[key] = value
+            with _lock:
+                if key in _registry:
+                    flag = _registry[key]
+                    flag.value = _coerce(flag, value)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Core framework flags (inventory mirrors the reference's MV_DEFINE_* set;
+# transport/allocator flags are dropped: XLA owns memory and ICI owns the wire).
+# ---------------------------------------------------------------------------
+define_string("ps_role", "default", "role of this process: none|worker|server|default")
+define_bool("ma", False, "model-average (allreduce) mode: no parameter tables")
+define_bool("sync", False, "BSP semantics (reference SyncServer). On TPU sync is "
+            "the hardware-native mode; async emulated via sync_frequency")
+define_float("backup_worker_ratio", 0.0, "straggler backup ratio (reference "
+             "declared-but-dead flag; wired here to worker_map redundancy)")
+define_string("updater_type", "default", "server-side updater: "
+              "default|sgd|momentum_sgd|adagrad|adam")
+define_int("num_workers", 0, "logical workers; 0 = one per JAX process")
+define_int("num_servers", 0, "logical server shards; 0 = one per device")
+define_string("mesh_axis", "mv", "name of the table-sharding mesh axis")
+define_string("log_level", "info", "debug|info|error|fatal")
+define_string("log_file", "", "optional log file path ('' = stdout only)")
+define_bool("dashboard", True, "collect Monitor timings and display at shutdown")

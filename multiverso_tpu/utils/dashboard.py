@@ -1,0 +1,115 @@
+"""Monitor / Dashboard metrics aggregation.
+
+TPU-native equivalent of the reference observability layer
+(ref: include/multiverso/dashboard.h:16-73, src/dashboard.cpp): named
+``Monitor``s accumulate call counts and cumulative elapsed milliseconds in a
+process-global ``Dashboard`` registry; ``display()`` prints the aggregate
+report at shutdown (ref src/zoo.cpp:109). The MONITOR_BEGIN/END macro pair
+becomes the ``monitor(name)`` context manager / decorator.
+
+On TPU, device work is asynchronously dispatched, so wall-clock monitors around
+jitted calls measure *dispatch* unless the caller blocks; monitors that need
+device time should wrap ``block_until_ready`` (the table layer does this for
+its sync ops, matching the reference's blocking Add/Get semantics).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, Iterator, Optional
+
+
+class Monitor:
+    """Count + cumulative-ms accumulator (ref dashboard.h Monitor)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self.total_ms = 0.0
+        self._begin: Optional[float] = None
+        self._lock = threading.Lock()
+
+    def begin(self) -> None:
+        self._begin = time.perf_counter()
+
+    def end(self) -> None:
+        if self._begin is None:
+            return
+        elapsed = (time.perf_counter() - self._begin) * 1e3
+        self._begin = None
+        with self._lock:
+            self.count += 1
+            self.total_ms += elapsed
+
+    def observe_ms(self, ms: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.total_ms += ms
+
+    @property
+    def average_ms(self) -> float:
+        return self.total_ms / self.count if self.count else 0.0
+
+    def info_string(self) -> str:
+        return (f"[{self.name}] count = {self.count}, "
+                f"total = {self.total_ms:.3f} ms, "
+                f"average = {self.average_ms:.3f} ms")
+
+
+class Dashboard:
+    """Process-global registry of Monitors (ref dashboard.h Dashboard)."""
+
+    _monitors: Dict[str, Monitor] = {}
+    _lock = threading.Lock()
+
+    @classmethod
+    def get(cls, name: str) -> Monitor:
+        with cls._lock:
+            mon = cls._monitors.get(name)
+            if mon is None:
+                mon = cls._monitors[name] = Monitor(name)
+            return mon
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._lock:
+            cls._monitors.clear()
+
+    @classmethod
+    def snapshot(cls) -> Dict[str, Monitor]:
+        with cls._lock:
+            return dict(cls._monitors)
+
+    @classmethod
+    def display(cls, print_fn=print) -> None:
+        mons = cls.snapshot()
+        if not mons:
+            return
+        print_fn("--------------Dashboard--------------------")
+        for name in sorted(mons):
+            print_fn(mons[name].info_string())
+        print_fn("-------------------------------------------")
+
+
+@contextmanager
+def monitor(name: str) -> Iterator[Monitor]:
+    """MONITOR_BEGIN/END pair as a context manager."""
+    mon = Dashboard.get(name)
+    start = time.perf_counter()
+    try:
+        yield mon
+    finally:
+        mon.observe_ms((time.perf_counter() - start) * 1e3)
+
+
+def monitored(name: str):
+    """Decorator form of :func:`monitor`."""
+    def wrap(fn):
+        def inner(*args, **kwargs):
+            with monitor(name):
+                return fn(*args, **kwargs)
+        inner.__name__ = getattr(fn, "__name__", name)
+        return inner
+    return wrap

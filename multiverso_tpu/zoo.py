@@ -1,0 +1,172 @@
+"""Zoo: system orchestrator — topology, lifecycle, table registry.
+
+TPU-native re-design of the reference Zoo/Controller bootstrap
+(ref: include/multiverso/zoo.h:19, src/zoo.cpp:41-177, src/controller.cpp).
+The reference spins up an actor system per MPI/ZMQ process and runs a rank-0
+Controller that assigns worker/server ids and implements barriers. On TPU all
+of that is subsumed by the JAX runtime:
+
+* node membership / rank assignment  -> ``jax.process_index()/process_count()``
+  (multi-controller runtime discovers the pod; no Control_Register handshake)
+* worker/server roles                -> every process is a worker, every
+  *device* holds a server shard (the reference's ``ps_role=default`` collapse).
+  ``num_workers`` = processes, ``num_servers`` = devices in the mesh.
+* Controller barrier round-trip      -> a global device sync over ICI
+* Communicator/net actors            -> XLA collectives inside jitted table ops
+
+The Zoo owns the global ``jax.sharding.Mesh`` that tables shard over, and the
+table registry (table_id -> table) used by checkpointing and the C ABI.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from multiverso_tpu.utils import config, log
+from multiverso_tpu.utils.dashboard import Dashboard
+
+
+class Zoo:
+    """Singleton orchestrator (ref zoo.h Zoo). Use module helpers or Zoo.get()."""
+
+    _instance: Optional["Zoo"] = None
+    _lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self._started = False
+        self._mesh: Optional[jax.sharding.Mesh] = None
+        self._tables: Dict[int, Any] = {}
+        self._next_table_id = 0
+        self._barrier_count = 0
+
+    # ------------------------------------------------------------------ #
+    # lifecycle
+    # ------------------------------------------------------------------ #
+    @classmethod
+    def get(cls) -> "Zoo":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = Zoo()
+            return cls._instance
+
+    def start(self, argv: Optional[List[str]] = None,
+              mesh: Optional[jax.sharding.Mesh] = None) -> None:
+        """ref Zoo::Start (src/zoo.cpp:41): parse flags, init net, start actors.
+
+        Here: parse flags, configure logging, adopt/build the device mesh.
+        Idempotent; re-entrant start is a no-op (matching MV_Init usage).
+        """
+        if self._started:
+            return
+        config.parse_cmd_flags(argv)
+        log.configure_from_flags()
+        self._mesh = mesh if mesh is not None else self._default_mesh()
+        self._started = True
+        log.info(
+            "multiverso_tpu started: process %d/%d, %d devices in mesh %s, "
+            "platform=%s",
+            self.rank(), self.size(), self._mesh.size,
+            dict(zip(self._mesh.axis_names, self._mesh.devices.shape)),
+            jax.devices()[0].platform,
+        )
+        self.barrier()
+
+    def _default_mesh(self) -> jax.sharding.Mesh:
+        axis = config.get_flag("mesh_axis")
+        devices = np.asarray(jax.devices())
+        return jax.sharding.Mesh(devices, (axis,))
+
+    def stop(self, finalize: bool = True) -> None:
+        """ref Zoo::Stop (src/zoo.cpp:103): drain, display dashboard, stop."""
+        if not self._started:
+            return
+        self.barrier()
+        if config.get_flag("dashboard"):
+            Dashboard.display(log.info)
+        self._tables.clear()
+        self._next_table_id = 0
+        self._mesh = None
+        self._started = False
+
+    @property
+    def started(self) -> bool:
+        return self._started
+
+    # ------------------------------------------------------------------ #
+    # topology (ref zoo.h rank/size/worker_rank/server_rank accessors)
+    # ------------------------------------------------------------------ #
+    def rank(self) -> int:
+        return jax.process_index()
+
+    def size(self) -> int:
+        return jax.process_count()
+
+    def mesh(self) -> jax.sharding.Mesh:
+        if self._mesh is None:
+            raise RuntimeError("multiverso_tpu not initialized; call mv.init()")
+        return self._mesh
+
+    def shard_axis(self) -> str:
+        """Mesh axis tables shard over (the last axis of the mesh)."""
+        return self.mesh().axis_names[-1]
+
+    def num_workers(self) -> int:
+        n = config.get_flag("num_workers")
+        return n if n > 0 else self.size()
+
+    def num_servers(self) -> int:
+        n = config.get_flag("num_servers")
+        return n if n > 0 else self.mesh().size
+
+    def worker_id(self) -> int:
+        return self.rank()
+
+    def server_id(self) -> int:
+        return self.rank()
+
+    def worker_id_to_rank(self, worker_id: int) -> int:
+        return worker_id
+
+    def server_id_to_rank(self, server_id: int) -> int:
+        return server_id
+
+    # ------------------------------------------------------------------ #
+    # barrier (ref Zoo::Barrier, src/zoo.cpp:165-177 — controller round trip)
+    # ------------------------------------------------------------------ #
+    def barrier(self) -> None:
+        self._barrier_count += 1
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(
+                f"multiverso_tpu_barrier_{self._barrier_count}")
+        else:
+            # Single controller: block on every registered table's live arrays
+            # so the barrier has the reference's "all prior Adds are visible"
+            # fence semantics on every device in the mesh.
+            for table in self._tables.values():
+                raw = getattr(table, "raw", None)
+                if callable(raw):
+                    value = raw()
+                    jax.tree.map(
+                        lambda a: a.block_until_ready()
+                        if isinstance(a, jax.Array) else a, value)
+
+    # ------------------------------------------------------------------ #
+    # table registry (ref zoo.h RegisterTable / table_factory ownership)
+    # ------------------------------------------------------------------ #
+    def register_table(self, table: Any) -> int:
+        with self._lock:
+            table_id = self._next_table_id
+            self._next_table_id += 1
+            self._tables[table_id] = table
+            return table_id
+
+    def table(self, table_id: int) -> Any:
+        return self._tables[table_id]
+
+    def tables(self) -> Dict[int, Any]:
+        return dict(self._tables)

@@ -1,0 +1,40 @@
+"""Test fixture: an 8-device virtual CPU mesh.
+
+The reference's integration tier simulates a cluster with ``mpirun -np 4`` on
+one host (SURVEY §4); the TPU-native analogue is
+``--xla_force_host_platform_device_count=8`` on CPU — 8 virtual devices stand
+in for 8 chips, so every sharding/collective path compiles and runs exactly as
+it would on a pod slice.
+"""
+
+import os
+
+# The TPU plugin may already be registered by a site hook that imported jax at
+# interpreter startup, so plain env vars are too late — use jax.config, which
+# takes effect as long as no backend has been initialized yet.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_runtime():
+    """Reset flags + Zoo between tests (the reference restarts processes)."""
+    from multiverso_tpu.utils import config
+    from multiverso_tpu.utils.dashboard import Dashboard
+    from multiverso_tpu.zoo import Zoo
+    yield
+    zoo = Zoo.get()
+    if zoo.started:
+        zoo.stop()
+    config.reset_flags()
+    Dashboard.reset()
