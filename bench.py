@@ -1,0 +1,113 @@
+"""Benchmark harness: prints ONE JSON line for the driver.
+
+Primary metric (BASELINE.json): WordEmbedding words/sec/chip, measured by the
+fused skipgram-NS trainer on a synthetic zipf corpus (text8 stand-in; this
+environment has no network egress). Secondary metrics (ArrayTable Add/Get p50
+latency and bandwidth) ride along in "extra".
+
+``vs_baseline``: the reference publishes no words/sec number
+(BASELINE.json "published": {}), so the ratio is computed against a locally
+recorded baseline in BENCH_BASELINE.json when present (first run writes it),
+else 1.0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def _percentile_ms(samples):
+    return float(np.percentile(np.asarray(samples) * 1e3, 50))
+
+
+def bench_wordembedding(epochs: int = 3):
+    import multiverso_tpu as mv
+    from multiverso_tpu.apps.word_embedding import (WEConfig, WordEmbedding,
+                                                    synthetic_corpus)
+    from multiverso_tpu.data.dictionary import Dictionary
+
+    tokens = synthetic_corpus(400_000, vocab=10_000, seed=7)
+    cfg = WEConfig(size=128, min_count=5, batch_size=2048, negative=5,
+                   window=5, epoch=1)
+    d = Dictionary.build(tokens, cfg.min_count)
+    we = WordEmbedding(cfg, d)
+    ids = we.prepare_ids(tokens)
+    we.train_fused(ids, epochs=1)  # warmup: compile + first dispatch
+    stats = we.train_fused(ids, epochs=epochs)
+    n_chips = max(len(mv.mesh().devices.reshape(-1)), 1)
+    return stats["words_per_sec"] / n_chips, stats
+
+
+def bench_array_table(size: int = 1_000_000, iters: int = 10):
+    import multiverso_tpu as mv
+    from multiverso_tpu.updaters import AddOption
+
+    t = mv.ArrayTable(size, updater="sgd", name="bench_array")
+    delta = np.random.default_rng(0).normal(size=size).astype(np.float32)
+    opt = AddOption(learning_rate=0.01)
+    t.add(delta, opt)  # compile
+    t.get()
+    adds, gets = [], []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        t.add(delta, opt)
+        adds.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        t.get()
+        gets.append(time.perf_counter() - t0)
+    nbytes = size * 4
+    return {
+        "add_p50_ms": _percentile_ms(adds),
+        "get_p50_ms": _percentile_ms(gets),
+        "add_gbps": nbytes / np.percentile(adds, 50) / 1e9,
+        "get_gbps": nbytes / np.percentile(gets, 50) / 1e9,
+        "size_mb": nbytes / 1e6,
+    }
+
+
+def main() -> None:
+    import multiverso_tpu as mv
+
+    mv.init()
+    words_per_sec_chip, we_stats = bench_wordembedding()
+    array_stats = bench_array_table()
+    mv.shutdown()
+
+    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "BENCH_BASELINE.json")
+    vs_baseline = 1.0
+    if os.path.exists(baseline_path):
+        try:
+            with open(baseline_path) as f:
+                recorded = json.load(f).get("we_words_per_sec_per_chip", 0)
+            if recorded > 0:
+                vs_baseline = words_per_sec_chip / recorded
+        except (ValueError, OSError):
+            pass
+    else:
+        try:
+            with open(baseline_path, "w") as f:
+                json.dump({"we_words_per_sec_per_chip": words_per_sec_chip},
+                          f)
+        except OSError:
+            pass
+
+    print(json.dumps({
+        "metric": "WordEmbedding words/sec/chip (fused skipgram-NS, "
+                  "synthetic zipf corpus, dim=128, neg=5)",
+        "value": round(words_per_sec_chip, 1),
+        "unit": "words/s/chip",
+        "vs_baseline": round(vs_baseline, 3),
+        "extra": {
+            "we_loss": round(we_stats["loss"], 4),
+            "array_table_4M_float32": array_stats,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -15,10 +15,12 @@ from multiverso_tpu.api import (
     num_servers, num_workers, rank, server_id, shutdown, size, worker_id,
 )
 from multiverso_tpu.table import Table
-from multiverso_tpu.tables import ArrayTable, KVTable, MatrixTable
+from multiverso_tpu.tables import ArrayTable, KVTable, MatrixTable, SparseMatrixTable
 from multiverso_tpu.tables.array_table import ArrayTableOption
 from multiverso_tpu.tables.kv_table import KVTableOption
 from multiverso_tpu.tables.matrix_table import MatrixTableOption
+from multiverso_tpu.tables.sparse_matrix_table import SparseMatrixTableOption
+from multiverso_tpu.utils.async_buffer import AsyncBuffer
 from multiverso_tpu.updaters import (
     AdaGradUpdater, AdamUpdater, AddOption, MomentumUpdater, SGDUpdater,
     Updater, get_updater, register_updater,

@@ -1,0 +1,4 @@
+from multiverso_tpu.io.stream import Stream, TextReader, open_stream
+from multiverso_tpu.io.sample_reader import SampleReader
+
+__all__ = ["Stream", "TextReader", "open_stream", "SampleReader"]

@@ -1,0 +1,108 @@
+"""Background-thread sample reader with a bounded ring buffer.
+
+TPU-native equivalent of the reference LR SampleReader
+(ref: Applications/LogisticRegression/src/reader.cpp — a background thread
+fills a ring buffer of parsed samples while training consumes them; variants
+for text/libsvm, weighted, and binary-sparse formats, plus per-chunk key sets
+for sparse pulls).
+
+Formats:
+* ``libsvm``: ``label idx:val idx:val ...`` (indices 0-based here)
+* ``dense``:  ``label v0 v1 v2 ...``
+
+The reader yields fixed-size minibatches as dense numpy arrays ready for
+device_put — batching/padding happens here on the host thread, keeping XLA
+shapes static (the TPU analogue of the reference's minibatch assembly). For
+sparse objectives it also reports the active-key set per chunk (the
+``SparseBlock<bool>`` keys the reference feeds to sparse pulls).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, Optional, Set, Tuple
+
+import numpy as np
+
+from multiverso_tpu.io.stream import TextReader
+
+
+def parse_line(line: str, input_dim: int, fmt: str) -> Optional[Tuple[int, np.ndarray]]:
+    parts = line.split()
+    if not parts:
+        return None
+    label = int(float(parts[0]))
+    x = np.zeros(input_dim, dtype=np.float32)
+    if fmt == "dense":
+        vals = np.asarray(parts[1:], dtype=np.float32)
+        x[: vals.size] = vals[:input_dim]
+    else:  # libsvm
+        for tok in parts[1:]:
+            idx, _, val = tok.partition(":")
+            i = int(idx)
+            if 0 <= i < input_dim:
+                x[i] = float(val)
+    return label, x
+
+
+class SampleReader:
+    """Iterate (X, y, keys) minibatches from a sample file.
+
+    ``keys`` is the sorted active-feature-id set of the batch (sparse-pull
+    support); for dense format it is None.
+    """
+
+    def __init__(self, uri: str, input_dim: int, batch_size: int,
+                 fmt: str = "libsvm", capacity: int = 8,
+                 loop_epochs: int = 1, drop_remainder: bool = False):
+        self.input_dim = input_dim
+        self.batch_size = batch_size
+        self.fmt = fmt
+        self.drop_remainder = drop_remainder
+        self._uri = uri
+        self._loop_epochs = loop_epochs
+        self._queue: "queue.Queue" = queue.Queue(maxsize=capacity)
+        self._thread = threading.Thread(target=self._fill, daemon=True)
+        self._error: Optional[BaseException] = None
+        self._thread.start()
+
+    def _fill(self) -> None:
+        try:
+            for _ in range(self._loop_epochs):
+                reader = TextReader(self._uri)
+                xs, ys, keys = [], [], set()
+                for line in reader:
+                    parsed = parse_line(line, self.input_dim, self.fmt)
+                    if parsed is None:
+                        continue
+                    label, x = parsed
+                    ys.append(label)
+                    xs.append(x)
+                    if self.fmt != "dense":
+                        keys.update(np.nonzero(x)[0].tolist())
+                    if len(xs) == self.batch_size:
+                        self._emit(xs, ys, keys)
+                        xs, ys, keys = [], [], set()
+                reader.close()
+                if xs and not self.drop_remainder:
+                    self._emit(xs, ys, keys)
+            self._queue.put(None)
+        except BaseException as e:
+            self._error = e
+            self._queue.put(None)
+
+    def _emit(self, xs, ys, keys: Set[int]) -> None:
+        X = np.stack(xs)
+        y = np.asarray(ys, dtype=np.int32)
+        k = np.asarray(sorted(keys), dtype=np.int64) if self.fmt != "dense" else None
+        self._queue.put((X, y, k))
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]]:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                if self._error is not None:
+                    raise self._error
+                return
+            yield item

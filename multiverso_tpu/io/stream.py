@@ -1,0 +1,104 @@
+"""URI-dispatched streams.
+
+TPU-native equivalent of the reference IO layer
+(ref: include/multiverso/io/io.h:24-132 — Stream/StreamFactory/TextReader with
+``file://`` vs ``hdfs://`` URI dispatch). The cloud-storage scheme of the TPU
+era is ``gs://``; it is gated on an optional dependency (gcsfs/tf.io) and
+raises a clear error when unavailable in this zero-egress environment. Local
+paths (bare or ``file://``) are first-class.
+"""
+
+from __future__ import annotations
+
+import io as _io
+import os
+from typing import IO, Iterator, Optional
+
+
+class Stream:
+    """Thin binary stream wrapper (ref io.h Stream: Read/Write/Good)."""
+
+    def __init__(self, fileobj: IO[bytes], uri: str):
+        self._f = fileobj
+        self.uri = uri
+
+    def write(self, data: bytes) -> int:
+        return self._f.write(data)
+
+    def read(self, size: int = -1) -> bytes:
+        return self._f.read(size)
+
+    def good(self) -> bool:
+        return not self._f.closed
+
+    def close(self) -> None:
+        self._f.close()
+
+    def __enter__(self) -> "Stream":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # numpy save/load compatibility
+    def seek(self, *args):
+        return self._f.seek(*args)
+
+    def tell(self):
+        return self._f.tell()
+
+    def readinto(self, b):
+        return self._f.readinto(b)
+
+    def readline(self, *args):
+        return self._f.readline(*args)
+
+    def flush(self):
+        return self._f.flush()
+
+
+def open_stream(uri: str, mode: str = "rb") -> Stream:
+    """ref StreamFactory::GetStream (io.h) — dispatch on URI scheme."""
+    if "b" not in mode:
+        mode += "b"
+    if uri.startswith("file://"):
+        path = uri[len("file://"):]
+    elif uri.startswith("gs://"):
+        raise NotImplementedError(
+            "gs:// streams need gcsfs/tensorflow-io; not available in this "
+            "environment (reference analogue: hdfs:// needed libhdfs)")
+    elif "://" in uri:
+        raise ValueError(f"unsupported stream scheme in {uri!r}")
+    else:
+        path = uri
+    if "w" in mode or "a" in mode:
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+    return Stream(open(path, mode), uri)
+
+
+class TextReader:
+    """Line reader over a Stream (ref io.h TextReader::GetLine)."""
+
+    def __init__(self, uri_or_stream, buf_size: int = 1 << 20):
+        if isinstance(uri_or_stream, Stream):
+            self._stream = uri_or_stream
+        else:
+            self._stream = open_stream(uri_or_stream, "rb")
+        self._wrapped = _io.TextIOWrapper(
+            _io.BufferedReader(self._stream._f, buf_size), encoding="utf-8",
+            errors="replace")
+
+    def get_line(self) -> Optional[str]:
+        line = self._wrapped.readline()
+        return line.rstrip("\n") if line else None
+
+    def __iter__(self) -> Iterator[str]:
+        while True:
+            line = self.get_line()
+            if line is None:
+                return
+            yield line
+
+    def close(self) -> None:
+        self._wrapped.close()

@@ -1,0 +1,127 @@
+"""Logistic-regression / softmax model math, pure JAX.
+
+TPU-native equivalent of the reference LR model + objectives
+(ref: Applications/LogisticRegression/src/model/model.cpp:64-111 minibatch
+gradient accumulation; src/objective/objective.cpp sigmoid/softmax Predict /
+Diff / Gradient; src/regular/{l1,l2}_regular.h). The per-sample scalar loops
+of the reference become one batched matmul on the MXU; the minibatch-average
+gradient is a second matmul.
+
+Parameters are a single (num_classes, input_dim + 1) matrix with the bias
+folded in, stored flattened in an ArrayTable (the reference's dense PS layout,
+ps_model.cpp:24-41).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from multiverso_tpu.updaters import AddOption
+
+
+def param_count(input_dim: int, num_classes: int) -> int:
+    return num_classes * (input_dim + 1)
+
+
+def unflatten(params: jax.Array, input_dim: int, num_classes: int) -> jax.Array:
+    return params[: param_count(input_dim, num_classes)].reshape(
+        num_classes, input_dim + 1)
+
+
+def _augment(x: jax.Array) -> jax.Array:
+    """Append the bias column."""
+    return jnp.concatenate(
+        [x, jnp.ones((*x.shape[:-1], 1), x.dtype)], axis=-1)
+
+
+def predict_logits(w: jax.Array, x: jax.Array) -> jax.Array:
+    """(B, D) x (C, D+1) -> (B, C) on the MXU."""
+    return _augment(x) @ w.T
+
+
+def predict_proba(w: jax.Array, x: jax.Array, objective: str) -> jax.Array:
+    logits = predict_logits(w, x)
+    if objective == "sigmoid":
+        return jax.nn.sigmoid(logits)
+    return jax.nn.softmax(logits, axis=-1)
+
+
+def loss_and_grad(w: jax.Array, x: jax.Array, y: jax.Array, objective: str,
+                  regular: str = "none", reg_coef: float = 0.0
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """Minibatch loss and average gradient (ref objective.cpp Diff = p - onehot
+    then Gradient accumulation; regularizer added per element like
+    regular.cpp Calculate)."""
+    xb = _augment(x)
+    logits = xb @ w.T
+    num_classes = w.shape[0]
+    if objective == "sigmoid":
+        onehot = jax.nn.one_hot(y, num_classes, dtype=w.dtype)
+        p = jax.nn.sigmoid(logits)
+        eps = 1e-7
+        loss = -jnp.mean(jnp.sum(
+            onehot * jnp.log(p + eps) + (1 - onehot) * jnp.log(1 - p + eps),
+            axis=-1))
+        diff = p - onehot
+    else:  # softmax cross-entropy
+        onehot = jax.nn.one_hot(y, num_classes, dtype=w.dtype)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        loss = -jnp.mean(jnp.sum(onehot * logp, axis=-1))
+        diff = jax.nn.softmax(logits, axis=-1) - onehot
+    grad = diff.T @ xb / x.shape[0]
+    if regular == "l2":
+        grad = grad + reg_coef * w
+        loss = loss + 0.5 * reg_coef * jnp.sum(jnp.square(w))
+    elif regular == "l1":
+        grad = grad + reg_coef * jnp.sign(w)
+        loss = loss + reg_coef * jnp.sum(jnp.abs(w))
+    return loss, grad
+
+
+def accuracy(w: jax.Array, x: jax.Array, y: jax.Array) -> jax.Array:
+    return jnp.mean((jnp.argmax(predict_logits(w, x), axis=-1) == y)
+                    .astype(jnp.float32))
+
+
+def make_train_step(table, input_dim: int, num_classes: int, objective: str,
+                    regular: str = "none", reg_coef: float = 0.0,
+                    learning_rate: float = 0.1) -> Callable:
+    """Build the in-graph PS train step: grad -> lr-premultiplied delta ->
+    ``table.functional_add`` (the reference worker premultiplies the LR and the
+    server's SGD updater subtracts, ref app updater.cpp:52-71). Suitable for
+    ``lax.scan`` over a device-resident epoch."""
+
+    def step(state: Dict, batch) -> Tuple[Dict, jax.Array]:
+        x, y = batch
+        w = unflatten(state["data"], input_dim, num_classes)
+        loss, grad = loss_and_grad(w, x, y, objective, regular, reg_coef)
+        delta = learning_rate * grad
+        flat = jnp.zeros(table.padded_shape, table.dtype
+                         ).at[: delta.size].set(delta.reshape(-1))
+        state = table.functional_add(
+            state, flat, AddOption(learning_rate=learning_rate))
+        return state, loss
+
+    return step
+
+
+def synthetic_dataset(num_samples: int, input_dim: int, num_classes: int,
+                      seed: int = 0, noise: float = 0.6,
+                      centers_seed: int = 1234
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+    """Gaussian-blob classification set (test/bench fixture; the reference
+    pulls MNIST from the network, which a zero-egress environment cannot).
+    ``centers_seed`` fixes the class centers independently of the sample seed
+    so train/test splits share one task."""
+    rng = np.random.default_rng(seed)
+    centers = (np.random.default_rng(centers_seed)
+               .normal(size=(num_classes, input_dim)).astype(np.float32))
+    y = rng.integers(0, num_classes, size=num_samples).astype(np.int32)
+    x = centers[y] + noise * rng.normal(size=(num_samples, input_dim)
+                                        ).astype(np.float32)
+    return x.astype(np.float32), y

@@ -101,6 +101,10 @@ class Table:
         self._pending: Dict[int, Any] = {}
         self._next_msg_id = 0
         self._lock = threading.Lock()
+        # Serializes op *dispatch* (not device execution): a donating add on
+        # one thread must not delete the data buffer while another thread
+        # (e.g. an AsyncBuffer prefetch pull) is snapshotting it.
+        self._dispatch_lock = threading.RLock()
         self._jit_cache: Dict[Any, Any] = {}
 
     # ------------------------------------------------------------------ #
@@ -240,7 +244,7 @@ class Table:
                   opt: Optional[AddOption] = None) -> int:
         """ref WorkerTable::AddAsync — dispatch the update, return a msg id."""
         opt = opt or AddOption()
-        with monitor(f"table[{self.name}].add"):
+        with monitor(f"table[{self.name}].add"), self._dispatch_lock:
             delta_dev = self._host_delta(delta)
             self._data, self._ustate, token = self._full_update_fn()(
                 self._data, self._ustate, delta_dev, opt)
@@ -252,7 +256,7 @@ class Table:
 
     def get_async(self) -> int:
         """ref WorkerTable::GetAsync — start device->host transfer, return id."""
-        with monitor(f"table[{self.name}].get"):
+        with monitor(f"table[{self.name}].get"), self._dispatch_lock:
             snap = self._snapshot_fn()(self._data)
             try:
                 snap.copy_to_host_async()

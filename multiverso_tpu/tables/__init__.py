@@ -1,5 +1,6 @@
 from multiverso_tpu.tables.array_table import ArrayTable
 from multiverso_tpu.tables.matrix_table import MatrixTable
 from multiverso_tpu.tables.kv_table import KVTable
+from multiverso_tpu.tables.sparse_matrix_table import SparseMatrixTable
 
-__all__ = ["ArrayTable", "MatrixTable", "KVTable"]
+__all__ = ["ArrayTable", "MatrixTable", "KVTable", "SparseMatrixTable"]

@@ -147,7 +147,7 @@ class MatrixTable(Table):
     def add_rows_async(self, row_ids, values,
                        opt: Optional[AddOption] = None) -> int:
         opt = opt or AddOption()
-        with monitor(f"table[{self.name}].add_rows"):
+        with monitor(f"table[{self.name}].add_rows"), self._dispatch_lock:
             ids, vals, _, _ = self._prep_ids(row_ids, values)
             fn = self._row_update_fn(ids.size)
             self._data, self._ustate, token = fn(
@@ -160,7 +160,7 @@ class MatrixTable(Table):
         self.wait(self.add_rows_async(row_ids, values, opt))
 
     def get_rows_async(self, row_ids) -> int:
-        with monitor(f"table[{self.name}].get_rows"):
+        with monitor(f"table[{self.name}].get_rows"), self._dispatch_lock:
             ids, _, k, inv = self._prep_ids(row_ids)
             fn = self._row_get_fn(ids.size)
             rows = fn(self._data, jax.device_put(ids, self._replicated))

@@ -1,0 +1,98 @@
+"""LogisticRegression app end-to-end (ref tier-4 example-as-test, SURVEY §4:
+LR MNIST convergence). Synthetic blobs stand in for MNIST (zero-egress)."""
+
+import numpy as np
+import pytest
+
+import multiverso_tpu as mv
+from multiverso_tpu.apps.logistic_regression import LogReg, LogRegConfig
+from multiverso_tpu.models import logreg as model_lib
+
+
+@pytest.fixture(autouse=True)
+def _init():
+    mv.init()
+    yield
+    mv.shutdown()
+
+
+def _cfg(**over):
+    base = dict(input_size="20", output_size="4", objective_type="softmax",
+                updater_type="sgd", minibatch_size="32",
+                learning_rate="0.5", train_epoch="3", sync_frequency="1")
+    base.update({k: str(v) for k, v in over.items()})
+    return LogRegConfig(base)
+
+
+def test_fused_path_converges():
+    x, y = model_lib.synthetic_dataset(2048, 20, 4, seed=1)
+    xt, yt = model_lib.synthetic_dataset(512, 20, 4, seed=2)
+    lr = LogReg(_cfg())
+    before = lr.test_arrays(xt, yt)
+    stats = lr.train_arrays(x, y, epochs=5)
+    after = lr.test_arrays(xt, yt)
+    assert after > 0.85, f"accuracy {after} (before {before})"
+    assert stats["samples_per_sec"] > 0
+
+
+def test_ps_file_path_converges(tmp_path):
+    x, y = model_lib.synthetic_dataset(1024, 10, 2, seed=3)
+    train = tmp_path / "train.svm"
+    with open(train, "w") as f:
+        for xi, yi in zip(x, y):
+            feats = " ".join(f"{j}:{v:.5f}" for j, v in enumerate(xi))
+            f.write(f"{yi} {feats}\n")
+    cfg = _cfg(input_size=10, output_size=2, train_file=str(train),
+               test_file=str(train), train_epoch=2, sync_frequency=1)
+    lr = LogReg(cfg)
+    stats = lr.train_file()
+    acc = lr.test_file()
+    assert acc > 0.9, f"accuracy {acc}, stats {stats}"
+
+
+def test_pipeline_and_sync_frequency(tmp_path):
+    x, y = model_lib.synthetic_dataset(512, 10, 2, seed=4)
+    train = tmp_path / "train.svm"
+    with open(train, "w") as f:
+        for xi, yi in zip(x, y):
+            feats = " ".join(f"{j}:{v:.4f}" for j, v in enumerate(xi))
+            f.write(f"{yi} {feats}\n")
+    cfg = _cfg(input_size=10, output_size=2, train_file=str(train),
+               sync_frequency=3, pipeline="true", train_epoch=2)
+    lr = LogReg(cfg)
+    stats = lr.train_file()
+    assert stats["loss"] < 1.0
+
+
+def test_model_save_load(tmp_path):
+    x, y = model_lib.synthetic_dataset(512, 10, 2, seed=5)
+    lr = LogReg(_cfg(input_size=10, output_size=2))
+    lr.train_arrays(x, y, epochs=2)
+    acc = lr.test_arrays(x, y)
+    path = str(tmp_path / "model.bin")
+    lr.save_model(path)
+
+    lr2 = LogReg(_cfg(input_size=10, output_size=2))
+    lr2.load_model(path)
+    assert lr2.test_arrays(x, y) == pytest.approx(acc)
+
+
+def test_dense_reader(tmp_path):
+    f = tmp_path / "d.txt"
+    f.write_text("1 0.5 0.25\n0 -1 2\n")
+    from multiverso_tpu.io.sample_reader import SampleReader
+    batches = list(SampleReader(str(f), 2, 2, fmt="dense"))
+    assert len(batches) == 1
+    xb, yb, keys = batches[0]
+    np.testing.assert_allclose(xb, [[0.5, 0.25], [-1, 2]])
+    np.testing.assert_array_equal(yb, [1, 0])
+    assert keys is None
+
+
+def test_libsvm_reader_keys(tmp_path):
+    f = tmp_path / "s.svm"
+    f.write_text("1 0:1.0 5:2.0\n0 3:1.0\n")
+    from multiverso_tpu.io.sample_reader import SampleReader
+    (xb, yb, keys), = list(SampleReader(str(f), 8, 4))
+    assert xb.shape == (2, 8)
+    np.testing.assert_array_equal(keys, [0, 3, 5])

@@ -1,0 +1,91 @@
+"""SparseMatrixTable dirty-row protocol + AsyncBuffer tests
+(ref matrix.cpp stale-row semantics, async_buffer.h)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import multiverso_tpu as mv
+from multiverso_tpu.utils.async_buffer import AsyncBuffer
+
+
+@pytest.fixture(autouse=True)
+def _init():
+    mv.init()
+    yield
+    mv.shutdown()
+
+
+class TestSparseMatrixTable:
+    def test_first_get_pulls_everything(self):
+        t = mv.SparseMatrixTable(20, 4, num_workers=2)
+        t.add_rows([1, 2], np.ones((2, 4), np.float32))
+        assert t.stale_fraction(range(20), worker_id=0) == 1.0
+        rows = t.get_rows_sparse(range(20), worker_id=0)
+        np.testing.assert_allclose(rows[1], 1.0)
+        np.testing.assert_allclose(rows[0], 0.0)
+        # now everything is fresh for worker 0 ...
+        assert t.stale_fraction(range(20), worker_id=0) == 0.0
+        # ... but still stale for worker 1 (per-worker bits)
+        assert t.stale_fraction(range(20), worker_id=1) == 1.0
+
+    def test_add_marks_rows_stale_again(self):
+        t = mv.SparseMatrixTable(10, 4, num_workers=1)
+        t.get_rows_sparse(range(10))
+        t.add_rows([3], np.full((1, 4), 2.0, np.float32))
+        assert t.stale_fraction(range(10)) == pytest.approx(0.1)
+        rows = t.get_rows_sparse(range(10))
+        np.testing.assert_allclose(rows[3], 2.0)
+
+    def test_fresh_rows_served_from_cache(self):
+        t = mv.SparseMatrixTable(10, 4, num_workers=1)
+        t.add_rows([5], np.ones((1, 4), np.float32))
+        first = t.get_rows_sparse([5])
+        np.testing.assert_allclose(first, 1.0)
+        # second sparse get transfers nothing but must return same values
+        again = t.get_rows_sparse([5])
+        np.testing.assert_allclose(again, 1.0)
+
+    def test_whole_table_add_dirties_all(self):
+        t = mv.SparseMatrixTable(10, 4, num_workers=1)
+        t.get_rows_sparse(range(10))
+        t.add(np.ones((10, 4), np.float32))
+        assert t.stale_fraction(range(10)) == 1.0
+        np.testing.assert_allclose(t.get_rows_sparse(range(10)), 1.0)
+
+    def test_duplicate_ids(self):
+        t = mv.SparseMatrixTable(10, 4, num_workers=1)
+        t.add_rows([2], np.ones((1, 4), np.float32))
+        rows = t.get_rows_sparse([2, 2, 3])
+        assert rows.shape == (3, 4)
+        np.testing.assert_allclose(rows[0], 1.0)
+        np.testing.assert_allclose(rows[1], 1.0)
+        np.testing.assert_allclose(rows[2], 0.0)
+
+
+class TestAsyncBuffer:
+    def test_overlapped_fills(self):
+        calls = []
+
+        def fill():
+            calls.append(time.perf_counter())
+            return len(calls)
+
+        buf = AsyncBuffer(fill)
+        assert buf.get() == 1
+        assert buf.get() == 2
+        buf.stop()
+
+    def test_error_propagates_once(self):
+        state = {"n": 0}
+
+        def fill():
+            state["n"] += 1
+            if state["n"] == 1:
+                raise ValueError("boom")
+            return state["n"]
+
+        buf = AsyncBuffer(fill)
+        with pytest.raises(ValueError):
+            buf.get()

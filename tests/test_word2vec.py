@@ -1,0 +1,209 @@
+"""word2vec model + WordEmbedding app tests (ref tier-4: WE text8 analogue)."""
+
+import numpy as np
+import pytest
+
+import multiverso_tpu as mv
+from multiverso_tpu.apps.word_embedding import (WEConfig, WordEmbedding,
+                                                synthetic_corpus)
+from multiverso_tpu.data.dictionary import Dictionary, build_huffman
+from multiverso_tpu.models import word2vec as w2v
+
+
+@pytest.fixture(autouse=True)
+def _init():
+    mv.init()
+    yield
+    mv.shutdown()
+
+
+class TestDictionary:
+    def test_build_prunes_and_sorts(self):
+        d = Dictionary.build("a a a b b c".split(), min_count=2)
+        assert d.words == ["a", "b"]
+        assert d.word2id == {"a": 0, "b": 1}
+        np.testing.assert_array_equal(d.counts, [3, 2])
+
+    def test_encode_drops_oov(self):
+        d = Dictionary.build("a a b b".split(), min_count=2)
+        np.testing.assert_array_equal(d.encode("a x b".split()), [0, 1])
+
+    def test_subsample_keeps_rare(self):
+        counts = ["common"] * 10000 + ["rare"] * 10
+        d = Dictionary.build(counts, min_count=5)
+        ids = d.encode(counts)
+        kept = d.subsample(ids, t=1e-4, seed=0)
+        rare_id = d.word2id["rare"]
+        rare_rate = np.sum(kept == rare_id) / 10
+        common_rate = np.sum(kept == d.word2id["common"]) / 10000
+        # rare words survive at a much higher rate than common ones
+        assert rare_rate > common_rate * 3
+        assert common_rate < 0.2
+
+    def test_unigram_table(self):
+        d = Dictionary.build("a a a a b b".split(), min_count=1)
+        p = d.unigram_table()
+        assert p.sum() == pytest.approx(1.0)
+        assert p[0] > p[1]
+
+
+class TestHuffman:
+    def test_tree_shapes(self):
+        counts = np.array([50, 30, 10, 5, 5])
+        codes, points, lengths = build_huffman(counts)
+        assert codes.shape == points.shape
+        assert lengths.min() >= 1
+        # frequent words get shorter codes
+        assert lengths[0] <= lengths[-1]
+        # points index inner nodes only
+        assert points.max() <= len(counts) - 2
+
+    def test_codes_unique(self):
+        counts = np.array([8, 4, 2, 1, 1])
+        codes, points, lengths = build_huffman(counts)
+        paths = set()
+        for w in range(len(counts)):
+            paths.add(tuple(codes[w, :lengths[w]]))
+        assert len(paths) == len(counts)
+
+
+class TestSteps:
+    def test_skipgram_ns_reduces_loss(self):
+        rng = np.random.default_rng(0)
+        v, d, b, k = 50, 16, 32, 4
+        win, wout = w2v.init_embeddings(w2v.W2VConfig(v, d))
+        win, wout = np.asarray(win), np.asarray(wout)
+        centers = rng.integers(0, v, b).astype(np.int32)
+        contexts = ((centers + 1) % v).astype(np.int32)
+        negs = rng.integers(0, v, (b, k)).astype(np.int32)
+        import jax.numpy as jnp
+        win, wout = jnp.asarray(win), jnp.asarray(wout)
+        losses = []
+        for _ in range(30):
+            win, wout, loss = w2v.skipgram_ns_step(
+                win, wout, jnp.asarray(centers), jnp.asarray(contexts),
+                jnp.asarray(negs), 0.2)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.7
+
+    def test_cbow_ns_runs(self):
+        import jax.numpy as jnp
+        v, d, b, w, k = 30, 8, 16, 4, 3
+        rng = np.random.default_rng(1)
+        win, wout = map(jnp.asarray, w2v.init_embeddings(w2v.W2VConfig(v, d)))
+        windows = jnp.asarray(rng.integers(0, v, (b, w)), jnp.int32)
+        mask = jnp.ones((b, w), bool)
+        tgt = jnp.asarray(rng.integers(0, v, b), jnp.int32)
+        negs = jnp.asarray(rng.integers(0, v, (b, k)), jnp.int32)
+        l0 = None
+        for i in range(20):
+            win, wout, loss = w2v.cbow_ns_step(win, wout, windows, mask, tgt,
+                                               negs, 0.2)
+            l0 = l0 or float(loss)
+        assert float(loss) < l0
+
+    def test_hs_step_runs(self):
+        import jax.numpy as jnp
+        counts = np.array([40, 20, 10, 8, 6, 4])
+        codes, points, lengths = build_huffman(counts)
+        v, d, b = len(counts), 8, 12
+        rng = np.random.default_rng(2)
+        win, _ = map(jnp.asarray, w2v.init_embeddings(w2v.W2VConfig(v, d)))
+        hs_out = jnp.zeros((v - 1, d))
+        centers = rng.integers(0, v, b).astype(np.int32)
+        ctx = ((centers + 1) % v)
+        c = jnp.asarray(codes[ctx]); p = jnp.asarray(points[ctx])
+        m = jnp.arange(codes.shape[1])[None, :] < jnp.asarray(lengths[ctx])[:, None]
+        l0 = None
+        for _ in range(20):
+            win, hs_out, loss = w2v.skipgram_hs_step(
+                win, hs_out, jnp.asarray(centers), c, p, m, 0.2)
+            l0 = l0 or float(loss)
+        assert float(loss) < l0
+
+    def test_generate_pairs(self):
+        ids = np.arange(5)
+        c, x = w2v.generate_pairs(ids, window=1, dynamic=False)
+        # each interior token pairs with both neighbors
+        assert (c == 2).sum() == 2
+        assert set(x[c == 2]) == {1, 3}
+
+
+class TestWordEmbeddingApp:
+    def _make(self, **kw):
+        tokens = synthetic_corpus(30_000, vocab=200, seed=3)
+        cfg = WEConfig(size=32, min_count=5, batch_size=256, negative=4,
+                       epoch=1, **kw)
+        d = Dictionary.build(tokens, cfg.min_count)
+        we = WordEmbedding(cfg, d)
+        return we, we.prepare_ids(tokens)
+
+    def test_fused_training_learns(self):
+        we, ids = self._make()
+        stats = we.train_fused(ids, epochs=2)
+        assert stats["words_per_sec"] > 0
+        assert stats["loss"] < 3.0
+        emb = we.embeddings()
+        assert np.linalg.norm(emb) > 0
+
+    def test_ps_block_training(self):
+        we, ids = self._make(data_block_size=5000)
+        stats = we.train_ps_blocks(ids[:10_000], epochs=1)
+        assert stats["loss"] > 0
+        assert we.word_count[0] > 0
+
+    def test_save_and_nearest(self, tmp_path):
+        we, ids = self._make()
+        we.train_fused(ids, epochs=1)
+        out = tmp_path / "vec.txt"
+        we.save_embeddings(str(out))
+        header = out.read_text().splitlines()[0].split()
+        assert int(header[0]) == len(we.dict)
+        assert int(header[1]) == 32
+        word = we.dict.words[0]
+        nbrs = we.nearest(word, k=3)
+        assert len(nbrs) == 3 and word not in nbrs
+
+
+class TestModesAndRegressions:
+    def _tokens(self):
+        return synthetic_corpus(20_000, vocab=150, seed=5)
+
+    def test_cbow_fused(self):
+        tokens = self._tokens()
+        cfg = WEConfig(size=16, min_count=5, batch_size=256, negative=3,
+                       cbow=1)
+        d = Dictionary.build(tokens, cfg.min_count)
+        we = WordEmbedding(cfg, d)
+        stats = we.train_fused(we.prepare_ids(tokens), epochs=1)
+        assert stats["loss"] > 0
+        assert np.linalg.norm(we.embeddings()) > 0
+
+    def test_hs_fused(self):
+        tokens = self._tokens()
+        cfg = WEConfig(size=16, min_count=5, batch_size=256, hs=1)
+        d = Dictionary.build(tokens, cfg.min_count)
+        we = WordEmbedding(cfg, d)
+        stats = we.train_fused(we.prepare_ids(tokens), epochs=1)
+        assert stats["loss"] > 0
+        # the HS output table actually trained
+        assert np.linalg.norm(we.table_hs.get()) > 0
+
+    def test_ps_blocks_reject_cbow_hs(self):
+        tokens = self._tokens()
+        cfg = WEConfig(size=16, min_count=5, batch_size=128, cbow=1)
+        d = Dictionary.build(tokens, cfg.min_count)
+        we = WordEmbedding(cfg, d)
+        with pytest.raises(NotImplementedError):
+            we.train_ps_blocks(we.prepare_ids(tokens))
+
+    def test_words_per_sec_counts_tokens(self):
+        tokens = self._tokens()
+        cfg = WEConfig(size=16, min_count=5, batch_size=256, negative=3)
+        d = Dictionary.build(tokens, cfg.min_count)
+        we = WordEmbedding(cfg, d)
+        ids = we.prepare_ids(tokens)
+        stats = we.train_fused(ids, epochs=1)
+        implied_words = stats["words_per_sec"] * stats["seconds"]
+        assert implied_words == pytest.approx(ids.size, rel=0.01)
+        assert stats["pairs"] > ids.size  # pairs are reported separately
