@@ -29,3 +29,13 @@ from multiverso_tpu.utils import config, dashboard, log
 from multiverso_tpu.zoo import Zoo
 
 __version__ = "0.1.0"
+
+
+def __getattr__(name):
+    # Lazy submodule access (checkpoint, parallel, handlers, sharedvar,
+    # native import multiverso_tpu themselves, so eager import would cycle).
+    import importlib
+    if name in ("checkpoint", "parallel", "handlers", "sharedvar", "native",
+                "models", "apps", "io", "data"):
+        return importlib.import_module(f"multiverso_tpu.{name}")
+    raise AttributeError(f"module 'multiverso_tpu' has no attribute {name!r}")

@@ -36,11 +36,32 @@ import jax.numpy as jnp
 import numpy as np
 
 import multiverso_tpu as mv
+from multiverso_tpu import native
 from multiverso_tpu.data.dictionary import Dictionary, build_huffman
 from multiverso_tpu.models import word2vec as w2v
 from multiverso_tpu.utils import log
 from multiverso_tpu.utils.async_buffer import AsyncBuffer
 from multiverso_tpu.utils.dashboard import monitor
+
+
+def _gen_pairs(ids: np.ndarray, window: int, seed: int):
+    """Prefer the native C++ pair generator (mv_data.cpp); fall back to the
+    vectorized numpy path."""
+    if native.available():
+        return native.generate_pairs(ids, window, seed=seed)
+    return w2v.generate_pairs(ids, window, seed=seed)
+
+
+def prepare_ids(dictionary: Dictionary, ids: np.ndarray,
+                cfg: "WEConfig") -> np.ndarray:
+    """THE subsampling policy — one implementation shared by every entry
+    point (app method, load_corpus, bench) so id streams can't diverge."""
+    if cfg.sample <= 0:
+        return ids
+    if native.available():
+        return native.subsample(ids, dictionary.counts, cfg.sample,
+                                seed=cfg.seed).astype(np.int64)
+    return dictionary.subsample(ids, cfg.sample, seed=cfg.seed)
 
 
 class WEConfig:
@@ -109,10 +130,7 @@ class WordEmbedding:
     # corpus -> id stream
     # ------------------------------------------------------------------ #
     def prepare_ids(self, tokens) -> np.ndarray:
-        ids = self.dict.encode(tokens)
-        if self.cfg.sample > 0:
-            ids = self.dict.subsample(ids, self.cfg.sample, seed=self.cfg.seed)
-        return ids
+        return prepare_ids(self.dict, self.dict.encode(tokens), self.cfg)
 
     def _batches(self, centers: np.ndarray, contexts: np.ndarray
                  ) -> Tuple[np.ndarray, np.ndarray]:
@@ -156,8 +174,7 @@ class WordEmbedding:
             self.table_out.adopt({"data": wout,
                                   "ustate": state_out["ustate"]})
         else:
-            centers, contexts = w2v.generate_pairs(ids, cfg.window,
-                                                   seed=cfg.seed)
+            centers, contexts = _gen_pairs(ids, cfg.window, cfg.seed)
             cb, xb = self._batches(centers, contexts)
             pairs = cb.size
             cbd, xbd = jnp.asarray(cb), jnp.asarray(xb)
@@ -254,8 +271,8 @@ class WordEmbedding:
         (ref RequestParameter, communicator.cpp:104-142)."""
         cfg = self.cfg
         with monitor("we.prepare"):
-            centers, contexts = w2v.generate_pairs(
-                block, cfg.window, seed=int(rng.integers(1 << 31)))
+            centers, contexts = _gen_pairs(block, cfg.window,
+                                           int(rng.integers(1 << 31)))
             negs = rng.choice(len(self.dict),
                               size=(max(centers.size, 1), cfg.negative),
                               p=self.unigram).astype(np.int32)
@@ -345,21 +362,39 @@ def synthetic_corpus(num_tokens: int = 200_000, vocab: int = 2000,
     return [f"w{t}" for t in out]
 
 
+def load_corpus(cfg: WEConfig):
+    """Build (Dictionary, encoded ids) for cfg.train_file, preferring the
+    native C++ loader (mv_data.cpp: tokenize+count+prune+encode in one pass)."""
+    max_vocab = int(cfg.max_vocab) if cfg.max_vocab else None
+    if cfg.train_file and native.available():
+        corpus = native.NativeCorpus(cfg.train_file, cfg.min_count,
+                                     max_vocab)
+        dictionary = Dictionary.from_counts(corpus.words(), corpus.counts(),
+                                            cfg.min_count)
+        return dictionary, prepare_ids(dictionary,
+                                       corpus.ids().astype(np.int64), cfg)
+    if cfg.train_file:
+        # byte-level ASCII-whitespace split, matching the native tokenizer
+        # exactly (mv_data.cpp is_space) so results don't depend on whether
+        # the C++ build is available
+        with open(cfg.train_file, "rb") as f:
+            tokens = [t.decode("utf-8", errors="replace")
+                      for t in f.read().split()]
+    else:
+        log.info("no -train_file given; using synthetic corpus")
+        tokens = synthetic_corpus()
+    dictionary = Dictionary.build(tokens, cfg.min_count, max_vocab)
+    return dictionary, prepare_ids(dictionary, dictionary.encode(tokens), cfg)
+
+
 def main(argv=None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
     cfg = WEConfig.from_argv(argv)
     mv.init()
-    if cfg.train_file:
-        with open(cfg.train_file) as f:
-            tokens = f.read().split()
-    else:
-        log.info("no -train_file given; using synthetic corpus")
-        tokens = synthetic_corpus()
-    dictionary = Dictionary.build(tokens, cfg.min_count,
-                                  int(cfg.max_vocab) if cfg.max_vocab else None)
-    log.info("vocab %d words", len(dictionary))
+    dictionary, ids = load_corpus(cfg)
+    log.info("vocab %d words, %d training tokens (native=%s)",
+             len(dictionary), ids.size, native.available())
     we = WordEmbedding(cfg, dictionary)
-    ids = we.prepare_ids(tokens)
     stats = we.train_fused(ids)
     log.info("trained: %s", stats)
     we.save_embeddings()

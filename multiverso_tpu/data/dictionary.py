@@ -27,6 +27,17 @@ class Dictionary:
         self.counts: np.ndarray = np.zeros(0, dtype=np.int64)
 
     @classmethod
+    def from_counts(cls, words: List[str], counts: np.ndarray,
+                    min_count: int = 5) -> "Dictionary":
+        """Adopt a pre-counted vocabulary (e.g. from the native corpus
+        loader), which is already pruned and count-desc sorted."""
+        d = cls(min_count)
+        d.words = list(words)
+        d.word2id = {w: i for i, w in enumerate(d.words)}
+        d.counts = np.asarray(counts, dtype=np.int64)
+        return d
+
+    @classmethod
     def build(cls, tokens: Iterable[str], min_count: int = 5,
               max_vocab: Optional[int] = None) -> "Dictionary":
         d = cls(min_count)

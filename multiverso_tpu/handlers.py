@@ -1,0 +1,87 @@
+"""Binding-style table handlers.
+
+Parity surface for the reference Python binding
+(ref: binding/python/multiverso/tables.py — ArrayTableHandler /
+MatrixTableHandler over the C ABI; float32-only; the *master-init convention*:
+worker 0 Adds the init value while the others Add zeros so the shared value is
+initialized exactly once, tables.py:50-57). Users of the reference binding
+can switch imports and keep their code.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import multiverso_tpu as mv
+
+
+class ArrayTableHandler:
+    def __init__(self, size: int, init_value=None, name: str = "array"):
+        self._table = mv.ArrayTable(int(size), dtype=np.float32, name=name)
+        self.size = int(size)
+        if init_value is not None:
+            init_value = np.asarray(init_value, dtype=np.float32).reshape(-1)
+            # master-init: only worker 0 contributes the value; everyone
+            # participates in the Add so the barrier semantics match
+            # (ref tables.py:50-57)
+            if mv.is_master_worker():
+                self._table.add(init_value)
+            else:
+                self._table.add(np.zeros_like(init_value))
+            mv.barrier()
+
+    def get(self, out: Optional[np.ndarray] = None) -> np.ndarray:
+        return self._table.get(out=out)
+
+    def add(self, data, sync: bool = True) -> None:
+        data = np.asarray(data, dtype=np.float32).reshape(-1)
+        if sync:
+            self._table.add(data)
+        else:
+            self._table.add_async(data)
+
+    @property
+    def table(self) -> mv.ArrayTable:
+        return self._table
+
+
+class MatrixTableHandler:
+    def __init__(self, num_row: int, num_col: int, init_value=None,
+                 name: str = "matrix"):
+        self._table = mv.MatrixTable(int(num_row), int(num_col),
+                                     dtype=np.float32, name=name)
+        self.num_row, self.num_col = int(num_row), int(num_col)
+        if init_value is not None:
+            init_value = np.asarray(init_value, dtype=np.float32).reshape(
+                self.num_row, self.num_col)
+            if mv.is_master_worker():
+                self._table.add(init_value)
+            else:
+                self._table.add(np.zeros_like(init_value))
+            mv.barrier()
+
+    def get(self, out: Optional[np.ndarray] = None) -> np.ndarray:
+        return self._table.get(out=out)
+
+    def add(self, data, sync: bool = True) -> None:
+        data = np.asarray(data, dtype=np.float32).reshape(
+            self.num_row, self.num_col)
+        if sync:
+            self._table.add(data)
+        else:
+            self._table.add_async(data)
+
+    def get_rows(self, row_ids, out: Optional[np.ndarray] = None) -> np.ndarray:
+        return self._table.get_rows(row_ids, out=out)
+
+    def add_rows(self, row_ids, values, sync: bool = True) -> None:
+        if sync:
+            self._table.add_rows(row_ids, values)
+        else:
+            self._table.add_rows_async(row_ids, values)
+
+    @property
+    def table(self) -> mv.MatrixTable:
+        return self._table

@@ -1,0 +1,11 @@
+from multiverso_tpu.parallel.collectives import (
+    all_gather, all_reduce, broadcast, reduce_scatter)
+from multiverso_tpu.parallel.worker_map import make_worker_mesh, worker_step
+from multiverso_tpu.parallel.ring import (
+    ring_attention, sequence_shard, ulysses_attention)
+
+__all__ = [
+    "all_gather", "all_reduce", "broadcast", "reduce_scatter",
+    "make_worker_mesh", "worker_step",
+    "ring_attention", "sequence_shard", "ulysses_attention",
+]

@@ -1,0 +1,97 @@
+"""Collectives over the device mesh.
+
+TPU-native equivalent of the reference's CPU collective engine
+(ref: src/net/allreduce_engine.cpp — Bruck all-gather for small payloads,
+recursive-halving reduce-scatter + Bruck for large, over point-to-point
+SendRecv; src/net/allreduce_topo.cpp — the hop maps). On TPU every one of
+those algorithms collapses into a single XLA collective routed on the ICI
+torus by the compiler — ``psum`` / ``all_gather`` / ``psum_scatter`` inside
+``shard_map``. The topology math (BruckMap/RecursiveHalvingMap) is subsumed
+by hardware routing and is an explicit non-goal (SURVEY §2.2).
+
+These helpers are host-plane conveniences: they take a host or device array,
+run the collective over the Zoo mesh's table axis, and hand the result back.
+In-graph code should call ``jax.lax.psum`` etc. directly inside its own
+``shard_map``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from multiverso_tpu.zoo import Zoo
+
+
+def _mesh_axis(axis: Optional[str]):
+    zoo = Zoo.get()
+    mesh = zoo.mesh()
+    return mesh, (axis or zoo.shard_axis())
+
+
+def all_reduce(x, axis: Optional[str] = None) -> jax.Array:
+    """Sum the per-shard slices of an axis-sharded array into a replicated
+    result — the reference Allreduce over per-node buffers
+    (ref AllreduceEngine::Allreduce). Input: sharded [n] (n = shards * chunk);
+    output: replicated [chunk] = sum of all chunks."""
+    mesh, ax = _mesh_axis(axis)
+    x = jnp.asarray(x)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P(ax), out_specs=P(),
+             check_vma=False)
+    def _psum(v):
+        return jax.lax.psum(v, ax)
+
+    return _psum(x)
+
+
+def all_gather(x, axis: Optional[str] = None) -> jax.Array:
+    """Concatenate the shards of an axis-sharded array on every shard
+    (ref AllreduceEngine::Allgather)."""
+    mesh, ax = _mesh_axis(axis)
+    x = jnp.asarray(x)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P(ax), out_specs=P(),
+             check_vma=False)
+    def _ag(v):
+        return jax.lax.all_gather(v, ax, tiled=True)
+
+    return _ag(x)
+
+
+def reduce_scatter(x, axis: Optional[str] = None) -> jax.Array:
+    """Sum a replicated array and leave each shard with its slice
+    (ref AllreduceEngine::ReduceScatter). Input: replicated [n]; output:
+    sharded [n] (each device holds n/shards)."""
+    mesh, ax = _mesh_axis(axis)
+    x = jnp.asarray(x)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P(), out_specs=P(ax),
+             check_vma=False)
+    def _rs(v):
+        n = jax.lax.axis_size(ax)
+        i = jax.lax.axis_index(ax)
+        chunk = v.shape[0] // n
+        return jax.lax.dynamic_slice_in_dim(v, i * chunk, chunk)
+
+    return _rs(x)
+
+
+def broadcast(x, root: int = 0, axis: Optional[str] = None) -> jax.Array:
+    """Every shard adopts shard ``root``'s value (controller-broadcast
+    analogue, ref src/controller.cpp membership broadcast)."""
+    mesh, ax = _mesh_axis(axis)
+    x = jnp.asarray(x)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P(ax), out_specs=P(),
+             check_vma=False)
+    def _bc(v):
+        full = jax.lax.all_gather(v, ax)
+        return full[root]
+
+    return _bc(x)

@@ -1,0 +1,150 @@
+"""Binding surfaces: handlers (python-binding parity), sharedvar delta sync,
+C ABI shim, checkpoint (ref tier-3 binding tests, SURVEY §4:
+binding/python/multiverso/tests/test_multiverso.py)."""
+
+import ctypes
+import os
+
+import numpy as np
+import pytest
+
+import multiverso_tpu as mv
+from multiverso_tpu import checkpoint
+from multiverso_tpu.handlers import ArrayTableHandler, MatrixTableHandler
+from multiverso_tpu.sharedvar import mv_shared
+
+
+@pytest.fixture(autouse=True)
+def _init():
+    mv.init()
+    yield
+    mv.shutdown()
+
+
+class TestHandlers:
+    def test_array_handler_roundtrip(self):
+        # ref test_multiverso.py TestArray: get returns what was added,
+        # scaled by workers_num (1 here)
+        h = ArrayTableHandler(100, init_value=np.arange(100, dtype=np.float32))
+        np.testing.assert_allclose(h.get(), np.arange(100))
+        h.add(np.ones(100))
+        np.testing.assert_allclose(h.get(), np.arange(100) + 1)
+
+    def test_matrix_handler(self):
+        h = MatrixTableHandler(10, 4)
+        h.add(np.ones((10, 4)))
+        np.testing.assert_allclose(h.get(), 1.0)
+        h.add_rows([2, 3], np.full((2, 4), 2.0))
+        np.testing.assert_allclose(h.get_rows([2]), 3.0)
+
+
+class TestSharedVar:
+    def test_delta_sync(self):
+        # ref sharedvar.py mv_sync: Add(current - last) then Get
+        params = {"w": np.ones((3, 2), np.float32),
+                  "b": np.zeros(3, np.float32)}
+        shared = mv_shared(params)
+        got = shared.get()
+        np.testing.assert_allclose(got["w"], 1.0)
+        # local update then sync: global state reflects the delta
+        local = {"w": got["w"] + 0.5, "b": got["b"] - 1.0}
+        merged = shared.sync(local)
+        np.testing.assert_allclose(merged["w"], 1.5)
+        np.testing.assert_allclose(merged["b"], -1.0)
+        # second sync with no local change is a no-op
+        merged2 = shared.sync(merged)
+        np.testing.assert_allclose(merged2["w"], 1.5)
+
+    def test_preserves_tree_structure(self):
+        import jax.numpy as jnp
+        params = {"layers": [{"k": jnp.ones((2, 2))},
+                             {"k": jnp.zeros((1, 3))}]}
+        shared = mv_shared(params)
+        out = shared.get()
+        assert out["layers"][0]["k"].shape == (2, 2)
+        assert out["layers"][1]["k"].shape == (1, 3)
+
+
+class TestCheckpoint:
+    def test_save_restore_all_tables(self, tmp_path):
+        t1 = mv.ArrayTable(64, updater="adagrad", name="ckpt_a")
+        t2 = mv.MatrixTable(8, 4, name="ckpt_m")
+        kv = mv.KVTable(name="ckpt_kv")
+        t1.add(np.ones(64, np.float32), mv.AddOption(learning_rate=0.1))
+        t2.add_rows([3], np.full((1, 4), 5.0, np.float32))
+        kv.add([9], [42])
+        path = checkpoint.save(str(tmp_path), tag="t0")
+        snap1, snap2 = t1.get().copy(), t2.get().copy()
+
+        t1.add(np.ones(64, np.float32))
+        t2.add(np.ones((8, 4), np.float32))
+        kv.add([9], [1])
+        n = checkpoint.restore(str(tmp_path), tag="t0")
+        assert n == 3
+        np.testing.assert_allclose(t1.get(), snap1)
+        np.testing.assert_allclose(t2.get(), snap2)
+        assert kv[9] == 42
+        assert checkpoint.latest(str(tmp_path)) == "t0"
+
+    def test_restore_mismatch_raises(self, tmp_path):
+        mv.ArrayTable(16, name="first")
+        checkpoint.save(str(tmp_path), tag="x")
+        mv.shutdown()
+        mv.init()
+        mv.ArrayTable(16, name="different")
+        with pytest.raises(ValueError):
+            checkpoint.restore(str(tmp_path), tag="x")
+
+
+_CAPI = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "multiverso_tpu", "native",
+    "libmultiverso.so")
+
+
+@pytest.mark.skipif(not os.path.exists(_CAPI),
+                    reason="libmultiverso.so not built")
+class TestCAPI:
+    """Drive the C ABI end-to-end from ctypes (the Lua-binding load path,
+    ref c_api.h). The shim attaches to this already-running interpreter."""
+
+    def _lib(self):
+        lib = ctypes.CDLL(_CAPI)
+        lib.MV_NewArrayTable.argtypes = [ctypes.c_int,
+                                         ctypes.POINTER(ctypes.c_void_p)]
+        lib.MV_GetArrayTable.argtypes = [ctypes.c_void_p,
+                                         ctypes.POINTER(ctypes.c_float),
+                                         ctypes.c_int]
+        lib.MV_AddArrayTable.argtypes = lib.MV_GetArrayTable.argtypes
+        lib.MV_NewMatrixTable.argtypes = [ctypes.c_int, ctypes.c_int,
+                                          ctypes.POINTER(ctypes.c_void_p)]
+        lib.MV_GetMatrixTableByRows.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_float), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int), ctypes.c_int]
+        lib.MV_AddMatrixTableByRows.argtypes = lib.MV_GetMatrixTableByRows.argtypes
+        return lib
+
+    def test_array_table_via_c_abi(self):
+        lib = self._lib()
+        lib.MV_Init(None, None)
+        assert lib.MV_NumWorkers() == 1
+        assert lib.MV_WorkerId() == 0
+        h = ctypes.c_void_p()
+        lib.MV_NewArrayTable(32, ctypes.byref(h))
+        data = (ctypes.c_float * 32)(*([2.0] * 32))
+        lib.MV_AddArrayTable(h, data, 32)
+        out = (ctypes.c_float * 32)()
+        lib.MV_GetArrayTable(h, out, 32)
+        np.testing.assert_allclose(list(out), 2.0)
+        lib.MV_Barrier()
+
+    def test_matrix_rows_via_c_abi(self):
+        lib = self._lib()
+        lib.MV_Init(None, None)
+        h = ctypes.c_void_p()
+        lib.MV_NewMatrixTable(6, 3, ctypes.byref(h))
+        ids = (ctypes.c_int * 2)(1, 4)
+        vals = (ctypes.c_float * 6)(*([1.5] * 6))
+        lib.MV_AddMatrixTableByRows(h, vals, 6, ids, 2)
+        out = (ctypes.c_float * 6)()
+        lib.MV_GetMatrixTableByRows(h, out, 6, ids, 2)
+        np.testing.assert_allclose(list(out), 1.5)
