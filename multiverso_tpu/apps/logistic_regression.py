@@ -55,6 +55,7 @@ class LogRegConfig:
         g = pairs.get
         self.input_size = int(g("input_size", "0"))
         self.output_size = int(g("output_size", "2"))
+        self.sparse = g("sparse", "false").lower() == "true"
         self.objective_type = g("objective_type", "softmax")
         self.updater_type = g("updater_type", "sgd")
         self.regular_type = g("regular_type", "none")
@@ -87,14 +88,26 @@ class LogReg:
         if not mv.Zoo.get().started:
             mv.init()
         n_params = model_lib.param_count(cfg.input_size, cfg.output_size)
-        self.table = mv.ArrayTable(n_params, updater=cfg.updater_type,
-                                   name="logreg_params")
+        if cfg.sparse:
+            # feature-major layout: row = feature (last row = bias), col =
+            # class, in a SparseMatrixTable so only active-feature rows cross
+            # the wire (ref custom SparseWorkerTable + per-chunk key sets,
+            # Applications/LogisticRegression/src/util/sparse_table.h)
+            self.sparse_table = mv.SparseMatrixTable(
+                cfg.input_size + 1, cfg.output_size,
+                updater=cfg.updater_type, name="logreg_sparse")
+            self.table = None
+        else:
+            self.sparse_table = None
+            self.table = mv.ArrayTable(n_params, updater=cfg.updater_type,
+                                       name="logreg_params")
         self._local_w = np.zeros(n_params, dtype=np.float32)
         self._grad_fn = jax.jit(
             lambda w, x, y: model_lib.loss_and_grad(
                 w, x, y, cfg.objective_type, cfg.regular_type,
                 cfg.regular_coef))
         self._acc_fn = jax.jit(model_lib.accuracy)
+        self._sparse_grad_jit = {}
 
     # ------------------------------------------------------------------ #
     def _weights(self) -> jax.Array:
@@ -103,21 +116,29 @@ class LogReg:
             self.cfg.output_size))
 
     def _sync_model(self) -> None:
-        self.table.get(out=self._local_w)
+        if self.cfg.sparse:
+            # feature-major (D+1, C) -> class-major flat (C*(D+1),)
+            w = self.sparse_table.get()
+            self._local_w[:] = w.T.reshape(-1)
+        else:
+            self.table.get(out=self._local_w)
 
     def train_file(self) -> Dict[str, float]:
         """Epoch loop over the sample reader (ref logreg.cpp Train :41-87)."""
         cfg = self.cfg
         losses, seen, t0 = [], 0, time.perf_counter()
         pull_buffer: Optional[AsyncBuffer] = None
-        if cfg.pipeline:
+        if cfg.pipeline and not cfg.sparse:
             pull_buffer = AsyncBuffer(self.table.get)
         self._sync_model()
         for epoch in range(cfg.train_epoch):
             reader = SampleReader(cfg.train_file, cfg.input_size,
                                   cfg.minibatch_size, fmt=cfg.reader_type)
-            for batch_idx, (x, y, _keys) in enumerate(reader):
-                loss = self._train_minibatch(x, y, batch_idx, pull_buffer)
+            for batch_idx, (x, y, keys) in enumerate(reader):
+                if cfg.sparse:
+                    loss = self._train_minibatch_sparse(x, y, keys)
+                else:
+                    loss = self._train_minibatch(x, y, batch_idx, pull_buffer)
                 losses.append(float(loss))
                 seen += len(y)
                 if seen % cfg.show_time_per_sample < cfg.minibatch_size:
@@ -149,6 +170,69 @@ class LogReg:
                     np.copyto(self._local_w, pull_buffer.get())
                 else:
                     self._sync_model()
+        return float(loss)
+
+    def _sparse_grad_fn(self, k: int):
+        """Jitted sparse-feature gradient: only the pulled weight rows
+        participate (ref sparse LR: per-chunk key sets feed sparse pulls,
+        Applications/LogisticRegression/src/reader.h:21-146)."""
+        fn = self._sparse_grad_jit.get(k)
+        if fn is None:
+            obj = self.cfg.objective_type
+            num_classes = self.cfg.output_size
+
+            def _g(wsub, xa, y):
+                logits = xa @ wsub                       # (B, C)
+                onehot = jax.nn.one_hot(y, num_classes, dtype=wsub.dtype)
+                if obj == "sigmoid":
+                    p = jax.nn.sigmoid(logits)
+                    eps = 1e-7
+                    loss = -jnp.mean(jnp.sum(
+                        onehot * jnp.log(p + eps)
+                        + (1 - onehot) * jnp.log(1 - p + eps), axis=-1))
+                    diff = p - onehot
+                else:
+                    logp = jax.nn.log_softmax(logits, axis=-1)
+                    loss = -jnp.mean(jnp.sum(onehot * logp, axis=-1))
+                    diff = jax.nn.softmax(logits, axis=-1) - onehot
+                grad = xa.T @ diff / xa.shape[0]         # (k, C)
+                return loss, grad
+
+            fn = self._sparse_grad_jit[k] = jax.jit(_g)
+        return fn
+
+    def _train_minibatch_sparse(self, x: np.ndarray, y: np.ndarray,
+                                keys: Optional[np.ndarray]) -> float:
+        """Sparse push/pull minibatch: pull only the batch's active feature
+        rows (stale-row protocol), compute on the submatrix, push row deltas.
+        FTRL receives the raw gradient (its alpha owns the step size,
+        ref app updater.cpp FTRL branch); other updaters get lr*grad."""
+        cfg = self.cfg
+        D = cfg.input_size
+        with monitor("logreg.sparse_minibatch"):
+            if keys is None:
+                keys = np.nonzero(np.any(x != 0, axis=0))[0]
+            keys = np.asarray(keys, dtype=np.int64).reshape(-1)
+            keys_b = np.append(keys, D)              # + bias row
+            k = keys_b.size
+            kb = 8
+            while kb < k:
+                kb *= 2
+            pad = kb - k
+            # pad with the bias row; its padded xa columns are zero, so the
+            # padded slots contribute exactly zero gradient
+            keys_p = np.concatenate([keys_b, np.full(pad, D, np.int64)])
+            xa = np.concatenate(
+                [x[:, keys], np.ones((len(y), 1), np.float32),
+                 np.zeros((len(y), pad), np.float32)], axis=1)
+            wsub = self.sparse_table.get_rows_sparse(
+                keys_p, worker_id=mv.worker_id())
+            loss, grad = self._sparse_grad_fn(kb)(
+                jnp.asarray(wsub), jnp.asarray(xa), jnp.asarray(y))
+            grad = np.asarray(grad)
+            if self.sparse_table.updater.name != "ftrl":
+                grad = grad * cfg.learning_rate
+            self.sparse_table.add_rows(keys_p, grad)
         return float(loss)
 
     def train_arrays(self, x: np.ndarray, y: np.ndarray,
@@ -200,6 +284,10 @@ class LogReg:
             total += len(y)
         return correct / total if total else 0.0
 
+    @property
+    def param_table(self):
+        return self.sparse_table if self.cfg.sparse else self.table
+
     def save_model(self, path: Optional[str] = None) -> None:
         """ref model.cpp Store :147-205 — worker-side pull then write."""
         from multiverso_tpu.io.stream import open_stream
@@ -207,12 +295,12 @@ class LogReg:
         if not path:
             return
         with open_stream(path, "wb") as s:
-            self.table.store(s)
+            self.param_table.store(s)
 
     def load_model(self, path: str) -> None:
         from multiverso_tpu.io.stream import open_stream
         with open_stream(path, "rb") as s:
-            self.table.load(s)
+            self.param_table.load(s)
         self._sync_model()
 
 

@@ -1,0 +1,166 @@
+"""ResNet (CIFAR variants) in pure JAX, parameter-server trained.
+
+Capability parity with the reference's binding benchmarks: Lasagne ResNet-32
+(ref: binding/python/examples/theano/lasagne/*, docs/BENCHMARK.md) and Torch
+fb.resnet ResNet-18 data-parallel with a Multiverso ArrayTable holding all
+parameters (ref: binding/lua/docs/BENCHMARK.md, BASELINE config 5 "ResNet-18
+CIFAR-10 data-parallel, Adam updater, 8->64 chips").
+
+TPU-first shape: NHWC, convolutions via ``lax.conv_general_dilated`` (XLA
+maps them to the MXU), BatchNorm with running stats carried functionally, the
+whole flattened parameter vector living in one ArrayTable (the reference
+Lasagne param_manager recipe) updated by the server-side Adam updater, and
+the batch axis sharded over a ``dp`` mesh axis so gradients meet in one psum.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _conv(x, w, stride=1):
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _bn_apply(x, scale, bias, mean, var, eps=1e-5):
+    inv = jax.lax.rsqrt(var + eps)
+    return (x - mean) * inv * scale + bias
+
+
+def _bn_train(x, scale, bias, mean, var, momentum=0.9):
+    axes = (0, 1, 2)
+    m = jnp.mean(x, axes)
+    v = jnp.var(x, axes)
+    out = _bn_apply(x, scale, bias, m, v)
+    new_mean = momentum * mean + (1 - momentum) * m
+    new_var = momentum * var + (1 - momentum) * v
+    return out, new_mean, new_var
+
+
+def init_resnet(key, depth: int = 20, num_classes: int = 10,
+                width: int = 16, in_channels: int = 3
+                ) -> Tuple[Dict, Dict]:
+    """CIFAR ResNet (6n+2 layout: depth 20/32/44...; ref benchmarks use 32).
+    Returns (params, bn_state)."""
+    if (depth - 2) % 6:
+        raise ValueError("CIFAR resnet depth must be 6n+2 (20, 32, 44, ...)")
+    n = (depth - 2) // 6
+    keys = iter(jax.random.split(key, 4 + 6 * n * 3))
+
+    def conv_init(k, kh, kw, cin, cout):
+        fan_in = kh * kw * cin
+        return (jax.random.normal(k, (kh, kw, cin, cout), jnp.float32)
+                * np.sqrt(2.0 / fan_in))
+
+    params: Dict[str, Any] = {"stem": conv_init(next(keys), 3, 3,
+                                                in_channels, width)}
+    bn: Dict[str, Any] = {"stem": _bn_init(width)}
+    chans = [width, 2 * width, 4 * width]
+    blocks: List[Dict] = []
+    bn_blocks: List[Dict] = []
+    cin = width
+    for stage, cout in enumerate(chans):
+        for b in range(n):
+            stride = 2 if (stage > 0 and b == 0) else 1
+            blk = {
+                "conv1": conv_init(next(keys), 3, 3, cin, cout),
+                "conv2": conv_init(next(keys), 3, 3, cout, cout),
+            }
+            if stride != 1 or cin != cout:
+                blk["proj"] = conv_init(next(keys), 1, 1, cin, cout)
+            blocks.append(blk)
+            bn_blocks.append({"bn1": _bn_init(cout), "bn2": _bn_init(cout)})
+            cin = cout
+    params["blocks"] = blocks
+    bn["blocks"] = bn_blocks
+    params["head_w"] = (jax.random.normal(next(keys),
+                                          (chans[-1], num_classes),
+                                          jnp.float32)
+                        * np.sqrt(1.0 / chans[-1]))
+    params["head_b"] = jnp.zeros((num_classes,), jnp.float32)
+    return params, bn
+
+
+def _bn_init(c):
+    return {"scale": jnp.ones((c,), jnp.float32),
+            "bias": jnp.zeros((c,), jnp.float32),
+            "mean": jnp.zeros((c,), jnp.float32),
+            "var": jnp.ones((c,), jnp.float32)}
+
+
+def apply_resnet(params: Dict, bn: Dict, x: jax.Array, train: bool = True
+                 ) -> Tuple[jax.Array, Dict]:
+    """Forward pass; returns (logits, new_bn_state)."""
+    new_bn = {"stem": dict(bn["stem"]), "blocks": []}
+
+    def run_bn(h, st, store: Dict):
+        if train:
+            out, m, v = _bn_train(h, st["scale"], st["bias"], st["mean"],
+                                  st["var"])
+            store.update({"scale": st["scale"], "bias": st["bias"],
+                          "mean": m, "var": v})
+            return out
+        store.update(st)
+        return _bn_apply(h, st["scale"], st["bias"], st["mean"], st["var"])
+
+    h = _conv(x, params["stem"])
+    h = jax.nn.relu(run_bn(h, bn["stem"], new_bn["stem"]))
+    n = len(params["blocks"]) // 3  # blocks per stage (6n+2 layout)
+    for i, (blk, bst) in enumerate(zip(params["blocks"], bn["blocks"])):
+        # stage boundaries downsample (except the first stage)
+        stride = 2 if (i in (n, 2 * n)) else 1
+        store = {"bn1": {}, "bn2": {}}
+        out = _conv(h, blk["conv1"], stride)
+        out = jax.nn.relu(run_bn(out, bst["bn1"], store["bn1"]))
+        out = _conv(out, blk["conv2"])
+        out = run_bn(out, bst["bn2"], store["bn2"])
+        shortcut = _conv(h, blk["proj"], stride) if "proj" in blk else h
+        h = jax.nn.relu(out + shortcut)
+        new_bn["blocks"].append(store)
+    h = jnp.mean(h, axis=(1, 2))
+    logits = h @ params["head_w"] + params["head_b"]
+    return logits, new_bn
+
+
+def loss_fn(params, bn, x, y, train=True):
+    logits, new_bn = apply_resnet(params, bn, x, train)
+    onehot = jax.nn.one_hot(y, logits.shape[-1], dtype=logits.dtype)
+    loss = -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits), axis=-1))
+    return loss, new_bn
+
+
+def flatten_params(params) -> Tuple[np.ndarray, Any]:
+    leaves, treedef = jax.tree.flatten(params)
+    flat = np.concatenate([np.asarray(l).reshape(-1) for l in leaves])
+    meta = (treedef, [np.shape(l) for l in leaves])
+    return flat.astype(np.float32), meta
+
+
+def unflatten_params(flat, meta):
+    treedef, shapes = meta
+    leaves, off = [], 0
+    for s in shapes:
+        size = int(np.prod(s)) if s else 1
+        leaves.append(jnp.asarray(flat[off:off + size]).reshape(s))
+        off += size
+    return jax.tree.unflatten(treedef, leaves)
+
+
+def synthetic_cifar(n: int, size: int = 32, classes: int = 10, seed: int = 0):
+    """CIFAR-shaped synthetic data with class-dependent structure (zero-egress
+    stand-in; each class gets a distinct low-frequency pattern + noise)."""
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, classes, n).astype(np.int32)
+    yy, xx = np.mgrid[0:size, 0:size].astype(np.float32) / size
+    patterns = np.stack([
+        np.sin(2 * np.pi * ((c % 5 + 1) * xx + (c // 5 + 1) * yy))
+        for c in range(classes)]).astype(np.float32)
+    x = (patterns[y][..., None].repeat(3, axis=-1) * 0.5
+         + rng.normal(size=(n, size, size, 3)).astype(np.float32) * 0.3)
+    return x.astype(np.float32), y

@@ -1,0 +1,4 @@
+from multiverso_tpu.ops.embedding_kernels import (
+    embedding_gather, embedding_scatter_add, pallas_supported)
+
+__all__ = ["embedding_gather", "embedding_scatter_add", "pallas_supported"]

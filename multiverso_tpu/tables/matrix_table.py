@@ -37,7 +37,11 @@ import numpy as np
 from multiverso_tpu import updaters as updaters_lib
 from multiverso_tpu.table import ArrayLike, Table
 from multiverso_tpu.updaters import AddOption
+from multiverso_tpu.utils import config
 from multiverso_tpu.utils.dashboard import monitor
+
+config.define_bool("pallas", True, "use Pallas TPU kernels for row-sparse "
+                   "table traffic where shapes allow")
 
 
 def _bucket_size(k: int, cap: int) -> int:
@@ -80,10 +84,38 @@ class MatrixTable(Table):
             return nd - pd
         return None
 
+    def _use_pallas(self, bucket: int) -> bool:
+        """Pallas row kernels: single-device tables with lane-aligned rows and
+        the plain-accumulation updater (kernels fuse only the += path; other
+        updaters keep the XLA gather/update/scatter program)."""
+        from multiverso_tpu.ops import embedding_kernels as ek
+        return (config.get_flag("pallas")
+                and self._num_shards == 1
+                and self.updater.name == "default"
+                and ek.pallas_supported(int(self.shape[1]), bucket))
+
+    def _pallas_gettable(self, bucket: int) -> bool:
+        from multiverso_tpu.ops import embedding_kernels as ek
+        return (config.get_flag("pallas")
+                and self._num_shards == 1
+                and ek.pallas_supported(int(self.shape[1]), bucket))
+
     def _row_update_fn(self, bucket: int):
         key = ("row_update", bucket)
         fn = self._jit_cache.get(key)
         if fn is not None:
+            return fn
+
+        if self._use_pallas(bucket):
+            from multiverso_tpu.ops import embedding_kernels as ek
+
+            def _update(data, ustate, ids, vals, opt):
+                data = ek.embedding_scatter_add(data, ids, vals)
+                token = jnp.ravel(data)[0]
+                return data, ustate, token
+
+            fn = jax.jit(_update, donate_argnums=(0, 1))
+            self._jit_cache[key] = fn
             return fn
 
         def _update(data, ustate, ids, vals, opt):
@@ -100,7 +132,11 @@ class MatrixTable(Table):
         key = ("row_get", bucket)
         fn = self._jit_cache.get(key)
         if fn is None:
-            fn = jax.jit(lambda data, ids: jnp.take(data, ids, axis=0))
+            if self._pallas_gettable(bucket):
+                from multiverso_tpu.ops import embedding_kernels as ek
+                fn = jax.jit(ek.embedding_gather)
+            else:
+                fn = jax.jit(lambda data, ids: jnp.take(data, ids, axis=0))
             self._jit_cache[key] = fn
         return fn
 

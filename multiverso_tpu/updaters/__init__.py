@@ -137,12 +137,47 @@ class AdamUpdater(Updater):
         return data - step, {"m": m, "v": v, "t": t}
 
 
+class FTRLUpdater(Updater):
+    """FTRL-proximal (ref: Applications/LogisticRegression/src/updater/
+    updater.cpp:79-101 FTRL branch + util/ftrl_sparse_table.h z/n entries).
+    The delta passed to ``apply`` is the raw gradient; the stored data is the
+    *weight* vector recomputed from the (z, n) state after each update, so
+    Get keeps returning ready-to-use weights like every other table."""
+
+    name = "ftrl"
+
+    def __init__(self, num_workers: int = 1, alpha: float = 0.1,
+                 beta: float = 1.0, lambda1: float = 0.1,
+                 lambda2: float = 1.0):
+        super().__init__(num_workers)
+        self.alpha, self.beta = alpha, beta
+        self.lambda1, self.lambda2 = lambda1, lambda2
+
+    def init_state(self, shape, dtype):
+        return {"z": jnp.zeros(shape, dtype), "n": jnp.zeros(shape, dtype)}
+
+    def apply(self, data, state, delta, opt):
+        g = delta
+        z, n = state["z"], state["n"]
+        alpha = jnp.asarray(self.alpha, data.dtype)
+        sigma = (jnp.sqrt(n + jnp.square(g)) - jnp.sqrt(n)) / alpha
+        z = z + g - sigma * data
+        n = n + jnp.square(g)
+        w = jnp.where(
+            jnp.abs(z) <= self.lambda1,
+            jnp.zeros_like(z),
+            -(z - jnp.sign(z) * self.lambda1)
+            / ((self.beta + jnp.sqrt(n)) / alpha + self.lambda2))
+        return w, {"z": z, "n": n}
+
+
 _REGISTRY: Dict[str, Callable[..., Updater]] = {
     "default": Updater,
     "sgd": SGDUpdater,
     "momentum_sgd": MomentumUpdater,
     "adagrad": AdaGradUpdater,
     "adam": AdamUpdater,
+    "ftrl": FTRLUpdater,
 }
 
 

@@ -1,0 +1,105 @@
+"""Wire compression filters.
+
+TPU-native equivalent of the reference filter layer
+(ref: include/multiverso/util/quantization_util.h:37-154 — ``SparseFilter``
+rewrites a blob as (index, value) pairs when >50% of entries fall under a
+clip threshold; ``OneBitsFilter`` (:160-161) was declared and never
+implemented). On TPU the intra-pod wire is ICI managed by XLA, so these
+filters matter on the *host/DCN* seams: compressing deltas before
+cross-process aggregation or before a tunneled host<->device transfer.
+
+``OneBitsFilter`` is actually implemented here — 1-bit sign quantization with
+per-block scale and error-feedback residual (the 1-bit SGD recipe the
+reference planned): finishing what the reference left as a stub.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+
+class SparseFilter:
+    """(index, value) sparse encoding under a clip threshold
+    (ref quantization_util.h SparseFilter: FilterIn/FilterOut)."""
+
+    def __init__(self, clip: float = 0.0):
+        self.clip = clip
+
+    def filter_in(self, data: np.ndarray) -> Tuple[Dict, np.ndarray]:
+        """Returns (header, payload). Sparse iff >50% of entries are clipped
+        (the reference's worthwhile-to-compress rule)."""
+        flat = np.asarray(data, dtype=np.float32).reshape(-1)
+        keep = np.abs(flat) > self.clip
+        nnz = int(keep.sum())
+        if nnz * 2 < flat.size:
+            idx = np.nonzero(keep)[0].astype(np.int32)
+            vals = flat[keep]
+            payload = np.concatenate([idx.view(np.float32), vals])
+            return ({"sparse": True, "size": flat.size, "nnz": nnz},
+                    payload)
+        return {"sparse": False, "size": flat.size}, flat
+
+    def filter_out(self, header: Dict, payload: np.ndarray) -> np.ndarray:
+        if not header["sparse"]:
+            return payload.copy()
+        nnz = header["nnz"]
+        idx = payload[:nnz].view(np.int32)
+        vals = payload[nnz:]
+        out = np.zeros(header["size"], dtype=np.float32)
+        out[idx] = vals
+        return out
+
+
+class OneBitsFilter:
+    """1-bit quantization with error feedback (declared but empty in the
+    reference, quantization_util.h:160-161 — implemented here).
+
+    Encode: per-block mean magnitude of positives/negatives + sign bitmap.
+    The quantization error is kept as a residual and added to the next
+    payload, so the compressed stream is unbiased over time (1-bit SGD)."""
+
+    def __init__(self, block: int = 1024):
+        self.block = block
+        self._residual: Optional[np.ndarray] = None
+
+    def filter_in(self, data: np.ndarray) -> Tuple[Dict, np.ndarray, np.ndarray]:
+        flat = np.asarray(data, dtype=np.float32).reshape(-1)
+        if self._residual is None or self._residual.size != flat.size:
+            self._residual = np.zeros_like(flat)
+        flat = flat + self._residual
+        n = flat.size
+        nb = (n + self.block - 1) // self.block
+        padded = np.zeros(nb * self.block, np.float32)
+        padded[:n] = flat
+        blocks = padded.reshape(nb, self.block)
+        pos = blocks > 0
+        # per-block scales: mean of positives / mean magnitude of negatives
+        pos_scale = np.where(pos.any(1),
+                             (blocks * pos).sum(1) / np.maximum(pos.sum(1), 1),
+                             0.0).astype(np.float32)
+        neg = ~pos
+        neg_scale = np.where(neg.any(1),
+                             (-blocks * neg).sum(1) / np.maximum(neg.sum(1), 1),
+                             0.0).astype(np.float32)
+        bits = np.packbits(pos, axis=None)
+        decoded = np.where(pos, pos_scale[:, None],
+                           -neg_scale[:, None]).reshape(-1)[:n]
+        self._residual = flat - decoded
+        scales = np.stack([pos_scale, neg_scale], axis=1)
+        return {"size": n, "block": self.block}, bits, scales
+
+    def filter_out(self, header: Dict, bits: np.ndarray,
+                   scales: np.ndarray) -> np.ndarray:
+        n, block = header["size"], header["block"]
+        nb = (n + block - 1) // block
+        pos = np.unpackbits(bits, count=nb * block).astype(bool).reshape(
+            nb, block)
+        out = np.where(pos, scales[:, 0][:, None], -scales[:, 1][:, None])
+        return out.reshape(-1)[:n].astype(np.float32)
+
+    def compression_ratio(self, n: int) -> float:
+        """bytes(original float32) / bytes(bits + scales)."""
+        nb = (n + self.block - 1) // self.block
+        return (4.0 * n) / (n / 8.0 + 8.0 * nb)
