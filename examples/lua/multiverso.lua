@@ -1,0 +1,62 @@
+-- LuaJIT FFI binding for multiverso_tpu's C ABI (libmultiverso.so).
+--
+-- Mirrors the load pattern of the reference Lua binding
+-- (ref: binding/lua/init.lua:7-67 — ffi.cdef over c_api.h then
+-- ffi.load('multiverso')). The C ABI here bridges into the JAX/TPU runtime
+-- (see multiverso_tpu/native/mv_capi.cpp); build it with
+--   make -C multiverso_tpu/native capi
+-- This file ships as an untested example: the build image has no LuaJIT.
+
+local ffi = require('ffi')
+
+ffi.cdef[[
+typedef void* TableHandler;
+void MV_Init(int* argc, char** argv);
+void MV_ShutDown();
+void MV_Barrier();
+int  MV_NumWorkers();
+int  MV_WorkerId();
+int  MV_ServerId();
+void MV_NewArrayTable(int size, TableHandler* out);
+void MV_GetArrayTable(TableHandler handler, float* data, int size);
+void MV_AddArrayTable(TableHandler handler, float* data, int size);
+void MV_AddAsyncArrayTable(TableHandler handler, float* data, int size);
+void MV_NewMatrixTable(int num_row, int num_col, TableHandler* out);
+void MV_GetMatrixTableAll(TableHandler handler, float* data, int size);
+void MV_AddMatrixTableAll(TableHandler handler, float* data, int size);
+void MV_GetMatrixTableByRows(TableHandler handler, float* data, int size,
+                             int row_ids[], int row_ids_n);
+void MV_AddMatrixTableByRows(TableHandler handler, float* data, int size,
+                             int row_ids[], int row_ids_n);
+]]
+
+local lib = ffi.load('multiverso')
+
+local M = {}
+
+function M.init() lib.MV_Init(nil, nil) end
+function M.shutdown() lib.MV_ShutDown() end
+function M.barrier() lib.MV_Barrier() end
+function M.num_workers() return lib.MV_NumWorkers() end
+function M.worker_id() return lib.MV_WorkerId() end
+
+local ArrayTable = {}
+ArrayTable.__index = ArrayTable
+
+function M.new_array_table(size)
+  local h = ffi.new('TableHandler[1]')
+  lib.MV_NewArrayTable(size, h)
+  return setmetatable({ handler = h[0], size = size }, ArrayTable)
+end
+
+function ArrayTable:get(buf)
+  buf = buf or ffi.new('float[?]', self.size)
+  lib.MV_GetArrayTable(self.handler, buf, self.size)
+  return buf
+end
+
+function ArrayTable:add(buf)
+  lib.MV_AddArrayTable(self.handler, buf, self.size)
+end
+
+return M

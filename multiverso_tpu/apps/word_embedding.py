@@ -118,6 +118,7 @@ class WordEmbedding:
         self.word_count = mv.KVTable(name="word_count")
         self.unigram = dictionary.unigram_table()
         self._trained_words = 0
+        self._fused_cache: Dict[str, object] = {}
         if cfg.hs:
             codes, points, lengths = build_huffman(dictionary.counts)
             self._hs = (codes, points, lengths)
@@ -163,7 +164,10 @@ class WordEmbedding:
             mb = jnp.asarray(masks[:n].reshape(-1, b, masks.shape[1]))
             tb = jnp.asarray(targets[:n].reshape(-1, b))
             pairs = n
-            epoch_fn = w2v.make_fused_cbow_epoch(w2v_cfg, self.unigram)
+            epoch_fn = self._fused_cache.get("cbow")
+            if epoch_fn is None:
+                epoch_fn = self._fused_cache["cbow"] = (
+                    w2v.make_fused_cbow_epoch(w2v_cfg, self.unigram))
             state_in, state_out = self.table_in.state, self.table_out.state
             win, wout = state_in["data"], state_out["data"]
             for _ in range(epochs):
@@ -182,8 +186,11 @@ class WordEmbedding:
             win = state_in["data"]
             if cfg.hs:
                 codes, points, lengths = self._hs
-                epoch_fn = w2v.make_fused_hs_epoch(w2v_cfg, codes, points,
-                                                   lengths)
+                epoch_fn = self._fused_cache.get("hs")
+                if epoch_fn is None:
+                    epoch_fn = self._fused_cache["hs"] = (
+                        w2v.make_fused_hs_epoch(w2v_cfg, codes, points,
+                                                lengths))
                 state_hs = self.table_hs.state
                 hs_out = state_hs["data"]
                 for _ in range(epochs):
@@ -193,7 +200,10 @@ class WordEmbedding:
                 self.table_hs.adopt({"data": hs_out,
                                      "ustate": state_hs["ustate"]})
             else:
-                epoch_fn = w2v.make_fused_epoch(w2v_cfg, self.unigram)
+                epoch_fn = self._fused_cache.get("sg")
+                if epoch_fn is None:
+                    epoch_fn = self._fused_cache["sg"] = (
+                        w2v.make_fused_epoch(w2v_cfg, self.unigram))
                 state_out = self.table_out.state
                 wout = state_out["data"]
                 for _ in range(epochs):
