@@ -229,13 +229,34 @@ class Table:
             self._jit_cache[key] = fn
         return fn
 
+    @staticmethod
+    def _to_host(data: jax.Array) -> np.ndarray:
+        """Device -> host, including multi-controller arrays whose shards
+        live on other processes (ICI/DCN allgather instead of local DMA)."""
+        if getattr(data, "is_fully_addressable", True):
+            return np.asarray(data)
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(data, tiled=True))
+
     def _host_delta(self, delta: ArrayLike) -> jax.Array:
-        """Pad + shard-place a host/device delta of logical table shape."""
+        """Pad + shard-place a host/device delta of logical table shape.
+
+        Multi-controller: host-plane Add is a *collective* — every process
+        calls it with its own worker's delta, and the effective delta is the
+        SUM over processes (reference semantics: N workers each pushed
+        theirs). A plain global device_put would instead mosaic each
+        process's rows into its local shards, silently dropping the other
+        workers' contributions.
+        """
         if isinstance(delta, jax.Array) and delta.shape == self._padded_shape:
             return delta
         if isinstance(delta, jax.Array):
             return jax.device_put(self.pad_delta(delta), self._sharding)
         arr = np.asarray(delta, dtype=self.dtype).reshape(self.shape)
+        if self._zoo.size() > 1:
+            from jax.experimental import multihost_utils
+            gathered = multihost_utils.process_allgather(arr, tiled=False)
+            arr = np.asarray(gathered).sum(axis=0).astype(self.dtype)
         padded = np.zeros(self._padded_shape, dtype=self.dtype)
         padded[: self.shape[0]] = arr
         return jax.device_put(padded, self._sharding)
@@ -275,7 +296,7 @@ class Table:
         if res is None:
             raise KeyError(f"msg_id {msg_id} unknown or already consumed")
         _, data = res
-        host = np.asarray(data)[: self.shape[0]]
+        host = self._to_host(data)[: self.shape[0]]
         if out is not None:
             np.copyto(out.reshape(self.shape), host)
             return out

@@ -185,6 +185,22 @@ class MatrixTable(Table):
         opt = opt or AddOption()
         with monitor(f"table[{self.name}].add_rows"), self._dispatch_lock:
             ids, vals, _, _ = self._prep_ids(row_ids, values)
+            if self._zoo.size() > 1:
+                # collective row add: every process must push the same id
+                # set; vals are summed across processes (reference: each
+                # worker's Add lands on the server shard).
+                from jax.experimental import multihost_utils
+                gids = np.asarray(multihost_utils.process_allgather(
+                    ids, tiled=False))
+                if not np.all(gids == gids[0]):
+                    raise NotImplementedError(
+                        "multi-process add_rows requires identical row-id "
+                        "sets on every process (collective semantics); for "
+                        "per-worker row traffic use process-local tables + "
+                        "aggregate, or the fused plane")
+                gvals = np.asarray(multihost_utils.process_allgather(
+                    vals, tiled=False))
+                vals = gvals.sum(axis=0).astype(self.dtype)
             fn = self._row_update_fn(ids.size)
             self._data, self._ustate, token = fn(
                 self._data, self._ustate,
@@ -210,7 +226,7 @@ class MatrixTable(Table):
         msg_id = self.get_rows_async(row_ids)
         res = self.wait(msg_id)
         _, rows, k, inv = res
-        host = np.asarray(rows)[:k][inv]  # re-expand deduped ids
+        host = self._to_host(rows)[:k][inv]  # re-expand deduped ids
         if out is not None:
             np.copyto(out.reshape(host.shape), host)
             return out

@@ -1,0 +1,65 @@
+"""Subprocess body for the multi-process integration test (tier-2 fixture:
+the reference runs the same binary under ``mpirun -np N`` — here the same
+script runs under N coordinated JAX processes; ref Test/main.cpp:497-518).
+
+Invoked as: python multiprocess_worker.py <coordinator> <nprocs> <pid>
+Prints one line of JSON results that the parent asserts on.
+"""
+
+import json
+import sys
+
+
+def main():
+    coordinator, nprocs, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=nprocs, process_id=pid)
+    import numpy as np
+
+    import multiverso_tpu as mv
+    from multiverso_tpu.sharedvar import mv_shared
+
+    mv.init()
+    out = {"rank": mv.rank(), "size": mv.size(),
+           "num_workers": mv.num_workers(),
+           "num_servers": mv.num_servers(),
+           "devices": len(jax.devices())}
+
+    # barrier (ref TestArray barrier fencing)
+    mv.barrier()
+
+    # aggregate: each process contributes rank+1 -> sum = N(N+1)/2
+    data = np.full(4, float(pid + 1), np.float32)
+    agg = mv.aggregate(data)
+    out["aggregate"] = agg.tolist()
+
+    # KV allreduce with ragged per-process key sets
+    kv = mv.KVTable(name="mp_kv")
+    kv.add(list(range(pid + 1)), [10] * (pid + 1))  # rank r adds r+1 keys
+    merged = kv.allreduce()
+    out["kv"] = {str(k): float(v) for k, v in sorted(merged.items())}
+
+    # collective matrix row add: same ids everywhere, vals summed
+    mt = mv.MatrixTable(16, 4, name="mp_matrix")
+    mt.add_rows([1, 3], np.full((2, 4), float(pid + 1), np.float32))
+    out["matrix_rows"] = mt.get_rows([1, 3]).tolist()
+
+    # sharedvar delta-sync across processes: every worker adds +1 to its
+    # local copy; after sync the shared value reflects all workers' deltas
+    shared = mv_shared({"w": np.zeros(4, np.float32)}, name="mp_shared")
+    local = shared.get()
+    local["w"] = local["w"] + 1.0
+    merged_params = shared.sync(local)
+    mv.barrier()
+    final = shared.get()
+    out["sharedvar"] = final["w"].tolist()
+
+    mv.shutdown()
+    print("RESULT " + json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()
