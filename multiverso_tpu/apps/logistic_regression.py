@@ -68,6 +68,7 @@ class LogRegConfig:
         self.use_ps = g("use_ps", "true").lower() == "true"
         self.fused = g("fused", "false").lower() == "true"
         self.reader_type = g("reader_type", "libsvm")  # libsvm | dense
+        self.mnist_dir = g("mnist_dir", "")  # BASELINE config 1: idx files
         self.train_file = g("train_file", "")
         self.test_file = g("test_file", "")
         self.output_file = g("output_file", "")
@@ -312,12 +313,24 @@ def main(argv=None) -> int:
         return 2
     cfg = LogRegConfig.from_file(argv[0])
     mv.init()
-    lr = LogReg(cfg)
-    stats = lr.train_file()
-    log.info("train done: %s", stats)
-    if cfg.test_file:
-        acc = lr.test_file()
-        log.info("test accuracy: %.4f", acc)
+    if cfg.mnist_dir:
+        from multiverso_tpu.io import mnist
+        if not mnist.available(cfg.mnist_dir):
+            log.fatal("mnist_dir %s has no idx files", cfg.mnist_dir)
+        cfg.input_size, cfg.output_size = 784, 10
+        lr = LogReg(cfg)
+        x, y = mnist.load(cfg.mnist_dir, "train")
+        stats = lr.train_arrays(x, y)
+        log.info("train done: %s", stats)
+        xt, yt = mnist.load(cfg.mnist_dir, "test")
+        log.info("test accuracy: %.4f", lr.test_arrays(xt, yt))
+    else:
+        lr = LogReg(cfg)
+        stats = lr.train_file()
+        log.info("train done: %s", stats)
+        if cfg.test_file:
+            acc = lr.test_file()
+            log.info("test accuracy: %.4f", acc)
     lr.save_model()
     mv.shutdown()
     return 0

@@ -1,0 +1,55 @@
+"""MNIST idx-format loader.
+
+BASELINE config 1 trains LogisticRegression on MNIST; the reference's example
+downloads it (Applications/LogisticRegression/example/run.sh). This
+environment has no egress, so the loader reads pre-downloaded idx files when
+present and callers fall back to synthetic data otherwise.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Optional, Tuple
+
+import numpy as np
+
+_FILES = {
+    "train": ("train-images-idx3-ubyte", "train-labels-idx1-ubyte"),
+    "test": ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"),
+}
+
+
+def _open(path: str):
+    if os.path.exists(path + ".gz"):
+        return gzip.open(path + ".gz", "rb")
+    return open(path, "rb")
+
+
+def _read_idx(path: str) -> np.ndarray:
+    with _open(path) as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return data.reshape(dims)
+
+
+def available(data_dir: str) -> bool:
+    img, lbl = _FILES["train"]
+    return any(os.path.exists(os.path.join(data_dir, img) + ext)
+               for ext in ("", ".gz"))
+
+
+def load(data_dir: str, split: str = "train",
+         flatten: bool = True) -> Tuple[np.ndarray, np.ndarray]:
+    """Returns (images [N, 784] float32 in [0,1], labels [N] int32)."""
+    img_name, lbl_name = _FILES[split]
+    images = _read_idx(os.path.join(data_dir, img_name)).astype(np.float32) / 255.0
+    labels = _read_idx(os.path.join(data_dir, lbl_name)).astype(np.int32)
+    if flatten:
+        images = images.reshape(len(labels), -1)
+    else:
+        images = images[..., None]  # NHWC
+    return images, labels

@@ -1,0 +1,39 @@
+"""Profiler integration.
+
+The reference's tracing story is Monitor/Dashboard timestamps (SURVEY §5);
+on TPU the equivalent deep tool is an XLA trace. This wraps ``jax.profiler``
+with the framework's flag/config conventions so any region can be captured
+and opened in XProf/TensorBoard or Perfetto.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Iterator, Optional
+
+import jax
+
+from multiverso_tpu.utils import config, log
+
+config.define_string("trace_dir", "", "when set, trace() regions write a "
+                     "jax.profiler trace under this directory")
+
+
+@contextmanager
+def trace(name: str = "trace", trace_dir: Optional[str] = None) -> Iterator[None]:
+    """Capture a device+host profile of the enclosed region (no-op when no
+    directory is configured)."""
+    directory = trace_dir or config.get_flag("trace_dir")
+    if not directory:
+        yield
+        return
+    path = f"{directory.rstrip('/')}/{name}"
+    log.info("profiler trace -> %s", path)
+    with jax.profiler.trace(path):
+        yield
+
+
+def annotate(name: str):
+    """Named region inside a trace (ref MONITOR_BEGIN/END analogue at the
+    XLA timeline level)."""
+    return jax.profiler.TraceAnnotation(name)

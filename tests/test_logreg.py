@@ -96,3 +96,31 @@ def test_libsvm_reader_keys(tmp_path):
     (xb, yb, keys), = list(SampleReader(str(f), 8, 4))
     assert xb.shape == (2, 8)
     np.testing.assert_array_equal(keys, [0, 3, 5])
+
+
+def test_mnist_idx_loader(tmp_path):
+    """Write tiny synthetic idx files and read them back (BASELINE config 1
+    data path; real MNIST unavailable in a zero-egress environment)."""
+    import gzip
+    import struct
+
+    from multiverso_tpu.io import mnist
+
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 256, (5, 28, 28), dtype=np.uint8)
+    labels = rng.integers(0, 10, 5, dtype=np.uint8)
+    with open(tmp_path / "train-images-idx3-ubyte", "wb") as f:
+        f.write(struct.pack(">I", 0x00000803))
+        f.write(struct.pack(">III", 5, 28, 28))
+        f.write(images.tobytes())
+    # labels gzipped, to exercise the .gz path
+    with gzip.open(tmp_path / "train-labels-idx1-ubyte.gz", "wb") as f:
+        f.write(struct.pack(">I", 0x00000801))
+        f.write(struct.pack(">I", 5))
+        f.write(labels.tobytes())
+    assert mnist.available(str(tmp_path))
+    x, y = mnist.load(str(tmp_path), "train")
+    assert x.shape == (5, 784) and x.max() <= 1.0
+    np.testing.assert_array_equal(y, labels)
+    x2, _ = mnist.load(str(tmp_path), "train", flatten=False)
+    assert x2.shape == (5, 28, 28, 1)
