@@ -128,6 +128,60 @@ class TestSteps:
         assert (c == 2).sum() == 2
         assert set(x[c == 2]) == {1, 3}
 
+    def test_shared_neg_step_matches_numpy(self):
+        import jax.numpy as jnp
+        rng = np.random.default_rng(0)
+        v_sz, d, b, k = 30, 8, 16, 6
+        win = rng.normal(size=(v_sz, d)).astype(np.float32)
+        wout = rng.normal(size=(v_sz, d)).astype(np.float32) * 0.1
+        c = rng.integers(0, v_sz, b).astype(np.int32)
+        x = rng.integers(0, v_sz, b).astype(np.int32)
+        nid = rng.choice(v_sz, k, replace=False).astype(np.int32)
+        lr, nw = 0.05, 0.5
+
+        def sigmoid(z):
+            return 1.0 / (1.0 + np.exp(-z))
+
+        vv, up, un = win[c], wout[x], wout[nid]
+        pos = (vv * up).sum(-1)
+        negs = vv @ un.T
+        gp = (1.0 - sigmoid(pos)) * lr
+        gn = -sigmoid(negs) * lr * nw
+        exp_win, exp_wout = win.copy(), wout.copy()
+        np.add.at(exp_win, c, gp[:, None] * up + gn @ un)
+        np.add.at(exp_wout, x, gp[:, None] * vv)
+        np.add.at(exp_wout, nid, gn.T @ vv)
+
+        got_win, got_wout, loss = w2v.shared_neg_step(
+            jnp.asarray(win), jnp.asarray(wout), jnp.asarray(c),
+            jnp.asarray(x), jnp.asarray(nid), lr, nw,
+            compute_dtype=jnp.float32)
+        np.testing.assert_allclose(np.asarray(got_win), exp_win, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(got_wout), exp_wout, atol=1e-5)
+        exp_loss = (-np.mean(np.log(sigmoid(pos)))
+                    - nw * np.mean(np.log(sigmoid(-negs)).sum(-1)))
+        assert abs(float(loss) - exp_loss) < 1e-4
+
+    def test_shared_epoch_reduces_loss(self):
+        import jax.numpy as jnp
+        rng = np.random.default_rng(1)
+        v_sz, d, b = 50, 16, 64
+        cfg = w2v.W2VConfig(v_sz, d, negatives=4, shared_negatives=8,
+                            learning_rate=0.1)
+        win, wout = w2v.init_embeddings(cfg, seed=0)
+        # corpus where context == center makes loss trivially reducible
+        cs = rng.integers(0, v_sz, (20, b)).astype(np.int32)
+        epoch_fn = w2v.make_fused_shared_epoch(
+            cfg, np.ones(v_sz), compute_dtype=jnp.float32)
+        win, wout = jnp.asarray(win), jnp.asarray(wout)
+        lcg = jnp.asarray(w2v.init_lcg_state(8, 0))
+        losses = []
+        for _ in range(6):
+            win, wout, loss, lcg = epoch_fn(win, wout, jnp.asarray(cs),
+                                            jnp.asarray(cs), lcg)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
 
 class TestWordEmbeddingApp:
     def _make(self, **kw):
