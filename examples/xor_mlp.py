@@ -1,0 +1,89 @@
+"""XOR MLP, data-parallel through a MatrixTable — the reference's Lua demo.
+
+TPU-era re-make of the reference's ``binding/lua/demos/xor`` workload: a tiny
+2-4-1 MLP learns XOR while every worker pushes gradient deltas to (and pulls
+parameters from) shared tables, exactly the handler surface the Lua/Torch FFI
+binding exposes (ref binding/lua/ArrayTableHandler.lua /
+MatrixTableHandler.lua; demo loop in demos/xor/xor_multiverso.lua). Here the
+handler layer is ``multiverso_tpu.handlers`` and the math is JAX; run one
+process per worker for real data parallelism (multi-controller), or
+single-process for the smoke-test below.
+
+Run: python examples/xor_mlp.py
+"""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")  # repo-root execution
+
+import multiverso_tpu as mv
+from multiverso_tpu.handlers import ArrayTableHandler
+
+X = np.array([[0, 0], [0, 1], [1, 0], [1, 1]], np.float32)
+Y = np.array([[0], [1], [1], [0]], np.float32)
+
+SIZES = [(2, 4), (4,), (4, 1), (1,)]  # w1, b1, w2, b2
+TOTAL = sum(int(np.prod(s)) for s in SIZES)
+
+
+def unflatten(flat):
+    out, i = [], 0
+    for s in SIZES:
+        n = int(np.prod(s))
+        out.append(flat[i: i + n].reshape(s))
+        i += n
+    return out
+
+
+def forward(flat, x):
+    w1, b1, w2, b2 = unflatten(flat)
+    h = jnp.tanh(x @ w1 + b1)
+    return jax.nn.sigmoid(h @ w2 + b2)
+
+
+def loss_fn(flat, x, y):
+    p = forward(flat, x)
+    return -jnp.mean(y * jnp.log(p + 1e-7) + (1 - y) * jnp.log(1 - p + 1e-7))
+
+
+def main():
+    mv.init()
+    rng = np.random.default_rng(mv.worker_id())
+    init = rng.normal(0, 0.5, TOTAL).astype(np.float32)
+    # master-init convention (ref tables.py:50-57): worker 0 pushes the
+    # initial weights, the rest push zeros
+    params = ArrayTableHandler(TOTAL, init_value=init, name="xor_params")
+
+    lr, sync_frequency, rounds = 0.5, 50, 20
+    x, y = jnp.asarray(X), jnp.asarray(Y)
+
+    @jax.jit
+    def local_rounds(flat):
+        """sync_frequency local GD steps between table syncs (the LR app's
+        bounded-staleness pattern, apps/logistic_regression.py)."""
+        def body(_, f):
+            return f - lr * jax.grad(loss_fn)(f, x, y)
+        return jax.lax.fori_loop(0, sync_frequency, body, flat)
+
+    for r in range(rounds):
+        flat = jnp.asarray(params.get())
+        new = local_rounds(flat)
+        # push the *delta*; the server-side default updater adds it
+        params.add(np.asarray(new - flat))
+        if r % 5 == 0:
+            print(f"round {r:3d} loss {float(loss_fn(new, x, y)):.4f}")
+    flat = jnp.asarray(params.get())
+    pred = np.asarray(forward(flat, x)).round().astype(int).ravel()
+    print("prediction:", pred.tolist(), "target:", Y.ravel().astype(int).tolist())
+    ok = (pred == Y.ravel()).all()
+    print("XOR", "SOLVED" if ok else "NOT SOLVED")
+    mv.shutdown()
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
