@@ -61,12 +61,41 @@ def bench_array_table(size: int = 1_000_000, iters: int = 10):
         t0 = time.perf_counter()
         t.get()
         gets.append(time.perf_counter() - t0)
+    # device plane: delta already resident (the real TPU deployment shape —
+    # grads are produced on device; host numbers above are tunnel-bound)
+    import jax
+
+    import jax.numpy as jnp
+
+    delta_dev = jax.device_put(np.asarray(t.pad_delta(delta)), t.sharding)
+    chain = 100
+
+    # chain the adds inside one program: per-dispatch tunnel round-trips
+    # (~10s of ms here) would otherwise swamp the ~us-scale device op
+    @jax.jit
+    def fadd_chain(state, d):
+        return jax.lax.scan(
+            lambda s, _: (t.functional_add(s, d, opt), None),
+            state, None, length=chain)[0]
+
+    state = fadd_chain(t.state, delta_dev)  # compile
+    jax.block_until_ready(state["data"])
+    dev_adds = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        state = fadd_chain(state, delta_dev)
+        jax.block_until_ready(state["data"])
+        dev_adds.append((time.perf_counter() - t0) / chain)
+    t.adopt(state)
+
     nbytes = size * 4
     return {
         "add_p50_ms": _percentile_ms(adds),
         "get_p50_ms": _percentile_ms(gets),
         "add_gbps": nbytes / np.percentile(adds, 50) / 1e9,
         "get_gbps": nbytes / np.percentile(gets, 50) / 1e9,
+        "device_add_p50_ms": _percentile_ms(dev_adds),
+        "device_add_gbps": nbytes / np.percentile(dev_adds, 50) / 1e9,
         "size_mb": nbytes / 1e6,
     }
 

@@ -169,8 +169,10 @@ def parse_config_file(path: str) -> Dict[str, str]:
 
 
 # ---------------------------------------------------------------------------
-# Core framework flags (inventory mirrors the reference's MV_DEFINE_* set;
-# transport/allocator flags are dropped: XLA owns memory and ICI owns the wire).
+# Core framework flags (inventory mirrors the reference's MV_DEFINE_* set).
+# Flags whose mechanism has no TPU meaning (OpenMP thread pools, host
+# allocator tuning, ZMQ membership files) are still ACCEPTED so reference
+# command lines parse unchanged, and documented as no-ops here.
 # ---------------------------------------------------------------------------
 define_string("ps_role", "default", "role of this process: none|worker|server|default")
 define_bool("ma", False, "model-average (allreduce) mode: no parameter tables")
@@ -186,3 +188,13 @@ define_string("mesh_axis", "mv", "name of the table-sharding mesh axis")
 define_string("log_level", "info", "debug|info|error|fatal")
 define_string("log_file", "", "optional log file path ('' = stdout only)")
 define_bool("dashboard", True, "collect Monitor timings and display at shutdown")
+# Reference CLI-parity no-ops (mechanism owned by XLA / the JAX runtime):
+define_int("omp_threads", 4, "no-op: shard updates are VPU-parallel under XLA "
+           "(reference OpenMP server loop)")
+define_string("allocator_type", "smart", "no-op: device memory is XLA's BFC "
+              "arena (reference SmartAllocator)")
+define_int("allocator_alignment", 16, "no-op: XLA controls buffer alignment")
+define_string("machine_file", "", "no-op: pod topology comes from the JAX "
+              "runtime (reference ZMQ membership file)")
+define_int("port", 55555, "no-op: see machine_file; DCN endpoints come from "
+           "net_init(coordinator_address)")
