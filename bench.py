@@ -65,9 +65,7 @@ def bench_array_table(size: int = 1_000_000, iters: int = 10):
     # grads are produced on device; host numbers above are tunnel-bound)
     import jax
 
-    import jax.numpy as jnp
-
-    delta_dev = jax.device_put(np.asarray(t.pad_delta(delta)), t.sharding)
+    delta_dev = jax.device_put(t.pad_delta(delta), t.sharding)
     chain = 100
 
     # chain the adds inside one program: per-dispatch tunnel round-trips

@@ -342,8 +342,7 @@ class WordEmbedding:
             }
 
     def _read_pull(self, table, msg_id):
-        _, rows, k, inv = table.wait(msg_id)
-        return jnp.asarray(table._to_host(rows)[:k][inv])
+        return jnp.asarray(table.wait(msg_id))
 
     def _train_prepared(self, prep: Dict, num_workers: int) -> float:
         cfg = self.cfg

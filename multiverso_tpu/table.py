@@ -141,22 +141,29 @@ class Table:
     # ------------------------------------------------------------------ #
     # msg-id / Waiter bookkeeping (ref src/table.cpp:27-97)
     # ------------------------------------------------------------------ #
-    def _track(self, arrays: Any) -> int:
+    def _track(self, arrays: Any, finalize=None) -> int:
         with self._lock:
             msg_id = self._next_msg_id
             self._next_msg_id += 1
-            self._pending[msg_id] = arrays
+            self._pending[msg_id] = (arrays, finalize)
             return msg_id
 
     def wait(self, msg_id: int) -> Any:
-        """Block until the op behind ``msg_id`` is complete; return its result."""
+        """Block until the op behind ``msg_id`` is complete; return its result.
+
+        For get-style ops the result is the materialized host array (the ref's
+        Wait(GetAsync) leaves the data in the user buffer, src/table.cpp:27-97);
+        for adds it is the completion token.
+        """
         with self._lock:
-            arrays = self._pending.pop(msg_id, None)
-        if arrays is None:
+            entry = self._pending.pop(msg_id, None)
+        if entry is None:
             return None
-        return jax.tree.map(
+        arrays, finalize = entry
+        arrays = jax.tree.map(
             lambda a: a.block_until_ready() if isinstance(a, jax.Array) else a,
             arrays)
+        return finalize(arrays) if finalize is not None else arrays
 
     # ------------------------------------------------------------------ #
     # functional plane (in-graph use)
@@ -283,7 +290,8 @@ class Table:
                 snap.copy_to_host_async()
             except AttributeError:
                 pass
-            return self._track(("get", snap))
+            return self._track(
+                snap, lambda s: self._to_host(s)[: self.shape[0]])
 
     def get(self, out: Optional[np.ndarray] = None) -> np.ndarray:
         """ref WorkerTable::Get — blocking pull of the whole logical table."""
@@ -292,11 +300,14 @@ class Table:
 
     def read(self, msg_id: int, out: Optional[np.ndarray] = None) -> np.ndarray:
         """Materialize the result of a previous :meth:`get_async`."""
-        res = self.wait(msg_id)
-        if res is None:
+        with self._lock:
+            entry = self._pending.get(msg_id)
+        if entry is not None and entry[1] is None:
+            raise TypeError(
+                f"msg_id {msg_id} is an add, not a get; use wait()")
+        host = self.wait(msg_id)
+        if host is None:
             raise KeyError(f"msg_id {msg_id} unknown or already consumed")
-        _, data = res
-        host = self._to_host(data)[: self.shape[0]]
         if out is not None:
             np.copyto(out.reshape(self.shape), host)
             return out

@@ -220,13 +220,11 @@ class MatrixTable(Table):
                 rows.copy_to_host_async()
             except AttributeError:
                 pass
-            return self._track(("get_rows", rows, k, inv))
+            return self._track(
+                rows, lambda r: self._to_host(r)[:k][inv])  # re-expand dedup
 
     def get_rows(self, row_ids, out: Optional[np.ndarray] = None) -> np.ndarray:
-        msg_id = self.get_rows_async(row_ids)
-        res = self.wait(msg_id)
-        _, rows, k, inv = res
-        host = self._to_host(rows)[:k][inv]  # re-expand deduped ids
+        host = self.wait(self.get_rows_async(row_ids))
         if out is not None:
             np.copyto(out.reshape(host.shape), host)
             return out
