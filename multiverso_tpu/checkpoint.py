@@ -51,15 +51,27 @@ def save(directory: str, tag: str = "checkpoint") -> str:
     zoo = Zoo.get()
     path = _join(directory, tag)
     manifest = {"tables": {}, "version": 1}
-    if zoo.rank() == 0:
-        for table_id, table in zoo.tables().items():
-            if not hasattr(table, "store"):
-                continue
-            fname = f"{table.name}.{table_id}.mvt"
+
+    class _DevNull:
+        """Discarding sink: non-zero ranks still run store() because the
+        sharded-state fetch inside it is a collective, but nothing is
+        buffered or written."""
+
+        def write(self, b):
+            return len(b)
+
+    for table_id, table in zoo.tables().items():
+        if not hasattr(table, "store"):
+            continue
+        fname = f"{table.name}.{table_id}.mvt"
+        if zoo.rank() == 0:
             with open_stream(_join(path, fname), "wb") as s:
                 table.store(s)
-            manifest["tables"][str(table_id)] = dict(
-                _manifest_entry(table), file=fname)
+        else:
+            table.store(_DevNull())
+        manifest["tables"][str(table_id)] = dict(
+            _manifest_entry(table), file=fname)
+    if zoo.rank() == 0:
         # manifest rides the same URI-dispatched stream layer as the table
         # payloads, so gs:// checkpoints stay in one storage system
         with open_stream(_join(path, "manifest.json"), "wb") as s:

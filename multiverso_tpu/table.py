@@ -317,12 +317,14 @@ class Table:
     # checkpoint (ref ServerTable Store/Load, table_interface.h:61-75)
     # ------------------------------------------------------------------ #
     def store(self, stream) -> None:
-        """Write raw table + updater state (ref array_table.cpp:143-151)."""
-        np.save(stream, np.asarray(self._data), allow_pickle=False)
+        """Write raw table + updater state (ref array_table.cpp:143-151).
+        Multi-controller: fetching sharded state is a collective, so every
+        process must call this together (checkpoint.save does)."""
+        np.save(stream, self._to_host(self._data), allow_pickle=False)
         flat, _ = jax.tree.flatten(self._ustate)
         np.save(stream, np.asarray(len(flat)), allow_pickle=False)
         for leaf in flat:
-            np.save(stream, np.asarray(leaf), allow_pickle=False)
+            np.save(stream, self._to_host(leaf), allow_pickle=False)
 
     def load(self, stream) -> None:
         data = np.load(stream)

@@ -138,7 +138,7 @@ class SparseMatrixTable(MatrixTable):
             dev_ids = jax.device_put(uids, self._replicated)
             self._dirty, mask = self._take_stale_fn(uids.size)(
                 self._dirty, dev_ids, worker_id)
-            mask_host = np.asarray(mask)[:k]
+            mask_host = self._to_host(mask)[:k]
             stale = uids[:k][mask_host]
             if stale.size:
                 rows = super().get_rows(stale)
@@ -148,9 +148,18 @@ class SparseMatrixTable(MatrixTable):
     def stale_fraction(self, row_ids, worker_id: int = 0) -> float:
         """Diagnostic: fraction of the requested rows that would transfer."""
         self._worker_cache(worker_id)  # validates worker_id
-        ids = np.unique(np.asarray(row_ids, dtype=np.int64).reshape(-1))
-        mask = np.asarray(self._dirty[worker_id])[ids]
-        return float(mask.mean()) if ids.size else 0.0
+        if np.asarray(row_ids).size == 0:
+            return 0.0
+        uids, _, k, _ = self._prep_ids(row_ids)
+        key = ("stale_frac", uids.size)
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            fn = self._jit_cache[key] = jax.jit(
+                lambda dirty, ids, wid: dirty[wid, ids])
+        mask = self._to_host(fn(self._dirty,
+                                jax.device_put(uids, self._replicated),
+                                worker_id))[:k]
+        return float(mask.mean()) if k else 0.0
 
 
 class SparseMatrixTableOption:
