@@ -313,6 +313,11 @@ def _spawn_cluster(cmd: str, nprocs: int, extra: List[str]) -> int:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    # A site hook may have force-registered an accelerator plugin; restore
+    # the JAX_PLATFORMS/XLA_FLAGS intent (the battery is meant to run on the
+    # virtual CPU mesh unless explicitly pointed at hardware).
+    from multiverso_tpu.utils.platform import apply_platform_env
+    apply_platform_env()
     argv = list(sys.argv[1:] if argv is None else argv)
     cmds = [a for a in argv if not a.startswith("-")]
     flags = [a for a in argv if a.startswith("-")]

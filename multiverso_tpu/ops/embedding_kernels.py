@@ -62,7 +62,7 @@ def embedding_gather(table: jax.Array, ids: jax.Array,
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(b // _GROUP,),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec((_GROUP, d), lambda i, ids: (i, 0)),
         scratch_shapes=[pltpu.SemaphoreType.DMA((_GROUP,))],
     )
@@ -121,10 +121,10 @@ def embedding_scatter_add(table: jax.Array, ids: jax.Array,
         num_scalar_prefetch=1,
         grid=(b // _GROUP,),
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.ANY),                 # table
+            pl.BlockSpec(memory_space=pl.ANY),                 # table
             pl.BlockSpec((_GROUP, d), lambda i, ids: (i, 0)),     # deltas
         ],
-        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),           # table out
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),           # table out
         scratch_shapes=[
             pltpu.VMEM((_GROUP, d), table.dtype),
             pltpu.SemaphoreType.DMA((_GROUP,)),

@@ -1,0 +1,33 @@
+"""Make JAX platform env vars effective under a pre-registered plugin.
+
+In some deployments a site hook imports jax at interpreter startup and
+force-registers an accelerator plugin, which wins over ``JAX_PLATFORMS`` /
+``XLA_FLAGS`` environment variables.  ``jax.config`` updates still take
+effect as long as no backend has been initialized, so subprocess entry
+points (the tier-2 battery, spawned cluster processes) call this first to
+restore the env vars' intent.  No-op when the env vars are unset — a bench
+run on real TPU hardware is untouched.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def apply_platform_env() -> None:
+    """Re-apply JAX_PLATFORMS / host-device-count env intent via jax.config."""
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if not platforms:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", platforms)
+        if platforms.split(",")[0] == "cpu":
+            m = re.search(r"xla_force_host_platform_device_count=(\d+)",
+                          os.environ.get("XLA_FLAGS", ""))
+            if m:
+                jax.config.update("jax_num_cpu_devices", int(m.group(1)))
+    except (RuntimeError, AttributeError):
+        pass  # backend already live; keep whatever it is

@@ -12,8 +12,14 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def _run(args, timeout=600):
     env = dict(os.environ)
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env.setdefault("JAX_PLATFORMS", "cpu")
-    env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    # Unconditional (not setdefault): the ambient environment may point JAX
+    # at real hardware, but the tier-2 battery is defined to run on the
+    # virtual CPU mesh (SURVEY §4's "mpirun on one host" analogue).
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+    env["XLA_FLAGS"] = flags
     return subprocess.run(
         [sys.executable, "-m", "multiverso_tpu.harness", *args],
         capture_output=True, text=True, timeout=timeout, env=env)

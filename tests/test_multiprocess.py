@@ -20,7 +20,6 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.timeout(180)
 def test_two_process_cluster():
     nprocs = 2
     coordinator = f"127.0.0.1:{_free_port()}"
