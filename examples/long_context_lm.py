@@ -1,0 +1,73 @@
+"""Long-context LM training with ring attention over a (dp, sp) mesh.
+
+The capability demo the reference never had (it predates transformers —
+SURVEY §5 "long-context: absent"): a decoder-only LM whose sequence axis is
+context-parallel over the mesh, so per-chip attention memory is
+O((S/n_chips)^2) and sequence length scales with chips. Batch rides the dp
+axis; K/V blocks rotate over the sp axis via ``ppermute`` (ICI ring).
+
+Run: python examples/long_context_lm.py   (8 virtual CPU devices stand in
+for 8 chips; the same code runs unchanged on a TPU pod slice.)
+"""
+
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")  # repo-root execution
+
+import jax
+
+if "--tpu" not in sys.argv:
+    # default to the 8-virtual-device CPU mesh (checking the live backend
+    # would *initialize* it, claiming the real chip just to ask its name)
+    from multiverso_tpu.utils.platform import force_cpu_mesh
+    force_cpu_mesh(8)
+
+from jax.sharding import Mesh
+
+import multiverso_tpu as mv
+from multiverso_tpu.models import transformer as tf
+
+SEQ, BATCH, STEPS = 256, 4, 40
+
+
+def synthetic_text(n, seed=0):
+    """A noisy periodic token stream — learnable but not trivial."""
+    rng = np.random.default_rng(seed)
+    base = np.tile(np.arange(16, dtype=np.int32), n // 16 + 1)[:n]
+    noise = rng.integers(0, 16, n).astype(np.int32)
+    keep = rng.random(n) < 0.9
+    return np.where(keep, base, noise)
+
+
+def main():
+    devices = np.asarray(jax.devices())
+    dp = 2 if devices.size % 2 == 0 and devices.size > 1 else 1
+    mesh = Mesh(devices.reshape(dp, devices.size // dp), ("dp", "sp"))
+    mv.init(mesh=mesh)
+
+    cfg = tf.TransformerConfig(vocab_size=16, dim=64, num_heads=4,
+                               num_layers=2, max_seq=SEQ, attn="ring",
+                               seq_axis="sp", batch_axis="dp")
+    params = tf.init_params(cfg, seed=0)
+
+    stream = synthetic_text(BATCH * (SEQ + 1))
+    chunks = stream[: BATCH * (SEQ + 1)].reshape(BATCH, SEQ + 1)
+    tokens = tf.shard_batch(chunks[:, :-1], cfg, mesh)
+    targets = tf.shard_batch(chunks[:, 1:], cfg, mesh)
+
+    step = jax.jit(tf.make_train_step(cfg, learning_rate=0.3))
+    for i in range(STEPS):
+        params, loss = step(params, tokens, targets)
+        if i % 10 == 0 or i == STEPS - 1:
+            print(f"step {i:3d}  loss {float(loss):.4f}")
+
+    assert float(loss) < 1.0, "LM failed to learn the periodic stream"
+    print(f"long-context LM ok: seq={SEQ} over {mesh.shape['sp']} "
+          f"sequence shards x {mesh.shape['dp']} data shards")
+    mv.shutdown()
+
+
+if __name__ == "__main__":
+    main()

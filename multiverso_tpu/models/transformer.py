@@ -1,0 +1,150 @@
+"""Decoder-only transformer LM with context-parallel (long-context) training.
+
+The reference framework predates transformers (SURVEY §5: long-context
+absent), but long context is first-class here: this model family trains with
+**ring attention** or **Ulysses all-to-all** sequence parallelism
+(parallel/ring.py) over a ``(dp, sp)`` mesh — batch data-parallel on ``dp``,
+sequence context-parallel on ``sp`` — so sequence length scales with the
+number of chips. Everything is a pure function designed for one jitted SPMD
+step: params replicated (psum'd grads on dp = the BSP merge the reference's
+SyncServer provided, ref src/server.cpp:68-222), activations sharded
+``P(dp, sp)``, attention collectives riding ICI.
+
+TPU notes: matmuls are einsum-batched for the MXU; ``cfg.dtype=bfloat16``
+keeps activations in bf16 while the loss/softmax runs in f32; no
+data-dependent Python control flow — the layer stack is a ``lax.scan`` over
+stacked per-layer params so XLA compiles ONE layer body regardless of depth.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from multiverso_tpu.parallel import ring
+
+
+class TransformerConfig(NamedTuple):
+    vocab_size: int = 256
+    dim: int = 128
+    num_heads: int = 4
+    num_layers: int = 2
+    max_seq: int = 512
+    mlp_ratio: int = 4
+    dtype: Any = jnp.float32
+    attn: str = "ring"          # "ring" | "ulysses" | "local"
+    seq_axis: Optional[str] = None   # mesh axis for sequence parallelism
+    batch_axis: Optional[str] = None  # mesh axis for data parallelism
+
+
+def init_params(cfg: TransformerConfig, seed: int = 0) -> Dict[str, Any]:
+    """Stacked-per-layer parameter pytree (leading dim = layer, for scan)."""
+    rng = np.random.default_rng(seed)
+    d, h, L = cfg.dim, cfg.num_heads, cfg.num_layers
+    m = cfg.mlp_ratio * d
+
+    def norm(*shape, scale):
+        return jnp.asarray(rng.normal(0, scale, shape), cfg.dtype)
+
+    s = 1.0 / np.sqrt(d)
+    return {
+        "embed": norm(cfg.vocab_size, d, scale=0.02),
+        "pos": norm(cfg.max_seq, d, scale=0.02),
+        "layers": {
+            "wqkv": norm(L, d, 3 * d, scale=s),
+            "wo": norm(L, d, d, scale=s / np.sqrt(2 * L)),
+            "w1": norm(L, d, m, scale=s),
+            "w2": norm(L, m, d, scale=np.sqrt(1.0 / m) / np.sqrt(2 * L)),
+            "ln1": jnp.ones((L, d), cfg.dtype),
+            "ln2": jnp.ones((L, d), cfg.dtype),
+        },
+        "ln_f": jnp.ones((d,), cfg.dtype),
+    }
+
+
+def _rmsnorm(x, g):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + 1e-6).astype(x.dtype)) * g
+
+
+def _attention(cfg: TransformerConfig, q, k, v):
+    if cfg.attn == "local":
+        return ring.reference_attention(q, k, v, causal=True)
+    fn = ring.ring_attention if cfg.attn == "ring" else ring.ulysses_attention
+    return fn(q, k, v, axis_name=cfg.seq_axis, causal=True,
+              batch_axis=cfg.batch_axis)
+
+
+def forward(params: Dict[str, Any], tokens: jax.Array,
+            cfg: TransformerConfig) -> jax.Array:
+    """tokens [B, S] -> logits [B, S, V]. Written at the global-logical
+    level; the attention call shard_maps over the sequence axis."""
+    b, s = tokens.shape
+    h, d = cfg.num_heads, cfg.dim
+    hd = d // h
+    x = params["embed"][tokens] + params["pos"][:s][None]
+
+    def layer(x, p):
+        y = _rmsnorm(x, p["ln1"])
+        qkv = jnp.einsum("bsd,de->bse", y, p["wqkv"])
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        # [B, S, D] -> [B, H, S, hd]
+        split = lambda t: t.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
+        o = _attention(cfg, split(q), split(k), split(v))
+        o = o.transpose(0, 2, 1, 3).reshape(b, s, d)
+        x = x + jnp.einsum("bsd,de->bse", o, p["wo"])
+        y = _rmsnorm(x, p["ln2"])
+        y = jax.nn.gelu(jnp.einsum("bsd,dm->bsm", y, p["w1"]))
+        return x + jnp.einsum("bsm,md->bsd", y, p["w2"]), None
+
+    x, _ = jax.lax.scan(layer, x, params["layers"])
+    x = _rmsnorm(x, params["ln_f"])
+    return jnp.einsum("bsd,vd->bsv", x, params["embed"])
+
+
+def loss_fn(params, tokens, targets, cfg: TransformerConfig,
+            mask: Optional[jax.Array] = None) -> jax.Array:
+    """Mean next-token cross-entropy (f32). ``targets`` is tokens shifted by
+    one on the host, so sequence shards never need a halo exchange; ``mask``
+    zeroes padding/terminal positions."""
+    logits = forward(params, tokens, cfg).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, -1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
+    if mask is not None:
+        return (nll * mask).sum() / jnp.maximum(mask.sum(), 1)
+    return nll.mean()
+
+
+def make_train_step(cfg: TransformerConfig, learning_rate: float = 1e-2):
+    """Plain-SGD jittable step (params, tokens, targets) -> (params, loss).
+
+    For the parameter-server training mode, keep params in a table instead:
+    compute ``grads`` with ``jax.grad(loss_fn)`` and push ``-lr * grads``
+    through ``sharedvar.SharedPytree.sync`` (the delta-sync ASGD surface) or
+    ``Table.functional_add`` inside your own step.
+    """
+
+    def step(params, tokens, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets,
+                                                  cfg)
+        params = jax.tree.map(
+            lambda p, g: p - jnp.asarray(learning_rate, p.dtype) * g,
+            params, grads)
+        return params, loss
+
+    return step
+
+
+def shard_batch(tokens: np.ndarray, cfg: TransformerConfig,
+                mesh=None) -> jax.Array:
+    """device_put a [B, S] token batch sharded P(batch_axis, seq_axis)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from multiverso_tpu.zoo import Zoo
+    mesh = mesh or Zoo.get().mesh()
+    spec = P(cfg.batch_axis, cfg.seq_axis)
+    return jax.device_put(jnp.asarray(tokens), NamedSharding(mesh, spec))

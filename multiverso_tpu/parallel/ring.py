@@ -44,17 +44,34 @@ def sequence_shard(x, axis_name: Optional[str] = None, seq_dim: int = 2):
     return jax.device_put(jnp.asarray(x), NamedSharding(mesh, P(*spec)))
 
 
-def _ring_attention_local(q, k, v, axis_name: str, scale: float):
+def _ring_attention_local(q, k, v, axis_name: str, scale: float,
+                          causal: bool = False):
     """Per-shard body: local q [B,H,Sq,D] against rotating k/v blocks."""
     n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
     b, h, sq, d = q.shape
+    sk = k.shape[2]
     neg_inf = jnp.asarray(-1e30, q.dtype)
+    # global token positions of this shard's queries
+    qpos = idx * sq + jnp.arange(sq)
 
-    def body(carry, _):
+    def body(carry, t):
         k_blk, v_blk, m, l, o = carry
         s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk) * scale
+        if causal:
+            # after t rotations the visiting k/v block is block (idx - t) % n
+            j = (idx - t) % n
+            kpos = j * sk + jnp.arange(sk)
+            allowed = qpos[:, None] >= kpos[None, :]
+        else:
+            allowed = None
+        if allowed is not None:
+            s = jnp.where(allowed[None, None], s, neg_inf)
         m_new = jnp.maximum(m, s.max(axis=-1))
         p = jnp.exp(s - m_new[..., None])
+        if allowed is not None:
+            # fully-masked rows would otherwise get exp(neg_inf-neg_inf)=1
+            p = jnp.where(allowed[None, None], p, 0.0)
         corr = jnp.exp(m - m_new)
         l = l * corr + p.sum(axis=-1)
         o = o * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v_blk)
@@ -66,23 +83,36 @@ def _ring_attention_local(q, k, v, axis_name: str, scale: float):
     m0 = jnp.full((b, h, sq), neg_inf, q.dtype)
     l0 = jnp.zeros((b, h, sq), q.dtype)
     o0 = jnp.zeros_like(q)
-    (_, _, _, l, o), _ = jax.lax.scan(body, (k, v, m0, l0, o0), None,
-                                      length=n)
+    (_, _, _, l, o), _ = jax.lax.scan(body, (k, v, m0, l0, o0),
+                                      jnp.arange(n))
     return o / l[..., None]
 
 
 def ring_attention(q, k, v, axis_name: Optional[str] = None,
                    mesh: Optional[Mesh] = None,
-                   precision: Optional[str] = None):
-    """Full (non-causal) ring attention over sequence-sharded [B, H, S, D]
-    arrays. Returns the sequence-sharded output."""
+                   precision: Optional[str] = None,
+                   causal: bool = False,
+                   batch_axis: Optional[str] = None):
+    """Ring attention over sequence-sharded [B, H, S, D] arrays; causal
+    masking uses global block positions so the online softmax sees exactly
+    the lower-triangular scores. ``batch_axis`` additionally shards B (the
+    dp x sp layout of the transformer model family). Returns the
+    sequence-sharded output.
+
+    Causal note: with contiguous block assignment shard i only has useful
+    work on i+1 of its n ring steps (the rest are fully masked), so ~half
+    the attention FLOPs are masked out and the ring is load-imbalanced;
+    acceptable at the current scale since the masked einsums still overlap
+    the ppermute. A striped/zigzag block assignment is the known fix if
+    causal ring becomes the bottleneck."""
     zoo = Zoo.get()
     mesh = mesh or zoo.mesh()
     ax = axis_name or zoo.shard_axis()
     scale = 1.0 / (q.shape[-1] ** 0.5)
-    spec = P(None, None, ax, None)
+    spec = P(batch_axis, None, ax, None)
 
-    fn = partial(_ring_attention_local, axis_name=ax, scale=scale)
+    fn = partial(_ring_attention_local, axis_name=ax, scale=scale,
+                 causal=causal)
     mapped = jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                            out_specs=spec, check_vma=False)
     if precision is not None:
@@ -92,7 +122,9 @@ def ring_attention(q, k, v, axis_name: Optional[str] = None,
 
 
 def ulysses_attention(q, k, v, axis_name: Optional[str] = None,
-                      mesh: Optional[Mesh] = None):
+                      mesh: Optional[Mesh] = None,
+                      causal: bool = False,
+                      batch_axis: Optional[str] = None):
     """All-to-all sequence parallelism: resharding sequence->heads, local
     full attention, heads->sequence. Head count must be divisible by the
     shard count."""
@@ -103,7 +135,7 @@ def ulysses_attention(q, k, v, axis_name: Optional[str] = None,
     if q.shape[1] % n:
         raise ValueError(f"heads {q.shape[1]} not divisible by shards {n}")
     scale = 1.0 / (q.shape[-1] ** 0.5)
-    spec = P(None, None, ax, None)
+    spec = P(batch_axis, None, ax, None)
 
     def local(q, k, v):
         # [B, H, S/n, D] -> all_to_all -> [B, H/n, S, D]
@@ -117,6 +149,10 @@ def ulysses_attention(q, k, v, axis_name: Optional[str] = None,
 
         qh, kh, vh = seq2head(q), seq2head(k), seq2head(v)
         s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+        if causal:
+            sfull = qh.shape[2]
+            mask = jnp.tril(jnp.ones((sfull, sfull), bool))
+            s = jnp.where(mask[None, None], s, jnp.asarray(-1e30, s.dtype))
         p = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
         return head2seq(o)
@@ -125,8 +161,12 @@ def ulysses_attention(q, k, v, axis_name: Optional[str] = None,
                          out_specs=spec)(q, k, v)
 
 
-def reference_attention(q, k, v):
+def reference_attention(q, k, v, causal: bool = False):
     """Unsharded softmax attention (test oracle)."""
     scale = 1.0 / (q.shape[-1] ** 0.5)
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        sq, sk = s.shape[-2:]
+        mask = jnp.tril(jnp.ones((sq, sk), bool))
+        s = jnp.where(mask[None, None], s, jnp.asarray(-1e30, s.dtype))
     return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)

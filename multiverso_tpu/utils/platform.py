@@ -15,6 +15,20 @@ import os
 import re
 
 
+def force_cpu_mesh(n_devices: int = 8) -> None:
+    """Point JAX at an n-device virtual CPU mesh (the test/dryrun fixture:
+    SURVEY §4's "mpirun -np N on one host" analogue). Best-effort no-op if a
+    backend is already live."""
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", n_devices)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    except (RuntimeError, AttributeError):
+        pass
+
+
 def apply_platform_env() -> None:
     """Re-apply JAX_PLATFORMS / host-device-count env intent via jax.config."""
     platforms = os.environ.get("JAX_PLATFORMS")

@@ -7,21 +7,13 @@ in for 8 chips, so every sharding/collective path compiles and runs exactly as
 it would on a pod slice.
 """
 
-import os
+# The TPU plugin may already be registered by a site hook that imported jax
+# at interpreter startup, so plain env vars are too late — force_cpu_mesh
+# uses jax.config, which takes effect as long as no backend has been
+# initialized yet.
+from multiverso_tpu.utils.platform import force_cpu_mesh
 
-# The TPU plugin may already be registered by a site hook that imported jax at
-# interpreter startup, so plain env vars are too late — use jax.config, which
-# takes effect as long as no backend has been initialized yet.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+force_cpu_mesh(8)
 
 import pytest  # noqa: E402
 
