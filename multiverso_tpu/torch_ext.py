@@ -1,0 +1,109 @@
+"""PyTorch binding: delta-sync data parallelism for ``torch.nn.Module``s.
+
+The reference shipped framework bindings as its user surface — Theano
+``mv_shared``/``mv_sync`` (ref binding/python/multiverso/theano_ext/
+sharedvar.py:38-50) and Lasagne's ``MVNetParamManager`` which flattens every
+network parameter into one ArrayTable (ref theano_ext/lasagne_ext/
+param_manager.py:9-64), plus a Lua/Torch FFI mirror (ref binding/lua/).
+Torch-the-framework outlived both hosts, so the modern equivalent binds
+PyTorch: ``TorchParamManager`` flattens a module's parameters into one
+sharded ArrayTable and ``sync()`` runs the same Add(current − last) → Get
+delta-sync ASGD recipe, writing the merged state back into the module
+in-place. The table lives on the TPU mesh; torch stays on CPU and only the
+flat float32 vector crosses the boundary per sync (the reference moved the
+same vector over MPI).
+
+Usage::
+
+    manager = TorchParamManager(model)          # master-init convention
+    for batch in loader:
+        loss.backward(); opt.step()
+        if step % sync_frequency == 0:
+            manager.sync()                      # ASGD merge across workers
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Tuple
+
+import numpy as np
+
+import multiverso_tpu as mv
+
+
+def _require_torch():
+    try:
+        import torch
+    except ImportError as e:  # pragma: no cover - torch is in the image
+        raise ImportError(
+            "torch_ext needs pytorch; `pip install torch` or use "
+            "multiverso_tpu.sharedvar for JAX pytrees") from e
+    return torch
+
+
+class TorchParamManager:
+    """``MVNetParamManager`` for PyTorch modules (ref param_manager.py:9-64).
+
+    Flattens ``module.parameters()`` into one float32 ArrayTable sharded
+    over the mesh. Worker 0 seeds the table with its initial values, other
+    workers add zeros, so after the constructor's barrier every worker
+    holds worker 0's init (ref param_manager.py:24-31 master-init).
+    """
+
+    def __init__(self, module, name: str = "torch_params"):
+        torch = _require_torch()
+        self._torch = torch
+        self._module = module
+        self._shapes: List[Tuple[int, ...]] = [
+            tuple(p.shape) for p in module.parameters()]
+        self._sizes = [int(np.prod(s)) if s else 1 for s in self._shapes]
+        # paramless modules still get a 1-slot table so the Add/Get/barrier
+        # protocol below stays collective-uniform across workers
+        self._width = max(sum(self._sizes), 1)
+        self.table = mv.ArrayTable(self._width, dtype=np.float32, name=name)
+        flat = self._flatten()
+        if mv.is_master_worker():
+            self.table.add(flat)
+        else:
+            self.table.add(np.zeros_like(flat))
+        mv.barrier()
+        self._last = self.table.get().copy()
+        self._write_back(self._last)
+
+    def _flatten(self) -> np.ndarray:
+        """Module params as one float32 vector, padded to the table width."""
+        ps = [p.detach().cpu().numpy().astype(np.float32).reshape(-1)
+              for p in self._module.parameters()]
+        flat = np.concatenate(ps) if ps else np.zeros(0, np.float32)
+        out = np.zeros(self._width, np.float32)
+        out[: flat.size] = flat
+        return out
+
+    def _write_back(self, flat: np.ndarray) -> None:
+        torch = self._torch
+        with torch.no_grad():
+            off = 0
+            for p, shape, size in zip(self._module.parameters(),
+                                      self._shapes, self._sizes):
+                chunk = flat[off: off + size].reshape(shape)
+                p.copy_(torch.from_numpy(np.ascontiguousarray(chunk))
+                        .to(p.dtype))
+                off += size
+
+    def sync(self) -> None:
+        """Add(current − last) then Get, in-place into the module
+        (ref sharedvar.py mv_sync :38-50 semantics)."""
+        current = self._flatten()
+        self.table.add(current - self._last)
+        merged = self.table.get()
+        self._last = merged.copy()
+        self._write_back(merged)
+
+    def pull(self) -> None:
+        """Get without pushing (refresh from the global state)."""
+        merged = self.table.get()
+        self._last = merged.copy()
+        self._write_back(merged)
+
+    def numel(self) -> int:
+        return int(sum(self._sizes))
