@@ -3,9 +3,14 @@ from multiverso_tpu.parallel.collectives import (
 from multiverso_tpu.parallel.worker_map import make_worker_mesh, worker_step
 from multiverso_tpu.parallel.ring import (
     ring_attention, sequence_shard, ulysses_attention)
+from multiverso_tpu.parallel.moe import (
+    MoEConfig, init_experts, moe_layer, shard_experts)
+from multiverso_tpu.parallel.pipeline import pipeline_apply, shard_stages
 
 __all__ = [
     "all_gather", "all_reduce", "broadcast", "reduce_scatter",
     "make_worker_mesh", "worker_step",
     "ring_attention", "sequence_shard", "ulysses_attention",
+    "MoEConfig", "init_experts", "moe_layer", "shard_experts",
+    "pipeline_apply", "shard_stages",
 ]

@@ -1,0 +1,164 @@
+"""Expert parallelism: a mixture-of-experts layer over a mesh axis.
+
+Rounds out the modern-strategy surface (SURVEY §2.10: the 2015 reference has
+DP + parameter-storage sharding only; SP/CP live in parallel/ring.py, EP
+here). Experts are sharded over the ``ep`` mesh axis — each device owns
+``num_experts / ep`` expert MLPs — and tokens travel to their experts and
+back via ``all_to_all`` over ICI, the TPU-native equivalent of the
+dispatch/combine messaging a parameter server would do per-row.
+
+Design choices, TPU-first:
+
+* **Static capacity**: each device sends exactly ``capacity`` tokens to each
+  expert shard (truncate-and-pad, like every production TPU MoE) so all
+  shapes are static for XLA; dropped tokens fall back to the residual path.
+* **Top-1 routing** (switch-style) with a jittable router; routing logits
+  get a gumbel option for load-balancing exploration, plus the standard
+  auxiliary load-balance loss returned to the caller.
+* One ``all_to_all`` out, one back; expert compute is a single batched
+  einsum over the local experts — MXU-shaped, no scalar loops.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from multiverso_tpu.zoo import Zoo
+
+
+class MoEConfig(NamedTuple):
+    num_experts: int
+    dim: int
+    hidden: int
+    capacity_factor: float = 1.25
+    axis: str = "ep"
+
+
+def init_experts(cfg: MoEConfig, seed: int = 0, dtype=jnp.float32) -> Dict:
+    """[E, ...]-stacked expert MLP params + router; shard E over the ep axis
+    with :func:`shard_experts`."""
+    rng = np.random.default_rng(seed)
+    e, d, h = cfg.num_experts, cfg.dim, cfg.hidden
+    mk = lambda *s, scale: jnp.asarray(rng.normal(0, scale, s), dtype)
+    return {
+        "w1": mk(e, d, h, scale=1 / np.sqrt(d)),
+        "w2": mk(e, h, d, scale=1 / np.sqrt(h)),
+        "router": mk(d, e, scale=1 / np.sqrt(d)),
+    }
+
+
+def shard_experts(params: Dict, cfg: MoEConfig,
+                  mesh: Optional[Mesh] = None) -> Dict:
+    """Place expert weights expert-sharded (router replicated)."""
+    mesh = mesh or Zoo.get().mesh()
+    shard = NamedSharding(mesh, P(cfg.axis))
+    repl = NamedSharding(mesh, P())
+    return {
+        "w1": jax.device_put(params["w1"], shard),
+        "w2": jax.device_put(params["w2"], shard),
+        "router": jax.device_put(params["router"], repl),
+    }
+
+
+def _local_moe(x, w1, w2, router, cfg: MoEConfig, capacity: int,
+               batch_axis: Optional[str] = None):
+    """Per-shard body. x: [T_local, D]; w1/w2: local experts [E_local, ...]."""
+    ax = cfg.axis
+    n = jax.lax.axis_size(ax)
+    e = cfg.num_experts
+    e_local = e // n
+    t = x.shape[0]
+
+    logits = x @ router                                    # [T, E]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), -1)
+    expert = jnp.argmax(probs, -1)                         # [T]
+    gate = jnp.take_along_axis(probs, expert[:, None], 1)[:, 0]
+
+    # position of each token within its expert's send buffer
+    onehot = jax.nn.one_hot(expert, e, dtype=jnp.int32)    # [T, E]
+    pos = jnp.cumsum(onehot, 0) * onehot                   # 1-based
+    pos = (pos.sum(-1) - 1)                                # [T], per-expert slot
+    keep = pos < capacity
+
+    # dispatch buffer: [E, capacity, D] (one slice per destination expert)
+    slot = jnp.where(keep, pos, capacity)                  # overflow -> pad row
+    dispatch = jnp.zeros((e, capacity + 1, x.shape[1]), x.dtype)
+    dispatch = dispatch.at[expert, slot].add(x)
+    dispatch = dispatch[:, :capacity]                      # [E, C, D]
+
+    # all_to_all: [E, C, D] -> group by shard -> each device ends up with
+    # its local experts' tokens from every peer: [n, E_local, C, D]
+    dispatch = dispatch.reshape(n, e_local, capacity, -1)
+    recv = jax.lax.all_to_all(dispatch, ax, split_axis=0, concat_axis=0,
+                              tiled=False)                 # [n, E_local, C, D]
+
+    # expert compute, batched over local experts: [E_local, n*C, D]
+    xin = recv.transpose(1, 0, 2, 3).reshape(e_local, n * capacity, -1)
+    h = jax.nn.gelu(jnp.einsum("ecd,edh->ech", xin, w1))
+    out = jnp.einsum("ech,ehd->ecd", h, w2)                # [E_local, n*C, D]
+
+    # route back: inverse all_to_all
+    back = out.reshape(e_local, n, capacity, -1).transpose(1, 0, 2, 3)
+    combined = jax.lax.all_to_all(back, ax, split_axis=0, concat_axis=0,
+                                  tiled=False)             # [n, E_local, C, D]
+    combined = combined.reshape(e, capacity, -1)           # [E, C, D]
+
+    # gather each surviving token's expert output; dropped tokens get 0
+    y = combined[expert, jnp.minimum(pos, capacity - 1)]   # [T, D]
+    y = jnp.where(keep[:, None], y, 0.0) * gate[:, None].astype(x.dtype)
+
+    # switch-transformer load-balance aux loss
+    me = probs.mean(0)                                     # [E]
+    ce = onehot.astype(jnp.float32).mean(0)                # [E]
+    aux = e * jnp.sum(me * ce)
+    # reduce over every axis the tokens are sharded on, so the returned
+    # scalars really are replicated (out_specs=P() asserts it)
+    reduce_axes = (ax,) if batch_axis is None else (ax, batch_axis)
+    aux = jax.lax.pmean(aux, reduce_axes)
+    frac_dropped = jax.lax.pmean(1.0 - keep.mean(), reduce_axes)
+    return y, aux, frac_dropped
+
+
+def moe_layer(x: jax.Array, params: Dict, cfg: MoEConfig,
+              mesh: Optional[Mesh] = None,
+              batch_axis: Optional[str] = None
+              ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Apply the expert-parallel MoE to tokens [B, T, D] sharded over
+    ``cfg.axis`` on T (and optionally ``batch_axis`` on B). Returns
+    (output [B, T, D], aux_loss scalar, dropped_fraction scalar)."""
+    mesh = mesh or Zoo.get().mesh()
+    n = mesh.shape[cfg.axis]
+    if cfg.num_experts % n:
+        raise ValueError(
+            f"{cfg.num_experts} experts not divisible by {n} shards")
+    b, t, d = x.shape
+    if t % n:
+        raise ValueError(f"token dim {t} not divisible by {n} {cfg.axis!r} "
+                         "shards")
+    if batch_axis and b % mesh.shape[batch_axis]:
+        raise ValueError(f"batch dim {b} not divisible by "
+                         f"{mesh.shape[batch_axis]} {batch_axis!r} shards")
+    local_tokens = b * t // n // (mesh.shape[batch_axis] if batch_axis else 1)
+    capacity = max(1, int(cfg.capacity_factor * local_tokens
+                          / cfg.num_experts))
+
+    xspec = P(batch_axis, cfg.axis, None)
+    espec = P(cfg.axis)
+
+    def body(x, w1, w2, router):
+        xb = x.reshape(-1, d)
+        y, aux, dropped = _local_moe(xb, w1, w2, router, cfg, capacity,
+                                     batch_axis)
+        return y.reshape(x.shape), aux, dropped
+
+    y, aux, dropped = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(xspec, espec, espec, P()),
+        out_specs=(xspec, P(), P()), check_vma=False)(
+            x, params["w1"], params["w2"], params["router"])
+    return y, aux, dropped

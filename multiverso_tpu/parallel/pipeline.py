@@ -1,0 +1,102 @@
+"""Pipeline parallelism: a GPipe-style microbatch ring over a mesh axis.
+
+Completes the strategy surface (SURVEY §2.10: the reference's only
+"pipeline" is communication/compute double-buffering; layer pipelining was
+out of its scope). Stage s of a stack of identical blocks lives on device s
+of the ``pp`` axis; microbatches enter at stage 0, activations hop stage to
+stage over ICI via ``ppermute``, and the bubble is the classic
+``(n_stages - 1) / (n_stages - 1 + n_micro)`` fraction.
+
+TPU-first shape discipline: ONE ``lax.scan`` over ``n_micro + n_stages - 1``
+ticks compiles a single pipelined body; every tick does (ingest -> stage fn
+-> emit -> rotate) with static shapes, so XLA overlaps the ppermute with the
+next tick's compute. Per-stage parameters are a stacked ``[n_stages, ...]``
+pytree sharded over ``pp`` — the same layout `lax.scan` uses for a deep
+stack on one chip, just distributed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from multiverso_tpu.zoo import Zoo
+
+
+def shard_stages(stacked_params: Any, axis: str = "pp",
+                 mesh: Optional[Mesh] = None) -> Any:
+    """Place a [n_stages, ...]-stacked param pytree stage-sharded."""
+    mesh = mesh or Zoo.get().mesh()
+
+    def put(x):
+        spec = P(axis, *([None] * (x.ndim - 1)))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree.map(put, stacked_params)
+
+
+def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                   stage_params: Any, x: jax.Array,
+                   n_micro: int, axis: str = "pp",
+                   mesh: Optional[Mesh] = None,
+                   batch_axis: Optional[str] = None) -> jax.Array:
+    """Run ``x`` [B, ...] through ``n_stages`` pipelined applications of
+    ``stage_fn``; batch is split into ``n_micro`` microbatches on the fly.
+
+    ``stage_params`` leaves are [n_stages, ...] (use :func:`shard_stages`);
+    ``stage_fn(params_for_one_stage, act) -> act`` must preserve the
+    activation shape (the identical-blocks contract of layer pipelining).
+    On a multi-axis mesh pass ``batch_axis`` to shard the microbatch dim
+    (each batch shard runs its own pipeline over the same stage weights).
+    """
+    mesh = mesh or Zoo.get().mesh()
+    n_stages = mesh.shape[axis]
+    for path, leaf in jax.tree_util.tree_leaves_with_path(stage_params):
+        if leaf.shape[0] != n_stages:
+            raise ValueError(
+                f"stage_params leaf {jax.tree_util.keystr(path)} has leading "
+                f"dim {leaf.shape[0]}, expected n_stages={n_stages} "
+                f"(mesh axis {axis!r}); fold extra layers into stage_fn")
+    b = x.shape[0]
+    if b % n_micro:
+        raise ValueError(f"batch {b} not divisible by {n_micro} microbatches")
+    mb = b // n_micro
+    xs = x.reshape(n_micro, mb, *x.shape[1:])
+
+    def body(params, xs):
+        # params: this stage's slice, leading stage-dim of 1
+        params = jax.tree.map(lambda p: p[0], params)
+        idx = jax.lax.axis_index(axis)
+        last = n_stages - 1
+        fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def tick(carry, t):
+            act, outs = carry
+            # stage 0 ingests microbatch t while it exists; later stages
+            # keep the activation that just arrived on the ring
+            inp = xs[jnp.minimum(t, n_micro - 1)]
+            act = jnp.where(idx == 0, inp, act)
+            act = stage_fn(params, act)
+            # stage n-1 emits microbatch t-(n-1) once the fill ends
+            slot = jnp.clip(t - last, 0, n_micro - 1)
+            valid = (idx == last) & (t >= last)
+            outs = outs.at[slot].add(jnp.where(valid, act, 0.0))
+            act = jax.lax.ppermute(act, axis, fwd)
+            return (act, outs), None
+
+        act0 = jnp.zeros(xs.shape[1:], xs.dtype)
+        outs0 = jnp.zeros_like(xs)
+        (_, outs), _ = jax.lax.scan(
+            tick, (act0, outs0), jnp.arange(n_micro + n_stages - 1))
+        # every stage holds zeros except the last; psum replicates the result
+        return jax.lax.psum(outs, axis)
+
+    pspec = jax.tree.map(lambda _: P(axis), stage_params)
+    xspec = P(None, batch_axis) if batch_axis else P()
+    out = jax.shard_map(body, mesh=mesh,
+                        in_specs=(pspec, xspec), out_specs=xspec,
+                        check_vma=False)(stage_params, xs)
+    return out.reshape(b, *x.shape[1:])

@@ -1,0 +1,128 @@
+"""Pipeline parallelism (parallel/pipeline.py): GPipe microbatch ring vs a
+sequential oracle on the 8-device mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+import multiverso_tpu as mv
+from multiverso_tpu.parallel import pipeline
+
+
+@pytest.fixture(autouse=True)
+def _init():
+    yield
+    if mv.Zoo.get().started:
+        mv.shutdown()
+
+
+def _stages(n, d, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "w": jnp.asarray(rng.normal(0, 0.5, (n, d, d)).astype(np.float32)),
+        "b": jnp.asarray(rng.normal(0, 0.1, (n, d)).astype(np.float32)),
+    }
+
+
+def _stage_fn(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def _oracle(params, x):
+    for i in range(params["w"].shape[0]):
+        x = _stage_fn({"w": params["w"][i], "b": params["b"][i]}, x)
+    return x
+
+
+class TestPipeline:
+    def test_matches_sequential(self):
+        mesh = Mesh(np.asarray(jax.devices()), ("pp",))
+        mv.init(mesh=mesh)
+        params = _stages(8, 16)
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.normal(size=(32, 16)).astype(np.float32))
+        expect = _oracle(params, x)
+        got = pipeline.pipeline_apply(
+            _stage_fn, pipeline.shard_stages(params), x, n_micro=4)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_single_microbatch_and_many(self):
+        mesh = Mesh(np.asarray(jax.devices()), ("pp",))
+        mv.init(mesh=mesh)
+        params = _stages(8, 8)
+        x = jnp.asarray(np.random.default_rng(2)
+                        .normal(size=(16, 8)).astype(np.float32))
+        expect = _oracle(params, x)
+        for n_micro in (1, 2, 8, 16):
+            got = pipeline.pipeline_apply(
+                _stage_fn, pipeline.shard_stages(params), x, n_micro=n_micro)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                                       rtol=2e-4, atol=2e-5)
+
+    def test_rejects_indivisible_microbatch(self):
+        mesh = Mesh(np.asarray(jax.devices()), ("pp",))
+        mv.init(mesh=mesh)
+        params = _stages(8, 8)
+        x = jnp.zeros((10, 8), jnp.float32)
+        with pytest.raises(ValueError):
+            pipeline.pipeline_apply(_stage_fn,
+                                    pipeline.shard_stages(params), x,
+                                    n_micro=4)
+
+    def test_under_jit_and_grad(self):
+        mesh = Mesh(np.asarray(jax.devices()), ("pp",))
+        mv.init(mesh=mesh)
+        params = _stages(8, 8)
+        sharded = pipeline.shard_stages(params)
+        x = jnp.asarray(np.random.default_rng(3)
+                        .normal(size=(16, 8)).astype(np.float32))
+
+        @jax.jit
+        def loss(p, x):
+            y = pipeline.pipeline_apply(_stage_fn, p, x, n_micro=4)
+            return jnp.mean(y ** 2)
+
+        g = jax.grad(loss)(sharded, x)
+        for leaf in jax.tree.leaves(g):
+            arr = np.asarray(leaf)
+            assert np.isfinite(arr).all()
+            assert np.abs(arr).sum() > 0
+
+    def test_rejects_stage_count_mismatch(self):
+        mesh = Mesh(np.asarray(jax.devices()), ("pp",))
+        mv.init(mesh=mesh)
+        params = _stages(16, 8)  # 16 layers on an 8-stage axis
+        x = jnp.zeros((16, 8), jnp.float32)
+        with pytest.raises(ValueError, match="n_stages"):
+            pipeline.pipeline_apply(_stage_fn, params, x, n_micro=4)
+
+    def test_dp_pp_mesh_with_batch_axis(self):
+        devices = np.asarray(jax.devices()).reshape(2, 4)
+        mesh = Mesh(devices, ("dp", "pp"))
+        mv.init(mesh=mesh)
+        params = _stages(4, 8)
+        x = jnp.asarray(np.random.default_rng(5)
+                        .normal(size=(16, 8)).astype(np.float32))
+        expect = _oracle(params, x)
+        got = pipeline.pipeline_apply(
+            _stage_fn, pipeline.shard_stages(params, mesh=mesh), x,
+            n_micro=4, mesh=mesh, batch_axis="dp")
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_dp_pp_mesh(self):
+        devices = np.asarray(jax.devices()).reshape(2, 4)
+        mesh = Mesh(devices, ("dp", "pp"))
+        mv.init(mesh=mesh)
+        params = _stages(4, 8)
+        x = jnp.asarray(np.random.default_rng(4)
+                        .normal(size=(16, 8)).astype(np.float32))
+        expect = _oracle(params, x)
+        got = pipeline.pipeline_apply(
+            _stage_fn, pipeline.shard_stages(params, mesh=mesh), x,
+            n_micro=4, mesh=mesh)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                                   rtol=2e-4, atol=2e-5)
