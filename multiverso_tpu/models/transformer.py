@@ -39,6 +39,7 @@ class TransformerConfig(NamedTuple):
     attn: str = "ring"          # "ring" | "ulysses" | "local"
     seq_axis: Optional[str] = None   # mesh axis for sequence parallelism
     batch_axis: Optional[str] = None  # mesh axis for data parallelism
+    tp_axis: Optional[str] = None    # mesh axis for tensor parallelism
 
 
 def init_params(cfg: TransformerConfig, seed: int = 0) -> Dict[str, Any]:
@@ -73,10 +74,30 @@ def _rmsnorm(x, g):
 
 def _attention(cfg: TransformerConfig, q, k, v):
     if cfg.attn == "local":
+        # global-level attention; with tp_axis set GSPMD shards the
+        # (embarrassingly parallel) head dim itself
         return ring.reference_attention(q, k, v, causal=True)
-    fn = ring.ring_attention if cfg.attn == "ring" else ring.ulysses_attention
-    return fn(q, k, v, axis_name=cfg.seq_axis, causal=True,
-              batch_axis=cfg.batch_axis)
+    if cfg.attn == "ring":
+        return ring.ring_attention(q, k, v, axis_name=cfg.seq_axis,
+                                   causal=True, batch_axis=cfg.batch_axis,
+                                   head_axis=cfg.tp_axis)
+    if cfg.tp_axis is not None:
+        raise ValueError("ulysses attention reshards heads itself; combine "
+                         "tp_axis with attn='ring' or 'local' instead")
+    return ring.ulysses_attention(q, k, v, axis_name=cfg.seq_axis,
+                                  causal=True, batch_axis=cfg.batch_axis)
+
+
+def shard_params_tp(params: Dict[str, Any], cfg: TransformerConfig,
+                    mesh=None) -> Dict[str, Any]:
+    """Place params Megatron-sharded over ``cfg.tp_axis`` (see parallel/tp)."""
+    from multiverso_tpu.parallel import tp as tp_lib
+    if cfg.tp_axis is None:
+        raise ValueError("shard_params_tp needs cfg.tp_axis set; with no "
+                         "tensor-parallel axis it would silently replicate "
+                         "every parameter")
+    return tp_lib.shard_params(
+        params, tp_lib.transformer_tp_rules(cfg.tp_axis), mesh)
 
 
 def forward(params: Dict[str, Any], tokens: jax.Array,
@@ -86,19 +107,34 @@ def forward(params: Dict[str, Any], tokens: jax.Array,
     b, s = tokens.shape
     h, d = cfg.num_heads, cfg.dim
     hd = d // h
+
+    if cfg.tp_axis is not None:
+        from jax.sharding import PartitionSpec as P
+
+        from multiverso_tpu.parallel import tp as tp_lib
+        heads_spec = P(cfg.batch_axis, cfg.tp_axis, cfg.seq_axis, None)
+        hidden_spec = P(cfg.batch_axis, cfg.seq_axis, cfg.tp_axis)
+        tp_hint = lambda t, spec: tp_lib.constrain(t, spec)
+    else:
+        tp_hint = lambda t, spec: t
+        heads_spec = hidden_spec = None
+
     x = params["embed"][tokens] + params["pos"][:s][None]
 
     def layer(x, p):
         y = _rmsnorm(x, p["ln1"])
         qkv = jnp.einsum("bsd,de->bse", y, p["wqkv"])
         q, k, v = jnp.split(qkv, 3, axis=-1)
-        # [B, S, D] -> [B, H, S, hd]
-        split = lambda t: t.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
+        # [B, S, D] -> [B, H, S, hd]; tp shards the head dim
+        split = lambda t: tp_hint(
+            t.reshape(b, s, h, hd).transpose(0, 2, 1, 3), heads_spec)
         o = _attention(cfg, split(q), split(k), split(v))
         o = o.transpose(0, 2, 1, 3).reshape(b, s, d)
         x = x + jnp.einsum("bsd,de->bse", o, p["wo"])
         y = _rmsnorm(x, p["ln2"])
-        y = jax.nn.gelu(jnp.einsum("bsd,dm->bsm", y, p["w1"]))
+        # tp shards the MLP hidden dim (column-parallel w1, row-parallel w2)
+        y = tp_hint(jnp.einsum("bsd,dm->bsm", y, p["w1"]), hidden_spec)
+        y = jax.nn.gelu(y)
         return x + jnp.einsum("bsm,md->bsd", y, p["w2"]), None
 
     x, _ = jax.lax.scan(layer, x, params["layers"])

@@ -6,6 +6,8 @@ from multiverso_tpu.parallel.ring import (
 from multiverso_tpu.parallel.moe import (
     MoEConfig, init_experts, moe_layer, shard_experts)
 from multiverso_tpu.parallel.pipeline import pipeline_apply, shard_stages
+from multiverso_tpu.parallel.tp import (
+    column_parallel, mlp_block, row_parallel, transformer_tp_rules)
 
 __all__ = [
     "all_gather", "all_reduce", "broadcast", "reduce_scatter",
@@ -13,4 +15,5 @@ __all__ = [
     "ring_attention", "sequence_shard", "ulysses_attention",
     "MoEConfig", "init_experts", "moe_layer", "shard_experts",
     "pipeline_apply", "shard_stages",
+    "column_parallel", "mlp_block", "row_parallel", "transformer_tp_rules",
 ]

@@ -92,11 +92,14 @@ def ring_attention(q, k, v, axis_name: Optional[str] = None,
                    mesh: Optional[Mesh] = None,
                    precision: Optional[str] = None,
                    causal: bool = False,
-                   batch_axis: Optional[str] = None):
+                   batch_axis: Optional[str] = None,
+                   head_axis: Optional[str] = None):
     """Ring attention over sequence-sharded [B, H, S, D] arrays; causal
     masking uses global block positions so the online softmax sees exactly
     the lower-triangular scores. ``batch_axis`` additionally shards B (the
-    dp x sp layout of the transformer model family). Returns the
+    dp x sp layout of the transformer model family) and ``head_axis``
+    shards H (tensor parallelism composed with the sequence ring — heads
+    are embarrassingly parallel inside the ring body). Returns the
     sequence-sharded output.
 
     Causal note: with contiguous block assignment shard i only has useful
@@ -108,8 +111,11 @@ def ring_attention(q, k, v, axis_name: Optional[str] = None,
     zoo = Zoo.get()
     mesh = mesh or zoo.mesh()
     ax = axis_name or zoo.shard_axis()
+    if head_axis and q.shape[1] % mesh.shape[head_axis]:
+        raise ValueError(f"heads {q.shape[1]} not divisible by "
+                         f"{mesh.shape[head_axis]} {head_axis!r} shards")
     scale = 1.0 / (q.shape[-1] ** 0.5)
-    spec = P(batch_axis, None, ax, None)
+    spec = P(batch_axis, head_axis, ax, None)
 
     fn = partial(_ring_attention_local, axis_name=ax, scale=scale,
                  causal=causal)
