@@ -36,7 +36,7 @@ class TransformerConfig(NamedTuple):
     max_seq: int = 512
     mlp_ratio: int = 4
     dtype: Any = jnp.float32
-    attn: str = "ring"          # "ring" | "ulysses" | "local"
+    attn: str = "ring"          # "ring" | "ulysses" | "local" | "flash"
     seq_axis: Optional[str] = None   # mesh axis for sequence parallelism
     batch_axis: Optional[str] = None  # mesh axis for data parallelism
     tp_axis: Optional[str] = None    # mesh axis for tensor parallelism
@@ -77,6 +77,26 @@ def _attention(cfg: TransformerConfig, q, k, v):
         # global-level attention; with tp_axis set GSPMD shards the
         # (embarrassingly parallel) head dim itself
         return ring.reference_attention(q, k, v, causal=True)
+    if cfg.attn == "flash":
+        # fused Pallas kernel (ops/attention_kernels.py); the sequence stays
+        # whole per chip — use attn='ring' to shard S. With dp/tp axes set
+        # the kernel is shard_mapped so each chip runs it on its own
+        # batch/head slice (a bare pallas_call has no GSPMD partitioning
+        # rule, so jit alone would replicate the global batch per chip).
+        if cfg.seq_axis is not None:
+            raise ValueError("attn='flash' is the single-chip fused kernel; "
+                             "use attn='ring' for sequence parallelism")
+        from multiverso_tpu.ops.attention_kernels import flash_attention
+        if cfg.batch_axis is None and cfg.tp_axis is None:
+            return flash_attention(q, k, v, True)
+        from jax.sharding import PartitionSpec as P
+
+        from multiverso_tpu.zoo import Zoo
+        spec = P(cfg.batch_axis, cfg.tp_axis, None, None)
+        return jax.shard_map(
+            lambda q, k, v: flash_attention(q, k, v, True),
+            mesh=Zoo.get().mesh(), in_specs=(spec, spec, spec),
+            out_specs=spec, check_vma=False)(q, k, v)
     if cfg.attn == "ring":
         return ring.ring_attention(q, k, v, axis_name=cfg.seq_axis,
                                    causal=True, batch_axis=cfg.batch_axis,

@@ -1,4 +1,6 @@
+from multiverso_tpu.ops.attention_kernels import flash_attention
 from multiverso_tpu.ops.embedding_kernels import (
     embedding_gather, embedding_scatter_add, pallas_supported)
 
-__all__ = ["embedding_gather", "embedding_scatter_add", "pallas_supported"]
+__all__ = ["embedding_gather", "embedding_scatter_add", "flash_attention",
+           "pallas_supported"]

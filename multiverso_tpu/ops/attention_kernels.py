@@ -1,0 +1,155 @@
+"""Pallas TPU flash attention: fused blockwise softmax-attention kernel.
+
+Single-chip counterpart of the cross-chip schemes in parallel/ring.py (the
+reference framework predates attention entirely — SURVEY §5 "long-context:
+absent"). The kernel never materializes the [S, S] score matrix: the grid
+walks (batch*heads, q_blocks, k_blocks) with the k dimension innermost and
+sequential, carrying the online-softmax state (running max ``m``, denominator
+``l``, f32 accumulator) in VMEM scratch that persists across the k steps —
+the same math as ``ring._ring_attention_local`` with ppermute hops replaced
+by grid steps over HBM-resident K/V blocks.
+
+MXU/VPU notes: both matmuls (q@k^T, p@v) run on the MXU in the input dtype
+with f32 accumulation (``preferred_element_type``); masking, exp and the
+rescale are VPU elementwise ops on (block_q, block_k) tiles. Causal blocks
+strictly above the diagonal skip their compute with ``pl.when`` (the
+block pipeline still streams those K/V blocks — only the MXU/VPU work is
+saved).
+
+The backward pass recomputes attention with plain XLA ops (jax.custom_vjp),
+trading the O(S^2) backward memory for not keeping ``p`` alive; use ring
+attention when S itself is the memory problem.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANES = 128
+_NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                  scale: float, causal: bool, block_q: int, block_k: int,
+                  nk: int):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # causal: the whole block is masked iff its first k position exceeds
+    # the last q position of this q block
+    live = (j * block_k <= i * block_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _step():
+        qb = q_ref[0]                                     # (bq, d)
+        kb = k_ref[0]                                     # (bk, d)
+        vb = v_ref[0]
+        s = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (bq, bk)
+        if causal:
+            qpos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) \
+                + i * block_q
+            kpos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) \
+                + j * block_k
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        m_prev = m_ref[...][:, :1]                        # (bq, 1)
+        l_prev = l_ref[...][:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_next = jnp.maximum(m_prev, m_cur)
+        corr = jnp.exp(m_prev - m_next)
+        p = jnp.exp(s - m_next)
+        if causal:
+            # rows whose every position is masked would get exp(-inf-(-inf))
+            p = jnp.where(s > _NEG_INF / 2, p, 0.0)
+        l_next = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (bq, d)
+        acc_ref[...] = acc_ref[...] * corr + pv
+        m_ref[...] = jnp.broadcast_to(m_next, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_next, l_ref.shape)
+
+    @pl.when(j == nk - 1)
+    def _emit():
+        l = l_ref[...][:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
+                   interpret: bool):
+    b, h, s, d = q.shape
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if s % block_q or s % block_k:
+        raise ValueError(f"seq len {s} not divisible by blocks "
+                         f"({block_q}, {block_k})")
+    bh, nq, nk = b * h, s // block_q, s // block_k
+    scale = 1.0 / (d ** 0.5)
+    flat = lambda t: t.reshape(bh, s, d)
+
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, nk=nk)
+    out = pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),   # running max
+            pltpu.VMEM((block_q, _LANES), jnp.float32),   # denominator
+            pltpu.VMEM((block_q, d), jnp.float32),        # output acc
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(flat(q), flat(k), flat(v))
+    return out.reshape(b, h, s, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal: bool = False,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    """Fused attention over [B, H, S, D]; S must divide by the block sizes
+    (blocks auto-clamp to S when S < 128). ``interpret=None`` auto-selects
+    interpreter mode off-TPU (tests); pass False to force the compiled path.
+    """
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _fwd(q, k, v, causal, block_q, block_k, interpret):
+    out = flash_attention(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _bwd(causal, block_q, block_k, interpret, res, g):
+    from multiverso_tpu.parallel.ring import reference_attention
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: reference_attention(q, k, v, causal=causal), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)

@@ -168,11 +168,16 @@ def ulysses_attention(q, k, v, axis_name: Optional[str] = None,
 
 
 def reference_attention(q, k, v, causal: bool = False):
-    """Unsharded softmax attention (test oracle)."""
+    """Unsharded softmax attention (test oracle; also the recompute
+    backward of ops/attention_kernels.flash_attention). Scores and softmax
+    in f32 regardless of input dtype, output in the input dtype — the same
+    numerics as the flash kernel."""
     scale = 1.0 / (q.shape[-1] ** 0.5)
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
     if causal:
         sq, sk = s.shape[-2:]
         mask = jnp.tril(jnp.ones((sq, sk), bool))
-        s = jnp.where(mask[None, None], s, jnp.asarray(-1e30, s.dtype))
-    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, -1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)

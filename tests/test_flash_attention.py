@@ -1,0 +1,128 @@
+"""Flash attention Pallas kernel (ops/attention_kernels.py) vs the dense
+oracle, in interpreter mode on the CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import multiverso_tpu as mv
+from multiverso_tpu.ops.attention_kernels import flash_attention
+from multiverso_tpu.parallel.ring import reference_attention
+
+
+@pytest.fixture(autouse=True)
+def _init():
+    yield
+    if mv.Zoo.get().started:
+        mv.shutdown()
+
+
+def _qkv(b=2, h=2, s=256, d=64, seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    return tuple(jnp.asarray(rng.normal(size=(b, h, s, d)), dtype)
+                 for _ in range(3))
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference_multi_block(self, causal):
+        q, k, v = _qkv(s=256, d=64)  # 2 q blocks x 2 k blocks
+        expect = reference_attention(q, k, v, causal=causal)
+        got = flash_attention(q, k, v, causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_small_sequence_clamps_blocks(self, ):
+        q, k, v = _qkv(s=32, d=16, seed=1)
+        expect = reference_attention(q, k, v, causal=True)
+        got = flash_attention(q, k, v, True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_uneven_blocks(self):
+        # 4 k blocks per q block exercises the running-softmax carry
+        q, k, v = _qkv(s=512, d=32, seed=2)
+        expect = reference_attention(q, k, v, causal=True)
+        got = flash_attention(q, k, v, True, 256, 128)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_bfloat16(self):
+        q, k, v = _qkv(s=128, d=64, seed=3, dtype=jnp.bfloat16)
+        expect = reference_attention(q, k, v, causal=True)
+        got = flash_attention(q, k, v, True)
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(expect, np.float32),
+            rtol=5e-2, atol=5e-2)
+
+    def test_grad_matches_reference(self):
+        q, k, v = _qkv(s=128, d=32, seed=4)
+
+        def loss_flash(q, k, v):
+            return jnp.mean(flash_attention(q, k, v, True) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.mean(reference_attention(q, k, v, causal=True) ** 2)
+
+        with jax.default_matmul_precision("float32"):
+            gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+            gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=2e-4)
+
+    def test_rejects_indivisible_seq(self):
+        q, k, v = _qkv(s=192, d=32, seed=5)
+        with pytest.raises(ValueError, match="not divisible"):
+            flash_attention(q, k, v, False)
+
+    def test_transformer_flash_matches_local(self):
+        from multiverso_tpu.models import transformer as tfm
+        mv.init()
+        base = tfm.TransformerConfig(vocab_size=64, dim=32, num_heads=4,
+                                     num_layers=2, max_seq=32, attn="local")
+        params = tfm.init_params(base, seed=0)
+        rng = np.random.default_rng(6)
+        tok = jnp.asarray(rng.integers(0, 64, (2, 32)), jnp.int32)
+        tgt = jnp.asarray(rng.integers(0, 64, (2, 32)), jnp.int32)
+        with jax.default_matmul_precision("float32"):
+            expect = tfm.loss_fn(params, tok, tgt, base)
+            got = tfm.loss_fn(params, tok, tgt, base._replace(attn="flash"))
+        np.testing.assert_allclose(float(got), float(expect),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_transformer_flash_dp_tp_mesh(self):
+        from jax.sharding import Mesh
+
+        from multiverso_tpu.models import transformer as tfm
+        devices = np.asarray(jax.devices()).reshape(2, 4)
+        mesh = Mesh(devices, ("dp", "tp"))
+        mv.init(mesh=mesh)
+        base = tfm.TransformerConfig(vocab_size=64, dim=32, num_heads=4,
+                                     num_layers=2, max_seq=16, attn="local")
+        params = tfm.init_params(base, seed=1)
+        rng = np.random.default_rng(7)
+        toks = rng.integers(0, 64, (4, 17)).astype(np.int32)
+        with jax.default_matmul_precision("float32"):
+            expect = tfm.loss_fn(params, jnp.asarray(toks[:, :-1]),
+                                 jnp.asarray(toks[:, 1:]), base)
+        cfg = base._replace(attn="flash", batch_axis="dp", tp_axis="tp")
+        sharded = tfm.shard_params_tp(params, cfg, mesh)
+        tok = tfm.shard_batch(toks[:, :-1], cfg, mesh)
+        tgt = tfm.shard_batch(toks[:, 1:], cfg, mesh)
+        with jax.default_matmul_precision("float32"):
+            got = jax.jit(lambda p, a, b: tfm.loss_fn(p, a, b, cfg))(
+                sharded, tok, tgt)
+        np.testing.assert_allclose(float(got), float(expect),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_transformer_flash_rejects_seq_axis(self):
+        from multiverso_tpu.models import transformer as tfm
+        mv.init()
+        cfg = tfm.TransformerConfig(vocab_size=32, dim=16, num_heads=2,
+                                    num_layers=1, max_seq=8, attn="flash",
+                                    seq_axis="mv")
+        with pytest.raises(ValueError, match="flash"):
+            tfm.forward(tfm.init_params(cfg), jnp.zeros((1, 8), jnp.int32),
+                        cfg)
