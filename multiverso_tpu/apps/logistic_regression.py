@@ -64,6 +64,15 @@ class LogRegConfig:
         self.learning_rate = float(g("learning_rate", "0.1"))
         self.train_epoch = int(g("train_epoch", "1"))
         self.sync_frequency = int(g("sync_frequency", "1"))
+        # bounded staleness (SSP): -1 = off (pure async between barriers),
+        # 0 = BSP lockstep, s > 0 = at most s minibatches ahead of the
+        # slowest worker; needs ssp_dir on shared storage (see ssp.py).
+        # heartbeat_dir additionally excludes dead workers from the bound
+        # (elastic.failed); ssp_timeout bounds every wait.
+        self.staleness = int(g("staleness", "-1"))
+        self.ssp_dir = g("ssp_dir", "")
+        self.ssp_timeout = float(g("ssp_timeout", "600"))
+        self.heartbeat_dir = g("heartbeat_dir", "")
         self.pipeline = g("pipeline", "false").lower() == "true"
         self.use_ps = g("use_ps", "true").lower() == "true"
         self.fused = g("fused", "false").lower() == "true"
@@ -73,6 +82,13 @@ class LogRegConfig:
         self.test_file = g("test_file", "")
         self.output_file = g("output_file", "")
         self.show_time_per_sample = int(g("show_time_per_sample", "10000"))
+        if self.staleness >= 0 and not self.ssp_dir:
+            raise ValueError("staleness is set but ssp_dir is empty — the "
+                             "bound would be silently unenforced; set "
+                             "ssp_dir to shared storage")
+        if self.staleness >= 0 and not self.use_ps:
+            raise ValueError("staleness needs use_ps=true (there is no "
+                             "parameter server to be stale against)")
 
     @classmethod
     def from_file(cls, path: str) -> "LogRegConfig":
@@ -131,6 +147,15 @@ class LogReg:
         pull_buffer: Optional[AsyncBuffer] = None
         if cfg.pipeline and not cfg.sparse:
             pull_buffer = AsyncBuffer(self.table.get)
+        ssp_clock = None
+        if cfg.staleness >= 0:
+            from multiverso_tpu.ssp import SSPClock
+            ignore = None
+            if cfg.heartbeat_dir:
+                from multiverso_tpu import elastic
+                ignore = lambda: elastic.failed(cfg.heartbeat_dir)
+            ssp_clock = SSPClock(cfg.ssp_dir, staleness=cfg.staleness,
+                                 timeout=cfg.ssp_timeout, ignore=ignore)
         self._sync_model()
         for epoch in range(cfg.train_epoch):
             reader = SampleReader(cfg.train_file, cfg.input_size,
@@ -141,6 +166,8 @@ class LogReg:
                 else:
                     loss = self._train_minibatch(x, y, batch_idx, pull_buffer)
                 losses.append(float(loss))
+                if ssp_clock is not None:
+                    ssp_clock.tick()
                 seen += len(y)
                 if seen % cfg.show_time_per_sample < cfg.minibatch_size:
                     log.info("epoch %d, samples %d, loss %.4f",
