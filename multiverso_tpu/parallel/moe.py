@@ -12,9 +12,10 @@ Design choices, TPU-first:
 * **Static capacity**: each device sends exactly ``capacity`` tokens to each
   expert shard (truncate-and-pad, like every production TPU MoE) so all
   shapes are static for XLA; dropped tokens fall back to the residual path.
-* **Top-1 routing** (switch-style) with a jittable router; routing logits
-  get a gumbel option for load-balancing exploration, plus the standard
-  auxiliary load-balance loss returned to the caller.
+* **Top-1 (switch) or top-k (GShard) routing** with a jittable router —
+  ``top_k=1`` gates by the raw expert probability, ``top_k>1`` by the
+  renormalized top-k probabilities — plus the standard auxiliary
+  load-balance loss returned to the caller.
 * One ``all_to_all`` out, one back; expert compute is a single batched
   einsum over the local experts — MXU-shaped, no scalar loops.
 """
@@ -37,6 +38,7 @@ class MoEConfig(NamedTuple):
     hidden: int
     capacity_factor: float = 1.25
     axis: str = "ep"
+    top_k: int = 1
 
 
 def init_experts(cfg: MoEConfig, seed: int = 0, dtype=jnp.float32) -> Dict:
@@ -65,6 +67,24 @@ def shard_experts(params: Dict, cfg: MoEConfig,
     }
 
 
+def _route(probs, kk: int, capacity: int):
+    """Priority routing over the [T, E] expert probabilities: assignments
+    are flattened **k-major** ([all 1st choices, then all 2nd choices, ...])
+    so every token's 1st choice wins the capacity race against any token's
+    2nd choice — the GShard/Switch fill order. Returns (expert, gate, pos,
+    keep, onehot), each over the K*T assignments; gates are the raw top
+    probability for k=1 (switch) and renormalized for k>1 (GShard)."""
+    t, e = probs.shape
+    topv, topi = jax.lax.top_k(probs, kk)                  # [T, K]
+    gates = topv if kk == 1 else topv / topv.sum(-1, keepdims=True)
+    expert = topi.T.reshape(-1)                            # [K*T]
+    gate = gates.T.reshape(-1)
+    onehot = jax.nn.one_hot(expert, e, dtype=jnp.int32)    # [K*T, E]
+    pos = (jnp.cumsum(onehot, 0) * onehot).sum(-1) - 1     # per-expert slot
+    keep = pos < capacity
+    return expert, gate, pos, keep, onehot
+
+
 def _local_moe(x, w1, w2, router, cfg: MoEConfig, capacity: int,
                batch_axis: Optional[str] = None):
     """Per-shard body. x: [T_local, D]; w1/w2: local experts [E_local, ...]."""
@@ -74,21 +94,16 @@ def _local_moe(x, w1, w2, router, cfg: MoEConfig, capacity: int,
     e_local = e // n
     t = x.shape[0]
 
+    kk = cfg.top_k
     logits = x @ router                                    # [T, E]
     probs = jax.nn.softmax(logits.astype(jnp.float32), -1)
-    expert = jnp.argmax(probs, -1)                         # [T]
-    gate = jnp.take_along_axis(probs, expert[:, None], 1)[:, 0]
-
-    # position of each token within its expert's send buffer
-    onehot = jax.nn.one_hot(expert, e, dtype=jnp.int32)    # [T, E]
-    pos = jnp.cumsum(onehot, 0) * onehot                   # 1-based
-    pos = (pos.sum(-1) - 1)                                # [T], per-expert slot
-    keep = pos < capacity
+    expert, gate, pos, keep, onehot = _route(probs, kk, capacity)
 
     # dispatch buffer: [E, capacity, D] (one slice per destination expert)
+    x_rep = jnp.tile(x, (kk, 1))                           # [K*T, D] k-major
     slot = jnp.where(keep, pos, capacity)                  # overflow -> pad row
     dispatch = jnp.zeros((e, capacity + 1, x.shape[1]), x.dtype)
-    dispatch = dispatch.at[expert, slot].add(x)
+    dispatch = dispatch.at[expert, slot].add(x_rep)
     dispatch = dispatch[:, :capacity]                      # [E, C, D]
 
     # all_to_all: [E, C, D] -> group by shard -> each device ends up with
@@ -108,19 +123,24 @@ def _local_moe(x, w1, w2, router, cfg: MoEConfig, capacity: int,
                                   tiled=False)             # [n, E_local, C, D]
     combined = combined.reshape(e, capacity, -1)           # [E, C, D]
 
-    # gather each surviving token's expert output; dropped tokens get 0
-    y = combined[expert, jnp.minimum(pos, capacity - 1)]   # [T, D]
+    # gather each surviving assignment's expert output (dropped -> 0) and
+    # sum a token's k contributions (k-major flatten)
+    y = combined[expert, jnp.minimum(pos, capacity - 1)]   # [K*T, D]
     y = jnp.where(keep[:, None], y, 0.0) * gate[:, None].astype(x.dtype)
+    y = y.reshape(kk, t, -1).sum(0)                        # [T, D]
 
-    # switch-transformer load-balance aux loss
+    # load-balance aux loss (switch for k=1, GShard-normalized for k>1)
     me = probs.mean(0)                                     # [E]
-    ce = onehot.astype(jnp.float32).mean(0)                # [E]
+    ce = onehot.astype(jnp.float32).reshape(kk, t, e).sum(0).mean(0) / kk
     aux = e * jnp.sum(me * ce)
     # reduce over every axis the tokens are sharded on, so the returned
     # scalars really are replicated (out_specs=P() asserts it)
     reduce_axes = (ax,) if batch_axis is None else (ax, batch_axis)
     aux = jax.lax.pmean(aux, reduce_axes)
-    frac_dropped = jax.lax.pmean(1.0 - keep.mean(), reduce_axes)
+    # dropped = tokens whose EVERY assignment overflowed (full residual
+    # fallback), matching the "dropped tokens fall back" contract
+    token_dropped = 1.0 - keep.reshape(kk, t).any(axis=0)
+    frac_dropped = jax.lax.pmean(token_dropped.mean(), reduce_axes)
     return y, aux, frac_dropped
 
 
@@ -130,7 +150,9 @@ def moe_layer(x: jax.Array, params: Dict, cfg: MoEConfig,
               ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Apply the expert-parallel MoE to tokens [B, T, D] sharded over
     ``cfg.axis`` on T (and optionally ``batch_axis`` on B). Returns
-    (output [B, T, D], aux_loss scalar, dropped_fraction scalar)."""
+    (output [B, T, D], aux_loss scalar, dropped_fraction scalar —
+    the fraction of tokens whose every routed choice overflowed capacity
+    and that therefore fell back to the residual path with zero output)."""
     mesh = mesh or Zoo.get().mesh()
     n = mesh.shape[cfg.axis]
     if cfg.num_experts % n:
@@ -143,8 +165,11 @@ def moe_layer(x: jax.Array, params: Dict, cfg: MoEConfig,
     if batch_axis and b % mesh.shape[batch_axis]:
         raise ValueError(f"batch dim {b} not divisible by "
                          f"{mesh.shape[batch_axis]} {batch_axis!r} shards")
+    if not 1 <= cfg.top_k <= cfg.num_experts:
+        raise ValueError(f"top_k={cfg.top_k} out of range for "
+                         f"{cfg.num_experts} experts")
     local_tokens = b * t // n // (mesh.shape[batch_axis] if batch_axis else 1)
-    capacity = max(1, int(cfg.capacity_factor * local_tokens
+    capacity = max(1, int(cfg.capacity_factor * local_tokens * cfg.top_k
                           / cfg.num_experts))
 
     xspec = P(batch_axis, cfg.axis, None)

@@ -18,17 +18,19 @@ def _init():
         mv.shutdown()
 
 
-def _dense_oracle(x, params):
-    """Every expert on every token, then select top-1 with its gate."""
+def _dense_oracle(x, params, top_k=1):
+    """Every expert on every token, then combine the top-k with their
+    gates (raw prob for k=1, renormalized for k>1)."""
     b, t, d = x.shape
     xf = x.reshape(-1, d)
     logits = xf @ params["router"]
     probs = jax.nn.softmax(logits.astype(jnp.float32), -1)
-    expert = jnp.argmax(probs, -1)
-    gate = jnp.take_along_axis(probs, expert[:, None], 1)[:, 0]
+    topv, topi = jax.lax.top_k(probs, top_k)
+    gates = topv if top_k == 1 else topv / topv.sum(-1, keepdims=True)
     h = jax.nn.gelu(jnp.einsum("td,edh->eth", xf, params["w1"]))
     out_all = jnp.einsum("eth,ehd->etd", h, params["w2"])
-    y = out_all[expert, jnp.arange(xf.shape[0])] * gate[:, None]
+    y = sum(out_all[topi[:, k], jnp.arange(xf.shape[0])]
+            * gates[:, k, None] for k in range(top_k))
     return y.reshape(b, t, d).astype(x.dtype)
 
 
@@ -67,6 +69,69 @@ class TestMoE:
         assert float(dropped) == 0.0
         np.testing.assert_allclose(np.asarray(y), np.asarray(expect),
                                    rtol=2e-4, atol=2e-5)
+
+    def test_top2_matches_dense_oracle(self):
+        mesh = Mesh(np.asarray(jax.devices()), ("ep",))
+        mv.init(mesh=mesh)
+        cfg = moe.MoEConfig(num_experts=8, dim=16, hidden=32,
+                            capacity_factor=100.0, axis="ep", top_k=2)
+        x, params = _data(cfg)
+        expect = _dense_oracle(x, params, top_k=2)
+        y, aux, dropped = moe.moe_layer(x, moe.shard_experts(params, cfg),
+                                        cfg)
+        assert float(dropped) == 0.0
+        np.testing.assert_allclose(np.asarray(y), np.asarray(expect),
+                                   rtol=2e-4, atol=2e-5)
+        assert float(aux) > 0.0
+
+    def test_top2_gradients_flow(self):
+        mesh = Mesh(np.asarray(jax.devices()), ("ep",))
+        mv.init(mesh=mesh)
+        cfg = moe.MoEConfig(num_experts=8, dim=16, hidden=32,
+                            capacity_factor=2.0, axis="ep", top_k=2)
+        x, params = _data(cfg)
+        sharded = moe.shard_experts(params, cfg)
+
+        def loss(p, x):
+            y, aux, _ = moe.moe_layer(x, p, cfg)
+            return jnp.mean(y ** 2) + 0.01 * aux
+
+        g = jax.jit(jax.grad(loss))(sharded, x)
+        for leaf in jax.tree.leaves(g):
+            assert np.isfinite(np.asarray(leaf)).all()
+
+    def test_first_choices_win_capacity_race(self):
+        # token 0 prefers e1 (2nd choice e0); tokens 1-3 prefer e0.
+        # capacity 3 at e0: all three 1st choices must be kept and token
+        # 0's 2nd choice dropped — GShard fill order, not arrival order.
+        probs = jnp.asarray([[0.1, 0.9],
+                             [0.9, 0.1],
+                             [0.9, 0.1],
+                             [0.9, 0.1]], jnp.float32)
+        expert, gate, pos, keep, _ = moe._route(probs, kk=2, capacity=3)
+        t = 4
+        # k-major: assignments 0-3 are 1st choices, 4-7 are 2nd choices
+        first, second = keep[:t], keep[t:]
+        assert bool(first.all()), "a 1st choice lost to a 2nd choice"
+        assert not bool(second[0]), "token 0's 2nd choice must overflow"
+
+    def test_dropped_fraction_counts_tokens_not_assignments(self):
+        # opposite 1st choices; capacity 1 per expert keeps every token's
+        # 1st choice (2nd choices overflow), so no token is fully dropped
+        probs = jnp.asarray([[0.9, 0.1], [0.1, 0.9]], jnp.float32)
+        _, _, _, keep, _ = moe._route(probs, kk=2, capacity=1)
+        token_dropped = 1.0 - keep.reshape(2, 2).any(axis=0)
+        assert float(token_dropped.mean()) == 0.0
+        assert float(keep.mean()) < 1.0  # yet some assignments did drop
+
+    def test_rejects_bad_top_k(self):
+        mesh = Mesh(np.asarray(jax.devices()), ("ep",))
+        mv.init(mesh=mesh)
+        cfg = moe.MoEConfig(num_experts=8, dim=8, hidden=8, axis="ep",
+                            top_k=9)
+        x, params = _data(cfg, t=32)
+        with pytest.raises(ValueError, match="top_k"):
+            moe.moe_layer(x, moe.shard_experts(params, cfg), cfg)
 
     def test_aux_replicated_over_batch_axis(self):
         # aux must be the global mean, so permuting which dp shard holds
