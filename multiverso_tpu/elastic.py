@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 import threading
 import time
 from typing import Dict, List, Optional
@@ -136,10 +137,15 @@ class ElasticLoop:
     TAG = "step_{step:09d}"
 
     def __init__(self, directory: str, every: int = 100,
-                 keep: int = 2, heartbeat_interval: float = 5.0):
+                 keep: int = 2, heartbeat_interval: float = 5.0,
+                 backend: str = "stream"):
+        if backend not in checkpoint.BACKENDS:
+            raise ValueError(f"unknown checkpoint backend {backend!r}; "
+                             f"choose from {checkpoint.BACKENDS}")
         self.directory = directory
         self.every = max(1, int(every))
         self.keep = max(1, int(keep))
+        self.backend = backend
         self.heartbeat = Heartbeat(
             os.path.join(directory, "heartbeats"),
             interval=heartbeat_interval).start()
@@ -162,7 +168,8 @@ class ElasticLoop:
         self.heartbeat.set_step(step)
         if (step + 1) % self.every:
             return False
-        checkpoint.save(self.directory, self.TAG.format(step=step))
+        checkpoint.save(self.directory, self.TAG.format(step=step),
+                        backend=self.backend)
         self._prune()
         return True
 
@@ -174,10 +181,8 @@ class ElasticLoop:
                       os.path.exists(os.path.join(self.directory, t,
                                                   "manifest.json")))
         for tag in tags[: -self.keep]:
-            path = os.path.join(self.directory, tag)
-            for name in os.listdir(path):
-                os.unlink(os.path.join(path, name))
-            os.rmdir(path)
+            # orbax checkpoints nest directories, so remove recursively
+            shutil.rmtree(os.path.join(self.directory, tag))
 
     def stop(self) -> None:
         self.heartbeat.stop()

@@ -63,10 +63,12 @@ class TestElasticLoop:
             table.add(np.full(table.shape, 1.0, np.float32))
             loop.completed(step)
 
-    def test_resume_restores_table_state(self, tmp_path):
+    @pytest.mark.parametrize("backend", ["stream", "orbax"])
+    def test_resume_restores_table_state(self, tmp_path, backend):
         ckpt = str(tmp_path / "run")
         table = mv.ArrayTable(16, name="elastic_t")
-        loop = elastic.ElasticLoop(ckpt, every=3, heartbeat_interval=60)
+        loop = elastic.ElasticLoop(ckpt, every=3, heartbeat_interval=60,
+                                   backend=backend)
         assert loop.resume() == 0
         self._train(table, loop, 0, 10)  # checkpoints after steps 2,5,8
         loop.stop()
@@ -75,7 +77,8 @@ class TestElasticLoop:
         # "restart the job": fresh runtime, same table creation order
         mv.init()
         table2 = mv.ArrayTable(16, name="elastic_t")
-        loop2 = elastic.ElasticLoop(ckpt, every=3, heartbeat_interval=60)
+        loop2 = elastic.ElasticLoop(ckpt, every=3, heartbeat_interval=60,
+                                    backend=backend)
         start = loop2.resume()
         assert start == 9  # step 8 was the last checkpoint
         np.testing.assert_allclose(table2.get(), np.full(16, 9.0))
@@ -88,7 +91,7 @@ class TestElasticLoop:
         ckpt = str(tmp_path / "run")
         mv.ArrayTable(4, name="elastic_p")
         loop = elastic.ElasticLoop(ckpt, every=1, keep=2,
-                                   heartbeat_interval=60)
+                                   heartbeat_interval=60, backend="orbax")
         for step in range(5):
             loop.completed(step)
         import os
