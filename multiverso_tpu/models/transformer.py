@@ -40,6 +40,12 @@ class TransformerConfig(NamedTuple):
     seq_axis: Optional[str] = None   # mesh axis for sequence parallelism
     batch_axis: Optional[str] = None  # mesh axis for data parallelism
     tp_axis: Optional[str] = None    # mesh axis for tensor parallelism
+    # expert-parallel MoE MLPs (parallel/moe.py): 0 = dense MLP
+    moe_experts: int = 0
+    moe_axis: str = "ep"             # mesh axis the experts shard over
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 2.0
+    moe_aux_coef: float = 0.01
 
 
 def init_params(cfg: TransformerConfig, seed: int = 0) -> Dict[str, Any]:
@@ -52,17 +58,26 @@ def init_params(cfg: TransformerConfig, seed: int = 0) -> Dict[str, Any]:
         return jnp.asarray(rng.normal(0, scale, shape), cfg.dtype)
 
     s = 1.0 / np.sqrt(d)
+    layers = {
+        "wqkv": norm(L, d, 3 * d, scale=s),
+        "wo": norm(L, d, d, scale=s / np.sqrt(2 * L)),
+        "ln1": jnp.ones((L, d), cfg.dtype),
+        "ln2": jnp.ones((L, d), cfg.dtype),
+    }
+    if cfg.moe_experts:
+        e = cfg.moe_experts
+        layers["moe_w1"] = norm(L, e, d, m, scale=s)
+        layers["moe_w2"] = norm(L, e, m, d,
+                                scale=np.sqrt(1.0 / m) / np.sqrt(2 * L))
+        layers["moe_router"] = norm(L, d, e, scale=s)
+    else:
+        layers["w1"] = norm(L, d, m, scale=s)
+        layers["w2"] = norm(L, m, d,
+                            scale=np.sqrt(1.0 / m) / np.sqrt(2 * L))
     return {
         "embed": norm(cfg.vocab_size, d, scale=0.02),
         "pos": norm(cfg.max_seq, d, scale=0.02),
-        "layers": {
-            "wqkv": norm(L, d, 3 * d, scale=s),
-            "wo": norm(L, d, d, scale=s / np.sqrt(2 * L)),
-            "w1": norm(L, d, m, scale=s),
-            "w2": norm(L, m, d, scale=np.sqrt(1.0 / m) / np.sqrt(2 * L)),
-            "ln1": jnp.ones((L, d), cfg.dtype),
-            "ln2": jnp.ones((L, d), cfg.dtype),
-        },
+        "layers": layers,
         "ln_f": jnp.ones((d,), cfg.dtype),
     }
 
@@ -108,6 +123,29 @@ def _attention(cfg: TransformerConfig, q, k, v):
                                   causal=True, batch_axis=cfg.batch_axis)
 
 
+def shard_params_moe(params: Dict[str, Any], cfg: TransformerConfig,
+                     mesh=None) -> Dict[str, Any]:
+    """Place params with expert weights sharded over ``cfg.moe_axis`` (the
+    [L, E, ...] stacks split on E) and everything else replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    from multiverso_tpu.parallel import tp as tp_lib
+    if not cfg.moe_experts:
+        raise ValueError("shard_params_moe needs cfg.moe_experts > 0")
+    ax = cfg.moe_axis
+    rules = {
+        "embed": P(), "pos": P(),
+        "layers": {
+            "wqkv": P(), "wo": P(), "ln1": P(), "ln2": P(),
+            "moe_w1": P(None, ax, None, None),
+            "moe_w2": P(None, ax, None, None),
+            "moe_router": P(),
+        },
+        "ln_f": P(),
+    }
+    return tp_lib.shard_params(params, rules, mesh)
+
+
 def shard_params_tp(params: Dict[str, Any], cfg: TransformerConfig,
                     mesh=None) -> Dict[str, Any]:
     """Place params Megatron-sharded over ``cfg.tp_axis`` (see parallel/tp)."""
@@ -122,11 +160,31 @@ def shard_params_tp(params: Dict[str, Any], cfg: TransformerConfig,
 
 def forward(params: Dict[str, Any], tokens: jax.Array,
             cfg: TransformerConfig) -> jax.Array:
-    """tokens [B, S] -> logits [B, S, V]. Written at the global-logical
-    level; the attention call shard_maps over the sequence axis."""
+    """tokens [B, S] -> logits [B, S, V] (MoE aux loss discarded; training
+    uses :func:`loss_fn`, which keeps it)."""
+    return forward_with_aux(params, tokens, cfg)[0]
+
+
+def forward_with_aux(params: Dict[str, Any], tokens: jax.Array,
+                     cfg: TransformerConfig):
+    """tokens [B, S] -> (logits [B, S, V], moe aux-loss scalar). Written at
+    the global-logical level; the attention call shard_maps over the
+    sequence axis and MoE MLPs all_to_all tokens over ``moe_axis``."""
     b, s = tokens.shape
     h, d = cfg.num_heads, cfg.dim
     hd = d // h
+
+    if cfg.moe_experts:
+        if cfg.seq_axis is not None or cfg.tp_axis is not None:
+            raise ValueError(
+                "MoE MLPs shard tokens over moe_axis; combine with "
+                "batch_axis only (seq_axis/tp_axis are not supported "
+                "together with moe_experts yet)")
+        from multiverso_tpu.parallel import moe as moe_lib
+        mcfg = moe_lib.MoEConfig(
+            num_experts=cfg.moe_experts, dim=d, hidden=cfg.mlp_ratio * d,
+            capacity_factor=cfg.moe_capacity_factor,
+            axis=cfg.moe_axis, top_k=cfg.moe_top_k)
 
     if cfg.tp_axis is not None:
         from jax.sharding import PartitionSpec as P
@@ -141,7 +199,8 @@ def forward(params: Dict[str, Any], tokens: jax.Array,
 
     x = params["embed"][tokens] + params["pos"][:s][None]
 
-    def layer(x, p):
+    def layer(carry, p):
+        x, aux_sum = carry
         y = _rmsnorm(x, p["ln1"])
         qkv = jnp.einsum("bsd,de->bse", y, p["wqkv"])
         q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -152,27 +211,39 @@ def forward(params: Dict[str, Any], tokens: jax.Array,
         o = o.transpose(0, 2, 1, 3).reshape(b, s, d)
         x = x + jnp.einsum("bsd,de->bse", o, p["wo"])
         y = _rmsnorm(x, p["ln2"])
+        if cfg.moe_experts:
+            mlp, aux, _ = moe_lib.moe_layer(
+                y, {"w1": p["moe_w1"], "w2": p["moe_w2"],
+                    "router": p["moe_router"]},
+                mcfg, batch_axis=cfg.batch_axis)
+            return (x + mlp, aux_sum + aux), None
         # tp shards the MLP hidden dim (column-parallel w1, row-parallel w2)
         y = tp_hint(jnp.einsum("bsd,dm->bsm", y, p["w1"]), hidden_spec)
         y = jax.nn.gelu(y)
-        return x + jnp.einsum("bsm,md->bsd", y, p["w2"]), None
+        return (x + jnp.einsum("bsm,md->bsd", y, p["w2"]), aux_sum), None
 
-    x, _ = jax.lax.scan(layer, x, params["layers"])
+    (x, aux), _ = jax.lax.scan(layer, (x, jnp.zeros((), jnp.float32)),
+                               params["layers"])
     x = _rmsnorm(x, params["ln_f"])
-    return jnp.einsum("bsd,vd->bsv", x, params["embed"])
+    return jnp.einsum("bsd,vd->bsv", x, params["embed"]), aux
 
 
 def loss_fn(params, tokens, targets, cfg: TransformerConfig,
             mask: Optional[jax.Array] = None) -> jax.Array:
-    """Mean next-token cross-entropy (f32). ``targets`` is tokens shifted by
-    one on the host, so sequence shards never need a halo exchange; ``mask``
-    zeroes padding/terminal positions."""
-    logits = forward(params, tokens, cfg).astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits, -1)
+    """Mean next-token cross-entropy (f32) plus ``moe_aux_coef`` times the
+    MoE load-balance loss when MoE layers are enabled. ``targets`` is
+    tokens shifted by one on the host, so sequence shards never need a halo
+    exchange; ``mask`` zeroes padding/terminal positions."""
+    logits, aux = forward_with_aux(params, tokens, cfg)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
     nll = -jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
     if mask is not None:
-        return (nll * mask).sum() / jnp.maximum(mask.sum(), 1)
-    return nll.mean()
+        nll = (nll * mask).sum() / jnp.maximum(mask.sum(), 1)
+    else:
+        nll = nll.mean()
+    if cfg.moe_experts:
+        nll = nll + cfg.moe_aux_coef * aux
+    return nll
 
 
 def make_train_step(cfg: TransformerConfig, learning_rate: float = 1e-2):
