@@ -36,7 +36,7 @@ class TransformerConfig(NamedTuple):
     max_seq: int = 512
     mlp_ratio: int = 4
     dtype: Any = jnp.float32
-    attn: str = "ring"          # "ring" | "ulysses" | "local" | "flash"
+    attn: str = "ring"   # "ring" | "zigzag" | "ulysses" | "local" | "flash"
     seq_axis: Optional[str] = None   # mesh axis for sequence parallelism
     batch_axis: Optional[str] = None  # mesh axis for data parallelism
     tp_axis: Optional[str] = None    # mesh axis for tensor parallelism
@@ -116,6 +116,12 @@ def _attention(cfg: TransformerConfig, q, k, v):
         return ring.ring_attention(q, k, v, axis_name=cfg.seq_axis,
                                    causal=True, batch_axis=cfg.batch_axis,
                                    head_axis=cfg.tp_axis)
+    if cfg.attn == "zigzag":
+        # balanced causal ring; activations are in zigzag sequence order
+        # end to end (shard_batch permutes tokens, forward permutes pos)
+        return ring.zigzag_ring_attention(
+            q, k, v, axis_name=cfg.seq_axis, batch_axis=cfg.batch_axis,
+            head_axis=cfg.tp_axis)
     if cfg.tp_axis is not None:
         raise ValueError("ulysses attention reshards heads itself; combine "
                          "tp_axis with attn='ring' or 'local' instead")
@@ -197,7 +203,18 @@ def forward_with_aux(params: Dict[str, Any], tokens: jax.Array,
         tp_hint = lambda t, spec: t
         heads_spec = hidden_spec = None
 
-    x = params["embed"][tokens] + params["pos"][:s][None]
+    if cfg.attn == "zigzag":
+        # tokens arrive zigzag-permuted (shard_batch); position embeddings
+        # must follow the same permutation so each token keeps its true
+        # global position
+        from multiverso_tpu.zoo import Zoo as _Zoo
+        zmesh = _Zoo.get().mesh()
+        zax = cfg.seq_axis or _Zoo.get().shard_axis()
+        zperm = ring.zigzag_shard_ids(s, zmesh.shape[zax])
+        pos = params["pos"][zperm]
+    else:
+        pos = params["pos"][:s]
+    x = params["embed"][tokens] + pos[None]
 
     def layer(carry, p):
         x, aux_sum = carry
@@ -233,7 +250,15 @@ def loss_fn(params, tokens, targets, cfg: TransformerConfig,
     """Mean next-token cross-entropy (f32) plus ``moe_aux_coef`` times the
     MoE load-balance loss when MoE layers are enabled. ``targets`` is
     tokens shifted by one on the host, so sequence shards never need a halo
-    exchange; ``mask`` zeroes padding/terminal positions."""
+    exchange; ``mask`` zeroes padding/terminal positions and is given in
+    the ORIGINAL sequence order — with ``attn="zigzag"`` it is permuted
+    here to match the zigzag-ordered nll."""
+    if mask is not None and cfg.attn == "zigzag":
+        from multiverso_tpu.zoo import Zoo as _Zoo
+        ax = cfg.seq_axis or _Zoo.get().shard_axis()
+        perm = ring.zigzag_shard_ids(mask.shape[1],
+                                     _Zoo.get().mesh().shape[ax])
+        mask = mask[:, perm]
     logits, aux = forward_with_aux(params, tokens, cfg)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
     nll = -jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
@@ -268,10 +293,27 @@ def make_train_step(cfg: TransformerConfig, learning_rate: float = 1e-2):
 
 def shard_batch(tokens: np.ndarray, cfg: TransformerConfig,
                 mesh=None) -> jax.Array:
-    """device_put a [B, S] token batch sharded P(batch_axis, seq_axis)."""
+    """device_put a [B, S] token batch sharded P(batch_axis, seq_axis).
+    With ``attn="zigzag"`` the sequence is permuted into zigzag order first
+    (apply to tokens AND targets; logits/losses come back in the same
+    order, which leaves any position-mean loss unchanged)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from multiverso_tpu.zoo import Zoo
-    mesh = mesh or Zoo.get().mesh()
+    zoo_mesh = Zoo.get().mesh()
+    mesh = mesh or zoo_mesh
+    tokens = jnp.asarray(tokens)
+    if cfg.attn == "zigzag":
+        ax = cfg.seq_axis or Zoo.get().shard_axis()
+        if mesh.shape[ax] != zoo_mesh.shape[ax]:
+            # forward_with_aux derives the zigzag layout from the Zoo mesh;
+            # permuting with a different shard count would silently corrupt
+            # the causal masking
+            raise ValueError(
+                f"mesh axis {ax!r} has {mesh.shape[ax]} shards but the "
+                f"active Zoo mesh has {zoo_mesh.shape[ax]}; zigzag layout "
+                "must be computed against the mesh the model runs on")
+        perm = ring.zigzag_shard_ids(tokens.shape[1], mesh.shape[ax])
+        tokens = tokens[:, perm]
     spec = P(cfg.batch_axis, cfg.seq_axis)
-    return jax.device_put(jnp.asarray(tokens), NamedSharding(mesh, spec))
+    return jax.device_put(tokens, NamedSharding(mesh, spec))

@@ -2,7 +2,8 @@ from multiverso_tpu.parallel.collectives import (
     all_gather, all_reduce, broadcast, reduce_scatter)
 from multiverso_tpu.parallel.worker_map import make_worker_mesh, worker_step
 from multiverso_tpu.parallel.ring import (
-    ring_attention, sequence_shard, ulysses_attention)
+    ring_attention, sequence_shard, ulysses_attention,
+    zigzag_ring_attention, zigzag_shard_ids)
 from multiverso_tpu.parallel.moe import (
     MoEConfig, init_experts, moe_layer, shard_experts)
 from multiverso_tpu.parallel.pipeline import pipeline_apply, shard_stages
@@ -13,6 +14,7 @@ __all__ = [
     "all_gather", "all_reduce", "broadcast", "reduce_scatter",
     "make_worker_mesh", "worker_step",
     "ring_attention", "sequence_shard", "ulysses_attention",
+    "zigzag_ring_attention", "zigzag_shard_ids",
     "MoEConfig", "init_experts", "moe_layer", "shard_experts",
     "pipeline_apply", "shard_stages",
     "column_parallel", "mlp_block", "row_parallel", "transformer_tp_rules",

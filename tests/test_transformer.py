@@ -199,3 +199,139 @@ class TestMoETransformer:
                                    num_layers=1, max_seq=8)
         with pytest.raises(ValueError, match="moe_experts"):
             tf.shard_params_moe(tf.init_params(cfg), cfg)
+
+
+class TestZigzagRing:
+    def _check(self, b=2, h=4, s=64, d=16, seed=0, axes=("sp",),
+               head_axis=None, mesh_shape=None):
+        devices = np.asarray(jax.devices())
+        if mesh_shape:
+            devices = devices.reshape(mesh_shape)
+        mesh = Mesh(devices, axes)
+        mv.init(mesh=mesh)
+        n = mesh.shape[axes[-1] if head_axis is None else "sp"]
+        rng = np.random.default_rng(seed)
+        q, k, v = (jnp.asarray(rng.normal(size=(b, h, s, d)),
+                               jnp.float32) for _ in range(3))
+        expect = reference_attention(q, k, v, causal=True)
+        perm = parallel.zigzag_shard_ids(s, n)
+        inv = jnp.argsort(perm)
+        zq, zk, zv = (t[:, :, perm] for t in (q, k, v))
+        out = parallel.zigzag_ring_attention(
+            zq, zk, zv, axis_name="sp", head_axis=head_axis,
+            precision="float32")
+        np.testing.assert_allclose(np.asarray(out[:, :, inv]),
+                                   np.asarray(expect),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_matches_causal_oracle(self):
+        self._check()
+
+    def test_with_head_sharding(self):
+        self._check(mesh_shape=(2, 4), axes=("tp", "sp"), head_axis="tp")
+
+    def test_under_grad(self):
+        mesh = Mesh(np.asarray(jax.devices()), ("sp",))
+        mv.init(mesh=mesh)
+        rng = np.random.default_rng(3)
+        s = 32
+        q, k, v = (jnp.asarray(rng.normal(size=(1, 2, s, 8)),
+                               jnp.float32) for _ in range(3))
+        perm = parallel.zigzag_shard_ids(s, 8)
+        inv = np.argsort(np.asarray(perm))
+
+        def loss_zig(q, k, v):
+            o = parallel.zigzag_ring_attention(q[:, :, perm], k[:, :, perm],
+                                               v[:, :, perm], axis_name="sp")
+            return jnp.mean(o[:, :, inv] ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.mean(reference_attention(q, k, v, causal=True) ** 2)
+
+        with jax.default_matmul_precision("float32"):
+            gz = jax.grad(loss_zig, argnums=(0, 1, 2))(q, k, v)
+            gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gz, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=2e-4)
+
+    def test_rejects_indivisible_seq(self):
+        mv.init(mesh=Mesh(np.asarray(jax.devices()), ("sp",)))
+        q = jnp.zeros((1, 2, 24, 8), jnp.float32)  # 24 % 16 != 0
+        with pytest.raises(ValueError, match="not divisible"):
+            parallel.zigzag_ring_attention(q, q, q, axis_name="sp")
+
+
+class TestZigzagTransformer:
+    def test_zigzag_lm_loss_matches_local(self):
+        devices = np.asarray(jax.devices()).reshape(2, 4)
+        mesh = Mesh(devices, ("dp", "sp"))
+        mv.init(mesh=mesh)
+        base = tf.TransformerConfig(vocab_size=64, dim=32, num_heads=4,
+                                    num_layers=2, max_seq=32, attn="local")
+        params = tf.init_params(base, seed=0)
+        rng = np.random.default_rng(9)
+        toks = rng.integers(0, 64, (4, 33)).astype(np.int32)
+        with jax.default_matmul_precision("float32"):
+            expect = tf.loss_fn(params, jnp.asarray(toks[:, :-1]),
+                                jnp.asarray(toks[:, 1:]), base)
+        cfg = base._replace(attn="zigzag", batch_axis="dp", seq_axis="sp")
+        tok = tf.shard_batch(toks[:, :-1], cfg, mesh)
+        tgt = tf.shard_batch(toks[:, 1:], cfg, mesh)
+        with jax.default_matmul_precision("float32"):
+            got = jax.jit(lambda p, a, b: tf.loss_fn(p, a, b, cfg))(
+                params, tok, tgt)
+        np.testing.assert_allclose(float(got), float(expect),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_zigzag_lm_trains(self):
+        devices = np.asarray(jax.devices()).reshape(2, 4)
+        mesh = Mesh(devices, ("dp", "sp"))
+        mv.init(mesh=mesh)
+        cfg = tf.TransformerConfig(vocab_size=64, dim=32, num_heads=4,
+                                   num_layers=2, max_seq=32, attn="zigzag",
+                                   batch_axis="dp", seq_axis="sp")
+        params = tf.init_params(cfg, seed=1)
+        step = jax.jit(tf.make_train_step(cfg, 0.5))
+        rng = np.random.default_rng(10)
+        toks = rng.integers(0, 64, (4, 33)).astype(np.int32)
+        tok = tf.shard_batch(toks[:, :-1], cfg, mesh)
+        tgt = tf.shard_batch(toks[:, 1:], cfg, mesh)
+        losses = []
+        for _ in range(25):
+            params, loss = step(params, tok, tgt)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.5, losses[::6]
+
+    def test_zigzag_masked_loss_matches_local(self):
+        # the mask is supplied in ORIGINAL order; loss_fn must permute it
+        devices = np.asarray(jax.devices()).reshape(2, 4)
+        mesh = Mesh(devices, ("dp", "sp"))
+        mv.init(mesh=mesh)
+        base = tf.TransformerConfig(vocab_size=64, dim=32, num_heads=4,
+                                    num_layers=1, max_seq=32, attn="local")
+        params = tf.init_params(base, seed=2)
+        rng = np.random.default_rng(11)
+        toks = rng.integers(0, 64, (4, 33)).astype(np.int32)
+        mask = (rng.random((4, 32)) > 0.3).astype(np.float32)
+        with jax.default_matmul_precision("float32"):
+            expect = tf.loss_fn(params, jnp.asarray(toks[:, :-1]),
+                                jnp.asarray(toks[:, 1:]), base,
+                                mask=jnp.asarray(mask))
+        cfg = base._replace(attn="zigzag", batch_axis="dp", seq_axis="sp")
+        tok = tf.shard_batch(toks[:, :-1], cfg, mesh)
+        tgt = tf.shard_batch(toks[:, 1:], cfg, mesh)
+        with jax.default_matmul_precision("float32"):
+            got = tf.loss_fn(params, tok, tgt, cfg, mask=jnp.asarray(mask))
+        np.testing.assert_allclose(float(got), float(expect),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_shard_batch_rejects_mismatched_mesh(self):
+        devices = np.asarray(jax.devices()).reshape(2, 4)
+        mv.init(mesh=Mesh(devices, ("dp", "sp")))
+        cfg = tf.TransformerConfig(vocab_size=32, dim=16, num_heads=2,
+                                   num_layers=1, max_seq=16, attn="zigzag",
+                                   batch_axis="dp", seq_axis="sp")
+        other = Mesh(devices.reshape(4, 2), ("dp", "sp"))
+        with pytest.raises(ValueError, match="Zoo mesh"):
+            tf.shard_batch(np.zeros((2, 16), np.int32), cfg, other)
