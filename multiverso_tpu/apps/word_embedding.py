@@ -188,19 +188,38 @@ class WordEmbedding:
             mb = jnp.asarray(masks[:n].reshape(-1, b, masks.shape[1]))
             tb = jnp.asarray(targets[:n].reshape(-1, b))
             pairs = n
-            epoch_fn = self._fused_cache.get("cbow")
-            if epoch_fn is None:
-                epoch_fn = self._fused_cache["cbow"] = (
-                    w2v.make_fused_cbow_epoch(w2v_cfg, self.unigram))
-            state_in, state_out = self.table_in.state, self.table_out.state
-            win, wout = state_in["data"], state_out["data"]
-            for _ in range(epochs):
-                key, sub = jax.random.split(key)
-                win, wout, loss = epoch_fn(win, wout, wb, mb, tb, sub)
-            jax.block_until_ready(win)
+            state_in = self.table_in.state
+            win = state_in["data"]
+            if cfg.hs:
+                codes, points, lengths = self._hs
+                epoch_fn = self._fused_cache.get("cbow_hs")
+                if epoch_fn is None:
+                    epoch_fn = self._fused_cache["cbow_hs"] = (
+                        w2v.make_fused_cbow_hs_epoch(w2v_cfg, codes, points,
+                                                     lengths))
+                state_hs = self.table_hs.state
+                hs_out = state_hs["data"]
+                for _ in range(epochs):
+                    key, sub = jax.random.split(key)
+                    win, hs_out, loss = epoch_fn(win, hs_out, wb, mb, tb,
+                                                 sub)
+                jax.block_until_ready(win)
+                self.table_hs.adopt({"data": hs_out,
+                                     "ustate": state_hs["ustate"]})
+            else:
+                epoch_fn = self._fused_cache.get("cbow")
+                if epoch_fn is None:
+                    epoch_fn = self._fused_cache["cbow"] = (
+                        w2v.make_fused_cbow_epoch(w2v_cfg, self.unigram))
+                state_out = self.table_out.state
+                wout = state_out["data"]
+                for _ in range(epochs):
+                    key, sub = jax.random.split(key)
+                    win, wout, loss = epoch_fn(win, wout, wb, mb, tb, sub)
+                jax.block_until_ready(win)
+                self.table_out.adopt({"data": wout,
+                                      "ustate": state_out["ustate"]})
             self.table_in.adopt({"data": win, "ustate": state_in["ustate"]})
-            self.table_out.adopt({"data": wout,
-                                  "ustate": state_out["ustate"]})
         else:
             cbd, xbd, pairs = self._device_pairs(ids)
             state_in = self.table_in.state

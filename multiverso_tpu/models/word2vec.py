@@ -123,29 +123,58 @@ def skipgram_ns_step(win: jax.Array, wout: jax.Array, centers: jax.Array,
     return win, wout, loss
 
 
+def _cbow_mean(win, windows, window_mask):
+    """Masked mean of the window's input vectors (ref FeedForward average,
+    wordembedding.cpp:57-80). Returns (v, denom, m) for the backward."""
+    ctx = jnp.take(win, windows, axis=0)                     # (B, W, D)
+    m = window_mask.astype(ctx.dtype)[..., None]
+    denom = jnp.maximum(m.sum(axis=1), 1.0)
+    return (ctx * m).sum(axis=1) / denom, denom, m
+
+
+def _cbow_spread(win, windows, dv, denom, m):
+    """Scatter dv back over the (masked) window, divided like the forward
+    mean."""
+    dctx = (dv[:, None, :] / denom[:, None, :]) * m          # (B, W, D)
+    return win.at[windows.reshape(-1)].add(
+        dctx.reshape(-1, dctx.shape[-1]))
+
+
 def cbow_ns_step(win: jax.Array, wout: jax.Array, windows: jax.Array,
                  window_mask: jax.Array, targets_pos: jax.Array,
                  negatives: jax.Array, lr: float
                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One CBOW minibatch: windows (B, W) context ids with bool mask,
-    averaged input vectors predict targets_pos (B,)
-    (ref FeedForward average, wordembedding.cpp:57-80)."""
+    averaged input vectors predict targets_pos (B,)."""
     b, k = negatives.shape
-    ctx = jnp.take(win, windows, axis=0)                     # (B, W, D)
-    m = window_mask.astype(ctx.dtype)[..., None]
-    denom = jnp.maximum(m.sum(axis=1), 1.0)
-    v = (ctx * m).sum(axis=1) / denom                        # (B, D)
+    v, denom, m = _cbow_mean(win, windows, window_mask)
     tgt = jnp.concatenate([targets_pos[:, None], negatives], axis=1)
     u = jnp.take(wout, tgt, axis=0)
     labels = jnp.concatenate(
         [jnp.ones((b, 1), v.dtype), jnp.zeros((b, k), v.dtype)], axis=1)
     loss, dv, du = _ns_forward_backward(v, u, labels, lr)
-    # spread dv back over the (masked) window, divided like the forward mean
-    dctx = (dv[:, None, :] / denom[:, None, :]) * m          # (B, W, D)
-    win = win.at[windows.reshape(-1)].add(
-        dctx.reshape(-1, dctx.shape[-1]))
+    win = _cbow_spread(win, windows, dv, denom, m)
     wout = wout.at[tgt.reshape(-1)].add(du.reshape(-1, du.shape[-1]))
     return win, wout, loss
+
+
+def _hs_forward_backward(v: jax.Array, u: jax.Array, codes: jax.Array,
+                         path_mask: jax.Array, lr: float
+                         ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Shared hierarchical-softmax math. v: (B, D) predictor vectors;
+    u: (B, L, D) inner-node vectors along each word's Huffman path.
+    Returns (loss, dv, du), ascent deltas pre-scaled by lr."""
+    scores = jnp.einsum("bd,bld->bl", v, u)
+    sig = jax.nn.sigmoid(scores)
+    # label for Huffman: predict 1 - code (word2vec.c convention)
+    labels = (1.0 - codes.astype(v.dtype))
+    g = (labels - sig) * path_mask.astype(v.dtype) * lr
+    dv = jnp.einsum("bl,bld->bd", g, u)
+    du = g[..., None] * v[:, None, :]
+    masked = jnp.where(path_mask, scores * (1 - 2 * codes), 0.0)
+    loss = -jnp.mean(jnp.sum(jax.nn.log_sigmoid(masked)
+                             * path_mask.astype(v.dtype), axis=-1))
+    return loss, dv, du
 
 
 def skipgram_hs_step(win: jax.Array, hs_out: jax.Array, centers: jax.Array,
@@ -160,17 +189,27 @@ def skipgram_hs_step(win: jax.Array, hs_out: jax.Array, centers: jax.Array,
     """
     v = jnp.take(win, centers, axis=0)                       # (B, D)
     u = jnp.take(hs_out, points, axis=0)                     # (B, L, D)
-    scores = jnp.einsum("bd,bld->bl", v, u)
-    sig = jax.nn.sigmoid(scores)
-    # label for Huffman: predict 1 - code (word2vec.c convention)
-    labels = (1.0 - codes.astype(v.dtype))
-    g = (labels - sig) * path_mask.astype(v.dtype) * lr
-    dv = jnp.einsum("bl,bld->bd", g, u)
-    du = g[..., None] * v[:, None, :]
-    masked = jnp.where(path_mask, scores * (1 - 2 * codes), 0.0)
-    loss = -jnp.mean(jnp.sum(jax.nn.log_sigmoid(masked)
-                             * path_mask.astype(v.dtype), axis=-1))
+    loss, dv, du = _hs_forward_backward(v, u, codes, path_mask, lr)
     win = win.at[centers].add(dv)
+    hs_out = hs_out.at[points.reshape(-1)].add(
+        du.reshape(-1, du.shape[-1]))
+    return win, hs_out, loss
+
+
+def cbow_hs_step(win: jax.Array, hs_out: jax.Array, windows: jax.Array,
+                 window_mask: jax.Array, codes: jax.Array,
+                 points: jax.Array, path_mask: jax.Array, lr: float
+                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """CBOW x hierarchical softmax: the averaged window context predicts
+    the target word's Huffman path (ref wordembedding.cpp CBOW+HS branch).
+
+    windows/window_mask: (B, W); codes/points/path_mask: (B, L), the
+    TARGET word's path.
+    """
+    v, denom, m = _cbow_mean(win, windows, window_mask)
+    u = jnp.take(hs_out, points, axis=0)                     # (B, L, D)
+    loss, dv, du = _hs_forward_backward(v, u, codes, path_mask, lr)
+    win = _cbow_spread(win, windows, dv, denom, m)
     hs_out = hs_out.at[points.reshape(-1)].add(
         du.reshape(-1, du.shape[-1]))
     return win, hs_out, loss
@@ -315,30 +354,68 @@ def make_fused_cbow_epoch(cfg: W2VConfig, unigram: np.ndarray):
     return epoch_fn
 
 
-def make_fused_hs_epoch(cfg: W2VConfig, codes: np.ndarray, points: np.ndarray,
-                        lengths: np.ndarray):
-    """Hierarchical-softmax skipgram variant: the Huffman path tables live on
-    device once; each batch gathers its contexts' paths in-graph."""
+def _make_path_gather(codes: np.ndarray, points: np.ndarray,
+                      lengths: np.ndarray):
+    """Closure gathering words' Huffman paths in-graph: the path tables
+    live on device once; ``gather(ids) -> (code, point, mask)``."""
     codes_d = jnp.asarray(codes)
     points_d = jnp.asarray(points)
     lengths_d = jnp.asarray(lengths)
     max_len = codes.shape[1]
+
+    def gather(ids):
+        code = jnp.take(codes_d, ids, axis=0)
+        point = jnp.take(points_d, ids, axis=0)
+        mask = (jnp.arange(max_len)[None, :]
+                < jnp.take(lengths_d, ids)[:, None])
+        return code, point, mask
+
+    return gather
+
+
+def make_fused_hs_epoch(cfg: W2VConfig, codes: np.ndarray, points: np.ndarray,
+                        lengths: np.ndarray):
+    """Hierarchical-softmax skipgram variant: each batch gathers its
+    contexts' Huffman paths in-graph."""
+    path = _make_path_gather(codes, points, lengths)
 
     @jax.jit
     def epoch_fn(win, hs_out, centers, contexts, key):
         def body(carry, batch):
             win, hs_out = carry
             c, ctx = batch
-            code = jnp.take(codes_d, ctx, axis=0)
-            point = jnp.take(points_d, ctx, axis=0)
-            mask = (jnp.arange(max_len)[None, :]
-                    < jnp.take(lengths_d, ctx)[:, None])
+            code, point, mask = path(ctx)
             win, hs_out, loss = skipgram_hs_step(
                 win, hs_out, c, code, point, mask, cfg.learning_rate)
             return (win, hs_out), loss
 
         (win, hs_out), losses = jax.lax.scan(
             body, (win, hs_out), (centers, contexts))
+        return win, hs_out, jnp.mean(losses)
+
+    return epoch_fn
+
+
+def make_fused_cbow_hs_epoch(cfg: W2VConfig, codes: np.ndarray,
+                             points: np.ndarray, lengths: np.ndarray):
+    """CBOW x HS variant: scans (windows, masks, targets) batches; each
+    batch gathers its TARGETS' Huffman paths in-graph."""
+    path = _make_path_gather(codes, points, lengths)
+
+    @jax.jit
+    def epoch_fn(win, hs_out, windows, masks, targets, key):
+        del key  # HS draws no negatives; kept for dispatch uniformity
+
+        def body(carry, batch):
+            win, hs_out = carry
+            w, m, t = batch
+            code, point, pmask = path(t)
+            win, hs_out, loss = cbow_hs_step(
+                win, hs_out, w, m, code, point, pmask, cfg.learning_rate)
+            return (win, hs_out), loss
+
+        (win, hs_out), losses = jax.lax.scan(
+            body, (win, hs_out), (windows, masks, targets))
         return win, hs_out, jnp.mean(losses)
 
     return epoch_fn

@@ -243,6 +243,59 @@ class TestModesAndRegressions:
         # the HS output table actually trained
         assert np.linalg.norm(we.table_hs.get()) > 0
 
+    def test_cbow_hs_step_reduces_loss_and_matches_grad(self):
+        import jax
+        import jax.numpy as jnp
+        counts = np.array([40, 20, 10, 8, 6, 4])
+        codes, points, lengths = build_huffman(counts)
+        v, d, b, w = len(counts), 8, 12, 4
+        rng = np.random.default_rng(3)
+        win, _ = map(jnp.asarray, w2v.init_embeddings(w2v.W2VConfig(v, d)))
+        hs_out = jnp.asarray(rng.normal(0, 0.1, (v - 1, d)), jnp.float32)
+        windows = jnp.asarray(rng.integers(0, v, (b, w)), jnp.int32)
+        wmask = jnp.asarray(rng.random((b, w)) > 0.2)
+        targets = rng.integers(0, v, b)
+        c = jnp.asarray(codes[targets]); p = jnp.asarray(points[targets])
+        m = (jnp.arange(codes.shape[1])[None, :]
+             < jnp.asarray(lengths[targets])[:, None])
+
+        # the manual ascent deltas must equal -lr * d(sum-loss)/d(params)
+        def total_loss(win, hs_out):
+            ctx = jnp.take(win, windows, axis=0)
+            mm = wmask.astype(ctx.dtype)[..., None]
+            vvec = (ctx * mm).sum(1) / jnp.maximum(mm.sum(1), 1.0)
+            u = jnp.take(hs_out, p, axis=0)
+            s = jnp.einsum("bd,bld->bl", vvec, u)
+            masked = jnp.where(m, s * (1 - 2 * c), 0.0)
+            # per-sample sum (the step's g has no 1/B factor)
+            return -jnp.sum(jax.nn.log_sigmoid(masked) * m)
+
+        lr = 0.2
+        gw, gh = jax.grad(total_loss, argnums=(0, 1))(win, hs_out)
+        win2, hs2, _ = w2v.cbow_hs_step(win, hs_out, windows, wmask,
+                                        c, p, m, lr)
+        np.testing.assert_allclose(np.asarray(win2 - win),
+                                   np.asarray(-lr * gw), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(hs2 - hs_out),
+                                   np.asarray(-lr * gh), atol=1e-5)
+
+        l0 = None
+        for _ in range(30):
+            win, hs_out, loss = w2v.cbow_hs_step(
+                win, hs_out, windows, wmask, c, p, m, lr)
+            l0 = l0 or float(loss)
+        assert float(loss) < l0
+
+    def test_cbow_hs_fused(self):
+        tokens = self._tokens()
+        cfg = WEConfig(size=16, min_count=5, batch_size=256, cbow=1, hs=1)
+        d = Dictionary.build(tokens, cfg.min_count)
+        we = WordEmbedding(cfg, d)
+        stats = we.train_fused(we.prepare_ids(tokens), epochs=1)
+        assert stats["loss"] > 0
+        assert np.linalg.norm(we.table_hs.get()) > 0
+        assert np.linalg.norm(we.embeddings()) > 0
+
     def test_ps_blocks_reject_cbow_hs(self):
         tokens = self._tokens()
         cfg = WEConfig(size=16, min_count=5, batch_size=128, cbow=1)
