@@ -291,6 +291,118 @@ def make_train_step(cfg: TransformerConfig, learning_rate: float = 1e-2):
     return step
 
 
+def generate(params: Dict[str, Any], prompt: jax.Array,
+             cfg: TransformerConfig, max_new_tokens: int,
+             temperature: float = 0.0,
+             key: Optional[jax.Array] = None) -> jax.Array:
+    """Autoregressive decode with a static KV cache: one ``lax.scan`` over
+    decode steps, each step one fused single-token pass (no recompute of
+    the prefix). Greedy at ``temperature=0.0``, else samples with ``key``.
+
+    prompt: [B, P] int32 -> returns [B, P + max_new_tokens]. Decoding is
+    inherently sequential so there is no sequence axis here (dense configs
+    only: attn is ignored); run it data-parallel by sharding B.
+    """
+    if cfg.moe_experts:
+        raise NotImplementedError("generate() supports dense MLPs only")
+    b, p = prompt.shape
+    h, d = cfg.num_heads, cfg.dim
+    hd = d // h
+    L = cfg.num_layers
+    total = p + max_new_tokens
+    if p < 1:
+        raise ValueError("prompt must contain at least one token (an "
+                         "empty prompt would decode from placeholder "
+                         "logits)")
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    if total > cfg.max_seq:
+        raise ValueError(f"prompt + new tokens = {total} exceeds "
+                         f"max_seq={cfg.max_seq}")
+    if temperature > 0.0 and key is None:
+        raise ValueError("sampling (temperature > 0) needs a PRNG key")
+    neg_inf = jnp.asarray(-1e30, jnp.float32)
+
+    def step_token(caches, tok, t):
+        """One token through all layers, reading/updating the KV cache.
+        caches: dict of [L, B, H, max, hd]; tok [B]; t scalar position."""
+        x = params["embed"][tok] + params["pos"][t]          # [B, D]
+
+        def layer(carry, inputs):
+            x, = carry
+            pl, ck, cv = inputs
+            y = _rmsnorm(x, pl["ln1"])
+            qkv = y @ pl["wqkv"]                             # [B, 3D]
+            q, kk, vv = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(b, h, hd)
+            kk = kk.reshape(b, h, hd)
+            vv = vv.reshape(b, h, hd)
+            ck = jax.lax.dynamic_update_slice_in_dim(
+                ck, kk[:, :, None], t, axis=2)               # [B,H,max,hd]
+            cv = jax.lax.dynamic_update_slice_in_dim(
+                cv, vv[:, :, None], t, axis=2)
+            # f32 score/output accumulation, matching reference_attention's
+            # preferred_element_type so bf16 greedy decode agrees with
+            # forward()
+            s = jnp.einsum("bhd,bhkd->bhk", q, ck,
+                           preferred_element_type=jnp.float32)
+            s = s / (hd ** 0.5)
+            live = jnp.arange(cfg.max_seq)[None, None] <= t
+            s = jnp.where(live, s, neg_inf)
+            pattn = jax.nn.softmax(s, -1).astype(cv.dtype)
+            o = jnp.einsum("bhk,bhkd->bhd", pattn, cv).reshape(b, d)
+            x = x + o @ pl["wo"]
+            y = _rmsnorm(x, pl["ln2"])
+            y = jax.nn.gelu(y @ pl["w1"])
+            return (x + y @ pl["w2"],), (ck, cv)
+
+        (x,), (ck, cv) = jax.lax.scan(
+            layer, (x,), (params["layers"], caches["k"], caches["v"]))
+        x = _rmsnorm(x, params["ln_f"])
+        logits = jnp.einsum("bd,vd->bv", x, params["embed"],
+                            preferred_element_type=jnp.float32)
+        return {"k": ck, "v": cv}, logits
+
+    caches = {
+        "k": jnp.zeros((L, b, h, cfg.max_seq, hd), cfg.dtype),
+        "v": jnp.zeros((L, b, h, cfg.max_seq, hd), cfg.dtype),
+    }
+    # prefill: feed prompt tokens one at a time (simple; prompt lengths
+    # here are small — a batched prefill pass is the known optimization)
+    def prefill(carry, i):
+        caches, last = carry
+        caches, logits = step_token(caches, prompt[:, i], i)
+        return (caches, logits), None
+
+    (caches, logits), _ = jax.lax.scan(
+        prefill, (caches, jnp.zeros((b, cfg.vocab_size), jnp.float32)),
+        jnp.arange(p))
+
+    def pick(logits, k):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, -1).astype(prompt.dtype)
+        return jax.random.categorical(
+            k, logits / temperature).astype(prompt.dtype)
+
+    def decode(carry, i):
+        caches, logits, k = carry
+        k, sub = jax.random.split(k)
+        tok = pick(logits, sub)
+        caches, logits = step_token(caches, tok, p + i)
+        return (caches, logits, k), tok
+
+    # scan max_new_tokens - 1 steps; the final token needs only the last
+    # logits, not another forward pass
+    k0 = key if key is not None else jax.random.key(0)
+    (_, logits, kf), new = jax.lax.scan(
+        decode, (caches, logits, k0), jnp.arange(max_new_tokens - 1))
+    _, sub = jax.random.split(kf)
+    last = pick(logits, sub)
+    new = (jnp.concatenate([new.T, last[:, None]], axis=1)
+           if max_new_tokens > 1 else last[:, None])
+    return jnp.concatenate([prompt, new], axis=1)
+
+
 def shard_batch(tokens: np.ndarray, cfg: TransformerConfig,
                 mesh=None) -> jax.Array:
     """device_put a [B, S] token batch sharded P(batch_axis, seq_axis).

@@ -335,3 +335,72 @@ class TestZigzagTransformer:
         other = Mesh(devices.reshape(4, 2), ("dp", "sp"))
         with pytest.raises(ValueError, match="Zoo mesh"):
             tf.shard_batch(np.zeros((2, 16), np.int32), cfg, other)
+
+
+class TestGenerate:
+    def test_greedy_matches_full_forward(self):
+        mv.init()
+        cfg = tf.TransformerConfig(vocab_size=32, dim=16, num_heads=2,
+                                   num_layers=2, max_seq=24, attn="local")
+        params = tf.init_params(cfg, seed=0)
+        rng = np.random.default_rng(12)
+        prompt = jnp.asarray(rng.integers(0, 32, (2, 4)), jnp.int32)
+        with jax.default_matmul_precision("float32"):
+            out = tf.generate(params, prompt, cfg, max_new_tokens=6)
+            # oracle: re-run the full forward on each growing prefix
+            seq = np.asarray(prompt)
+            for _ in range(6):
+                logits = tf.forward(params, jnp.asarray(seq), cfg)
+                nxt = np.argmax(np.asarray(logits[:, -1]), -1)
+                seq = np.concatenate([seq, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(np.asarray(out), seq)
+
+    def test_sampling_reproducible_and_in_range(self):
+        mv.init()
+        cfg = tf.TransformerConfig(vocab_size=32, dim=16, num_heads=2,
+                                   num_layers=1, max_seq=16, attn="local")
+        params = tf.init_params(cfg, seed=1)
+        prompt = jnp.zeros((1, 2), jnp.int32)
+        k = jax.random.key(7)
+        a = tf.generate(params, prompt, cfg, 8, temperature=1.0, key=k)
+        b = tf.generate(params, prompt, cfg, 8, temperature=1.0, key=k)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert np.asarray(a).max() < 32 and np.asarray(a).min() >= 0
+        assert a.shape == (1, 10)
+
+    def test_bfloat16_generate_matches_forward(self):
+        mv.init()
+        cfg = tf.TransformerConfig(vocab_size=32, dim=16, num_heads=2,
+                                   num_layers=2, max_seq=16, attn="local",
+                                   dtype=jnp.bfloat16)
+        params = tf.init_params(cfg, seed=3)
+        prompt = jnp.asarray([[5, 9, 2]], jnp.int32)
+        out = tf.generate(params, prompt, cfg, max_new_tokens=4)
+        assert out.shape == (1, 7)
+        seq = np.asarray(prompt)
+        for _ in range(4):
+            logits = tf.forward(params, jnp.asarray(seq), cfg)
+            nxt = np.argmax(np.asarray(logits[:, -1], np.float32), -1)
+            seq = np.concatenate([seq, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(np.asarray(out), seq)
+
+    def test_single_token_and_empty_prompt(self):
+        mv.init()
+        cfg = tf.TransformerConfig(vocab_size=32, dim=16, num_heads=2,
+                                   num_layers=1, max_seq=8, attn="local")
+        params = tf.init_params(cfg)
+        out = tf.generate(params, jnp.zeros((1, 2), jnp.int32), cfg, 1)
+        assert out.shape == (1, 3)
+        with pytest.raises(ValueError, match="at least one token"):
+            tf.generate(params, jnp.zeros((1, 0), jnp.int32), cfg, 2)
+
+    def test_rejects_overlong_and_missing_key(self):
+        mv.init()
+        cfg = tf.TransformerConfig(vocab_size=32, dim=16, num_heads=2,
+                                   num_layers=1, max_seq=8, attn="local")
+        params = tf.init_params(cfg)
+        prompt = jnp.zeros((1, 6), jnp.int32)
+        with pytest.raises(ValueError, match="max_seq"):
+            tf.generate(params, prompt, cfg, 4)
+        with pytest.raises(ValueError, match="PRNG"):
+            tf.generate(params, prompt, cfg, 1, temperature=0.5)
