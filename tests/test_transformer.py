@@ -404,3 +404,14 @@ class TestGenerate:
             tf.generate(params, prompt, cfg, 4)
         with pytest.raises(ValueError, match="PRNG"):
             tf.generate(params, prompt, cfg, 1, temperature=0.5)
+
+
+def test_transformer_ps_example_trains():
+    import pathlib
+    import runpy
+    path = (pathlib.Path(__file__).resolve().parents[1]
+            / "examples" / "transformer_ps.py")
+    mod = runpy.run_path(str(path))
+    final = mod["main"](steps=30, sync_every=5)
+    # untrained loss is ln(64) ~= 4.16; demand real learning
+    assert np.isfinite(final) and final < 3.0
