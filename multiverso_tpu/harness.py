@@ -39,6 +39,9 @@ config.define_int("procid", -1, "internal: process id of a relaunched "
                   "battery process")
 config.define_int("local_devices", 2, "virtual CPU devices per battery "
                   "process in -nprocs mode")
+config.define_bool("cpu", False, "force the single-process battery onto a "
+                   "virtual 8-device CPU mesh instead of the default "
+                   "platform (use when the TPU tunnel is unavailable)")
 config.define_int("rows", 100_000, "num_row for the perf tests (ref default "
                   "1000000, Test/main.cpp:357)")
 config.define_int("iters", 3, "outer iterations for array/matrix tests")
@@ -319,11 +322,24 @@ def main(argv: Optional[List[str]] = None) -> int:
     from multiverso_tpu.utils.platform import apply_platform_env
     apply_platform_env()
     argv = list(sys.argv[1:] if argv is None else argv)
+    # accept the natural bare form of the boolean flag
+    argv = ["-cpu=true" if a == "-cpu" else a for a in argv]
     cmds = [a for a in argv if not a.startswith("-")]
     flags = [a for a in argv if a.startswith("-")]
+
+    def maybe_force_cpu() -> None:
+        if config.get_flag("cpu"):
+            from multiverso_tpu.utils.platform import force_cpu_mesh
+            if not force_cpu_mesh(8):
+                log.error("-cpu requested but a JAX backend is already "
+                          "initialized; battery would run on the default "
+                          "platform")
+                raise SystemExit(3)
+
     if not cmds:
         # ref: argc==1 -> bare MV_Init/MV_ShutDown smoke (Test/main.cpp:500)
         config.parse_cmd_flags(["prog", *flags])
+        maybe_force_cpu()
         mv = _init()
         mv.shutdown()
         print("HARNESS PASS init")
@@ -348,9 +364,9 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if procid >= 0:  # child of _spawn_cluster
         import jax
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices",
-                          config.get_flag("local_devices"))
+
+        from multiverso_tpu.utils.platform import force_cpu_mesh
+        force_cpu_mesh(config.get_flag("local_devices"))
         try:
             jax.distributed.initialize(
                 coordinator_address=config.get_flag("coordinator"),
@@ -358,6 +374,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         except Exception as e:  # environment without jax.distributed
             log.error("jax.distributed unavailable: %s", e)
             return 77  # conventional skip code, consumed by _spawn_cluster
+
+    if procid < 0:
+        maybe_force_cpu()
 
     names = _ALL if cmd == "all" else cmds
     for name in names:

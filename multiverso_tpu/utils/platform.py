@@ -15,18 +15,20 @@ import os
 import re
 
 
-def force_cpu_mesh(n_devices: int = 8) -> None:
+def force_cpu_mesh(n_devices: int = 8) -> bool:
     """Point JAX at an n-device virtual CPU mesh (the test/dryrun fixture:
-    SURVEY §4's "mpirun -np N on one host" analogue). Best-effort no-op if a
-    backend is already live."""
+    SURVEY §4's "mpirun -np N on one host" analogue). Returns False (instead
+    of raising) if a backend is already live — callers honoring an explicit
+    user request should surface that."""
     import jax
 
     try:
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", n_devices)
         os.environ["JAX_PLATFORMS"] = "cpu"
+        return True
     except (RuntimeError, AttributeError):
-        pass
+        return False
 
 
 def apply_platform_env() -> None:
