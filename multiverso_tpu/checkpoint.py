@@ -48,7 +48,7 @@ def _manifest_entry(table) -> Dict:
 
 
 def save(directory: str, tag: str = "checkpoint",
-         backend: str = "stream") -> str:
+         backend: str = "stream", block: bool = True) -> str:
     """Write every registered table (data + updater state) under
     ``directory/tag/``. Returns the checkpoint path.
 
@@ -56,11 +56,22 @@ def save(directory: str, tag: str = "checkpoint",
     ``backend="orbax"`` delegates the array payloads to Orbax — sharded,
     parallel per-shard IO, the industry-standard TPU checkpoint layout —
     while keeping the same manifest for name/shape validation.
+    ``block=False`` (orbax only) returns as soon as the on-device state is
+    snapshotted and writes in the background; the checkpoint becomes
+    visible (manifest written, ``latest()`` sees it) only when
+    :func:`wait_pending` runs — the next save/restore does this
+    automatically.
     """
     if backend == "orbax":
-        return _save_orbax(directory, tag)
+        return _save_orbax(directory, tag, block)
     if backend != "stream":
         raise ValueError(f"unknown checkpoint backend {backend!r}")
+    if not block:
+        raise ValueError("block=False requires backend='orbax' (the stream "
+                         "format writes synchronously)")
+    # finalize any in-flight async save so manifest mtimes (latest()'s
+    # ordering) can't invert across a backend switch
+    wait_pending()
     zoo = Zoo.get()
     path = _join(directory, tag)
     manifest = {"tables": {}, "version": 1}
@@ -102,6 +113,7 @@ def restore(directory: str, tag: str = "checkpoint") -> int:
     The backend is auto-detected from the manifest, so a loop can switch
     formats and still resume. Returns the number of tables restored.
     """
+    wait_pending()  # finalize any in-flight async save first
     zoo = Zoo.get()
     path = _join(directory, tag)
     with open_stream(_join(path, "manifest.json"), "rb") as s:
@@ -149,14 +161,63 @@ def _arrays_path(path: str) -> str:
     return os.path.abspath(os.path.join(local, "arrays"))
 
 
-def _save_orbax(directory: str, tag: str) -> str:
+_async_ckptr = None                 # lazily-created AsyncCheckpointer
+_pending = []                       # [(path, manifest)] awaiting finalize
+
+
+def _get_async_ckptr():
+    global _async_ckptr
+    if _async_ckptr is None:
+        import orbax.checkpoint as ocp
+        _async_ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+    return _async_ckptr
+
+
+def wait_pending() -> int:
+    """Block until in-flight ``block=False`` saves finish, then finalize
+    them (write manifests, making them visible to restore/``latest``).
+    Returns the number finalized."""
+    global _pending
+    if not _pending:
+        return 0
+    try:
+        _get_async_ckptr().wait_until_finished()
+    except Exception:
+        # a failed background write must not wedge every later call nor
+        # ever become visible: discard the unfinalized checkpoints (restore
+        # falls back to the previous finalized one) and surface the error
+        dropped = [p for p, _ in _pending]
+        _pending = []
+        log.error("async checkpoint write failed; discarded unfinalized "
+                  "checkpoints: %s", dropped)
+        raise
+    zoo = Zoo.get()
+    done = 0
+    for path, manifest in _pending:
+        if zoo.rank() == 0:
+            with open_stream(_join(path, "manifest.json"), "wb") as s:
+                s.write(json.dumps(manifest, indent=2).encode())
+            log.info("checkpoint finalized (orbax async): %s", path)
+        done += 1
+    _pending = []
+    zoo.barrier()
+    return done
+
+
+def _save_orbax(directory: str, tag: str, block: bool = True) -> str:
     import orbax.checkpoint as ocp
 
+    wait_pending()  # at most one async save in flight
     zoo = Zoo.get()
     path = _join(directory, tag)
     tree = _orbax_tree(zoo)
-    with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(_arrays_path(path), tree, force=True)
+    if block:
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(_arrays_path(path), tree, force=True)
+    else:
+        _get_async_ckptr().save(_arrays_path(path),
+                                args=ocp.args.StandardSave(tree),
+                                force=True)
     manifest = {"version": 1, "backend": "orbax", "tables": {}}
     for tid, t in zoo.tables().items():
         if hasattr(t, "state"):
@@ -165,12 +226,17 @@ def _save_orbax(directory: str, tag: str) -> str:
         elif hasattr(t, "store"):
             # host-side tables (e.g. KVTable) have no device state pytree;
             # they ride the stream format inside the same checkpoint
+            # (written synchronously — they are tiny host dicts)
             fname = f"{t.name}.{tid}.mvt"
             if zoo.rank() == 0:
                 with open_stream(_join(path, fname), "wb") as s:
                     t.store(s)
             manifest["tables"][str(tid)] = dict(_manifest_entry(t),
                                                 kind="stream", file=fname)
+    if not block:
+        # manifest (the visibility marker) is deferred to wait_pending()
+        _pending.append((path, manifest))
+        return path
     if zoo.rank() == 0:
         with open_stream(_join(path, "manifest.json"), "wb") as s:
             s.write(json.dumps(manifest, indent=2).encode())

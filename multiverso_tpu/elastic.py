@@ -138,14 +138,17 @@ class ElasticLoop:
 
     def __init__(self, directory: str, every: int = 100,
                  keep: int = 2, heartbeat_interval: float = 5.0,
-                 backend: str = "stream"):
+                 backend: str = "stream", block: bool = True):
         if backend not in checkpoint.BACKENDS:
             raise ValueError(f"unknown checkpoint backend {backend!r}; "
                              f"choose from {checkpoint.BACKENDS}")
+        if not block and backend != "orbax":
+            raise ValueError("block=False needs backend='orbax'")
         self.directory = directory
         self.every = max(1, int(every))
         self.keep = max(1, int(keep))
         self.backend = backend
+        self.block = block
         self.heartbeat = Heartbeat(
             os.path.join(directory, "heartbeats"),
             interval=heartbeat_interval).start()
@@ -153,6 +156,7 @@ class ElasticLoop:
     def resume(self) -> int:
         """Restore the newest valid checkpoint; return the step to resume
         FROM (one past the checkpointed step; 0 if none)."""
+        checkpoint.wait_pending()
         tag = checkpoint.latest(self.directory)
         if tag is None or not tag.startswith("step_"):
             return 0
@@ -169,7 +173,7 @@ class ElasticLoop:
         if (step + 1) % self.every:
             return False
         checkpoint.save(self.directory, self.TAG.format(step=step),
-                        backend=self.backend)
+                        backend=self.backend, block=self.block)
         self._prune()
         return True
 
@@ -185,4 +189,5 @@ class ElasticLoop:
             shutil.rmtree(os.path.join(self.directory, tag))
 
     def stop(self) -> None:
+        checkpoint.wait_pending()  # finalize an in-flight async save
         self.heartbeat.stop()

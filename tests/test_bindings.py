@@ -143,6 +143,35 @@ class TestCheckpoint:
         np.testing.assert_allclose(t.get(), snap)
         np.testing.assert_allclose(extra.get(), np.full(8, 3.0))
 
+    def test_async_orbax_save_finalizes_on_wait(self, tmp_path):
+        t = mv.ArrayTable(32, name="async_t")
+        t.add(np.ones(32, np.float32))
+        snap = t.get().copy()
+        checkpoint.save(str(tmp_path), tag="a0", backend="orbax",
+                        block=False)
+        # invisible until finalized: no manifest yet
+        assert checkpoint.latest(str(tmp_path)) is None
+        assert checkpoint.wait_pending() == 1
+        assert checkpoint.latest(str(tmp_path)) == "a0"
+        t.add(np.ones(32, np.float32))
+        checkpoint.restore(str(tmp_path), tag="a0")
+        np.testing.assert_allclose(t.get(), snap)
+
+    def test_restore_waits_for_inflight_async_save(self, tmp_path):
+        t = mv.ArrayTable(16, name="async_u")
+        t.add(np.full(16, 2.0, np.float32))
+        checkpoint.save(str(tmp_path), tag="u0", backend="orbax",
+                        block=False)
+        t.add(np.ones(16, np.float32))
+        # restore finalizes the pending save itself, no explicit wait
+        checkpoint.restore(str(tmp_path), tag="u0")
+        np.testing.assert_allclose(t.get(), np.full(16, 2.0))
+
+    def test_async_requires_orbax(self, tmp_path):
+        mv.ArrayTable(8, name="async_v")
+        with pytest.raises(ValueError, match="orbax"):
+            checkpoint.save(str(tmp_path), tag="x", block=False)
+
     def test_unknown_backend_raises(self, tmp_path):
         mv.ArrayTable(8, name="bk")
         with pytest.raises(ValueError, match="backend"):
@@ -213,3 +242,14 @@ class TestCAPI:
         out = (ctypes.c_float * 6)()
         lib.MV_GetMatrixTableByRows(h, out, 6, ids, 2)
         np.testing.assert_allclose(list(out), 1.5)
+
+
+def test_stream_save_finalizes_pending_async(tmp_path):
+    import multiverso_tpu as mv
+    t = mv.ArrayTable(16, name="mix_t")
+    t.add(np.ones(16, np.float32))
+    checkpoint.save(str(tmp_path), tag="a", backend="orbax", block=False)
+    # a stream save must finalize 'a' first so latest() ordering holds
+    checkpoint.save(str(tmp_path), tag="b", backend="stream")
+    assert checkpoint.latest(str(tmp_path)) == "b"
+    assert checkpoint.wait_pending() == 0  # already finalized

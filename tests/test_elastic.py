@@ -63,12 +63,14 @@ class TestElasticLoop:
             table.add(np.full(table.shape, 1.0, np.float32))
             loop.completed(step)
 
-    @pytest.mark.parametrize("backend", ["stream", "orbax"])
-    def test_resume_restores_table_state(self, tmp_path, backend):
+    @pytest.mark.parametrize("backend,block", [("stream", True),
+                                               ("orbax", True),
+                                               ("orbax", False)])
+    def test_resume_restores_table_state(self, tmp_path, backend, block):
         ckpt = str(tmp_path / "run")
         table = mv.ArrayTable(16, name="elastic_t")
         loop = elastic.ElasticLoop(ckpt, every=3, heartbeat_interval=60,
-                                   backend=backend)
+                                   backend=backend, block=block)
         assert loop.resume() == 0
         self._train(table, loop, 0, 10)  # checkpoints after steps 2,5,8
         loop.stop()
@@ -78,7 +80,7 @@ class TestElasticLoop:
         mv.init()
         table2 = mv.ArrayTable(16, name="elastic_t")
         loop2 = elastic.ElasticLoop(ckpt, every=3, heartbeat_interval=60,
-                                    backend=backend)
+                                    backend=backend, block=block)
         start = loop2.resume()
         assert start == 9  # step 8 was the last checkpoint
         np.testing.assert_allclose(table2.get(), np.full(16, 9.0))
