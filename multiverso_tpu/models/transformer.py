@@ -152,6 +152,20 @@ def shard_params_moe(params: Dict[str, Any], cfg: TransformerConfig,
     return tp_lib.shard_params(params, rules, mesh)
 
 
+def shard_params_fsdp(params: Dict[str, Any], cfg: TransformerConfig,
+                      mesh=None, axis: str = "fsdp") -> Dict[str, Any]:
+    """Place params FSDP-sharded over ``axis`` (see
+    parallel/tp.transformer_fsdp_rules): each chip stores 1/n of every
+    large tensor; combine with ``batch_axis=axis`` on the config so the
+    same chips compute data-parallel. Works for dense and MoE param trees
+    (the signature matches shard_params_tp/shard_params_moe)."""
+    from multiverso_tpu.parallel import tp as tp_lib
+    return tp_lib.shard_params(
+        params, tp_lib.transformer_fsdp_rules(axis,
+                                              moe=bool(cfg.moe_experts)),
+        mesh)
+
+
 def shard_params_tp(params: Dict[str, Any], cfg: TransformerConfig,
                     mesh=None) -> Dict[str, Any]:
     """Place params Megatron-sharded over ``cfg.tp_axis`` (see parallel/tp)."""

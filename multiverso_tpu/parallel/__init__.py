@@ -8,7 +8,8 @@ from multiverso_tpu.parallel.moe import (
     MoEConfig, init_experts, moe_layer, shard_experts)
 from multiverso_tpu.parallel.pipeline import pipeline_apply, shard_stages
 from multiverso_tpu.parallel.tp import (
-    column_parallel, mlp_block, row_parallel, transformer_tp_rules)
+    column_parallel, mlp_block, row_parallel, transformer_fsdp_rules,
+    transformer_tp_rules)
 
 __all__ = [
     "all_gather", "all_reduce", "broadcast", "reduce_scatter",
@@ -17,5 +18,6 @@ __all__ = [
     "zigzag_ring_attention", "zigzag_shard_ids",
     "MoEConfig", "init_experts", "moe_layer", "shard_experts",
     "pipeline_apply", "shard_stages",
-    "column_parallel", "mlp_block", "row_parallel", "transformer_tp_rules",
+    "column_parallel", "mlp_block", "row_parallel", "transformer_fsdp_rules",
+    "transformer_tp_rules",
 ]

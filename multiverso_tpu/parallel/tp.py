@@ -49,6 +49,41 @@ def transformer_tp_rules(axis: str = "tp") -> Dict[str, Any]:
     }
 
 
+def transformer_fsdp_rules(axis: str = "fsdp",
+                           moe: bool = False) -> Dict[str, Any]:
+    """FSDP / ZeRO-3 layout for models/transformer.py params: every large
+    leaf is split on one dimension over the data-parallel axis, so each
+    chip STORES 1/n of the model while computing on its own batch shard
+    (set ``batch_axis=axis`` too). XLA inserts the all-gather on use and
+    the reduce-scatter on the gradients — the scaling-book FSDP recipe,
+    no hand-written comms. Tiny norm vectors stay replicated. ``moe=True``
+    matches the MoE param tree (expert stacks split on their model dim,
+    leaving the expert dim free for a separate ep axis)."""
+    layers = {
+        "wqkv": P(None, axis, None),
+        "wo": P(None, axis, None),
+        "ln1": P(None, None),
+        "ln2": P(None, None),
+    }
+    if moe:
+        layers.update({
+            "moe_w1": P(None, None, axis, None),
+            "moe_w2": P(None, None, axis, None),
+            "moe_router": P(None, axis, None),
+        })
+    else:
+        layers.update({
+            "w1": P(None, axis, None),
+            "w2": P(None, axis, None),
+        })
+    return {
+        "embed": P(axis, None),
+        "pos": P(axis, None),
+        "layers": layers,
+        "ln_f": P(None),
+    }
+
+
 def shard_params(params: Any, rules: Any,
                  mesh: Optional[Mesh] = None) -> Any:
     """device_put a param pytree according to a matching PartitionSpec tree."""
