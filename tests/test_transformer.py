@@ -415,3 +415,46 @@ def test_transformer_ps_example_trains():
     final = mod["main"](steps=30, sync_every=5)
     # untrained loss is ln(64) ~= 4.16; demand real learning
     assert np.isfinite(final) and final < 3.0
+
+
+class TestRematAndOptax:
+    def test_remat_loss_and_grads_match(self):
+        mv.init()
+        base = tf.TransformerConfig(vocab_size=64, dim=32, num_heads=4,
+                                    num_layers=3, max_seq=16, attn="local")
+        params = tf.init_params(base, seed=4)
+        rng = np.random.default_rng(13)
+        tok = jnp.asarray(rng.integers(0, 64, (2, 16)), jnp.int32)
+        tgt = jnp.asarray(rng.integers(0, 64, (2, 16)), jnp.int32)
+        rcfg = base._replace(remat=True)
+        with jax.default_matmul_precision("float32"):
+            l0, g0 = jax.value_and_grad(tf.loss_fn)(params, tok, tgt, base)
+            l1, g1 = jax.value_and_grad(tf.loss_fn)(params, tok, tgt, rcfg)
+        np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_optax_adamw_trains_sharded(self):
+        import optax
+        devices = np.asarray(jax.devices())
+        mesh = Mesh(devices, ("fsdp",))
+        mv.init(mesh=mesh)
+        cfg = tf.TransformerConfig(vocab_size=64, dim=32, num_heads=4,
+                                   num_layers=2, max_seq=16, attn="local",
+                                   batch_axis="fsdp", remat=True)
+        params = tf.shard_params_fsdp(tf.init_params(cfg, seed=5), cfg)
+        optimizer = optax.adamw(3e-3)
+        opt_state = optimizer.init(params)
+        step = jax.jit(tf.make_optax_train_step(cfg, optimizer))
+        rng = np.random.default_rng(14)
+        toks = rng.integers(0, 64, (8, 17)).astype(np.int32)
+        tok = tf.shard_batch(toks[:, :-1], cfg, mesh)
+        tgt = tf.shard_batch(toks[:, 1:], cfg, mesh)
+        losses = []
+        for _ in range(40):
+            params, opt_state, loss = step(params, opt_state, tok, tgt)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.7, losses[::10]
+        mu = opt_state[0].mu["embed"]
+        assert {s.data.shape[0] for s in mu.addressable_shards} == {64 // 8}
