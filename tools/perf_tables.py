@@ -28,8 +28,12 @@ def timeit(fn, n=10):
 
 
 def main():
-    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
-    cols = int(sys.argv[2]) if len(sys.argv) > 2 else 50
+    args = sys.argv[1:]
+    if any(not a.isdigit() for a in args):  # incl. -h/--help/negatives
+        print(__doc__)
+        return
+    rows = int(args[0]) if len(args) > 0 else 1_000_000
+    cols = int(args[1]) if len(args) > 1 else 50
     mv.init()
     rng = np.random.default_rng(0)
 
