@@ -67,3 +67,55 @@ def test_two_process_cluster():
         assert r["matrix_rows"] == [[3.0] * 4, [3.0] * 4]
         # sharedvar: both workers pushed +1 -> merged value 2 everywhere
         assert r["sharedvar"] == [2.0, 2.0, 2.0, 2.0]
+
+
+_SSP_WORKER = """
+import json, sys, time
+sys.path.insert(0, {repo!r})
+from multiverso_tpu.ssp import SSPClock
+
+wid = int(sys.argv[1])
+clk = SSPClock({clocks!r}, staleness=1, num_workers=2, worker_id=wid,
+               poll=0.005, timeout=30.0)
+history = []
+for _ in range(10):
+    time.sleep(0.0 if wid == 0 else 0.02)   # worker 0 is the fast one
+    c = clk.tick()
+    history.append([c, min(clk.peer_clocks().values())])
+print("RESULT " + json.dumps(history))
+"""
+
+
+def test_two_process_ssp_bound(tmp_path):
+    """Two real processes under the staleness-1 bound: neither may return
+    from tick(c) while the other is below c - 1."""
+    clocks = str(tmp_path / "clocks")
+    script = _SSP_WORKER.format(repo=_REPO, clocks=clocks)
+    procs = [subprocess.Popen([sys.executable, "-c", script, str(wid)],
+                              stdout=subprocess.PIPE, text=True)
+             for wid in range(2)]
+    histories = {}
+    try:
+        for wid, p in enumerate(procs):
+            try:
+                stdout, _ = p.communicate(timeout=120)
+            except subprocess.TimeoutExpired:
+                pytest.fail(f"ssp worker {wid} timed out (bound deadlock?)")
+            assert p.returncode == 0
+            for line in stdout.splitlines():
+                if line.startswith("RESULT "):
+                    histories[wid] = json.loads(line[len("RESULT "):])
+    finally:
+        for p in procs:  # no orphans on any failure path
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    assert set(histories) == {0, 1}
+    for wid, hist in histories.items():
+        assert len(hist) == 10
+        for clock, min_peer in hist:
+            assert min_peer >= clock - 1, (wid, clock, min_peer)
+    # the fast worker must have actually been held back by the bound at
+    # some point (otherwise the test proves nothing)
+    fast = histories[0]
+    assert any(clock - min_peer >= 1 for clock, min_peer in fast)
