@@ -94,3 +94,14 @@ def test_timer():
     assert t.elapse() >= 5.0
     t.start()
     assert t.elapse() < 5.0
+
+
+def test_documentation_citations_resolve():
+    import importlib.util
+    import pathlib
+    path = (pathlib.Path(__file__).resolve().parents[1]
+            / "tools" / "check_parity.py")
+    spec = importlib.util.spec_from_file_location("check_parity", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.check() == []

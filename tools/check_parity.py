@@ -1,0 +1,78 @@
+"""Validate PARITY.md / ARCHITECTURE.md code citations.
+
+Every `path/to/file.py:NN` (or `:NN-MM`) citation must point at an existing
+file with at least NN lines, so the component-inventory claims stay
+checkable as the code moves. Run: python tools/check_parity.py
+(exit 0 = all citations resolve; also exercised by tests/test_utils.py).
+"""
+
+import os
+import re
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_DOCS = ("PARITY.md", "ARCHITECTURE.md", "README.md")
+# spans: NN, NN-MM, and comma lists thereof (e.g. `table.py:83,241`)
+_CITE = re.compile(r"`([\w/\.]+\.(?:py|cpp|h|lua)):"
+                   r"(\d+(?:-\d+)?(?:,\d+(?:-\d+)?)*)`")
+# upstream-reference directory layout: these resolve against the read-only
+# /root/reference mount and are skipped (not silently passed off as in-repo
+# files) when the mount is absent
+_REF_PREFIXES = ("src/", "include/", "binding/", "Applications/", "Test/")
+
+
+def _line_count(path, cache={}):
+    if path not in cache:
+        with open(path) as f:
+            cache[path] = sum(1 for _ in f)
+    return cache[path]
+
+
+def check(docs=_DOCS) -> list:
+    """Return [(doc, citation, problem)] for every unresolvable citation."""
+    problems = []
+    for doc in docs:
+        doc_path = os.path.join(_REPO, doc)
+        if not os.path.exists(doc_path):
+            continue
+        with open(doc_path) as f:
+            text = f.read()
+        for fname, spans in set(_CITE.findall(text)):
+            path = os.path.join(_REPO, fname)
+            if not os.path.exists(path):
+                # references into the package are often written relative
+                # to multiverso_tpu/
+                path = os.path.join(_REPO, "multiverso_tpu", fname)
+            if not os.path.exists(path):
+                if fname.startswith(_REF_PREFIXES):
+                    ref = os.path.join("/root/reference", fname)
+                    if os.path.exists(ref):
+                        path = ref
+                    elif os.path.isdir("/root/reference"):
+                        problems.append((doc, fname, "missing file"))
+                        continue
+                    else:
+                        continue  # no mount: reference cites unverifiable
+                else:
+                    problems.append((doc, fname, "missing file"))
+                    continue
+            n = _line_count(path)
+            hi = max(int(x) for x in re.split(r"[-,]", spans))
+            if hi > n:
+                problems.append((doc, f"{fname}:{spans}",
+                                 f"file has only {n} lines"))
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    if problems:
+        for doc, cite, why in problems:
+            print(f"{doc}: `{cite}` -> {why}")
+        return 1
+    print("all documentation citations resolve")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
