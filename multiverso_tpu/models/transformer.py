@@ -343,7 +343,22 @@ def generate(params: Dict[str, Any], prompt: jax.Array,
     prompt: [B, P] int32 -> returns [B, P + max_new_tokens]. Decoding is
     inherently sequential so there is no sequence axis here (dense configs
     only: attn is ignored); run it data-parallel by sharding B.
+
+    ``params`` may be an int8 weight-only tree from
+    ``ops.quantization.quantize_lm_params`` — weights stay int8 in HBM and
+    are dequantized one layer at a time inside the decode scan.
     """
+    from multiverso_tpu.ops.quantization import (QuantizedTensor,
+                                                 maybe_dequantize)
+
+    def _is_q(x):
+        return isinstance(x, QuantizedTensor)
+
+    def _rows(e, idx):
+        """Embedding-row lookup without materializing the full table."""
+        if _is_q(e):
+            return e.q[idx].astype(jnp.float32) * e.scale[idx]
+        return e[idx]
     if cfg.moe_experts:
         raise NotImplementedError("generate() supports dense MLPs only")
     b, p = prompt.shape
@@ -367,11 +382,14 @@ def generate(params: Dict[str, Any], prompt: jax.Array,
     def step_token(caches, tok, t):
         """One token through all layers, reading/updating the KV cache.
         caches: dict of [L, B, H, max, hd]; tok [B]; t scalar position."""
-        x = params["embed"][tok] + params["pos"][t]          # [B, D]
+        x = (_rows(params["embed"], tok)
+             + _rows(params["pos"], t)).astype(cfg.dtype)    # [B, D]
 
         def layer(carry, inputs):
             x, = carry
             pl, ck, cv = inputs
+            pl = jax.tree.map(lambda l: maybe_dequantize(l, cfg.dtype),
+                              pl, is_leaf=_is_q)
             y = _rmsnorm(x, pl["ln1"])
             qkv = y @ pl["wqkv"]                             # [B, 3D]
             q, kk, vv = jnp.split(qkv, 3, axis=-1)
@@ -400,8 +418,17 @@ def generate(params: Dict[str, Any], prompt: jax.Array,
         (x,), (ck, cv) = jax.lax.scan(
             layer, (x,), (params["layers"], caches["k"], caches["v"]))
         x = _rmsnorm(x, params["ln_f"])
-        logits = jnp.einsum("bd,vd->bv", x, params["embed"],
-                            preferred_element_type=jnp.float32)
+        e = params["embed"]
+        if _is_q(e):
+            # int8 operand straight into the dot (the convert fuses), then
+            # the per-row scale applied on the small [B, V] logits — the
+            # [V, D] f32 table is never materialized
+            logits = jnp.einsum("bd,vd->bv", x, e.q.astype(x.dtype),
+                                preferred_element_type=jnp.float32)
+            logits = logits * e.scale[:, 0][None]
+        else:
+            logits = jnp.einsum("bd,vd->bv", x, e,
+                                preferred_element_type=jnp.float32)
         return {"k": ck, "v": cv}, logits
 
     caches = {

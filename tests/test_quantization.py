@@ -1,0 +1,79 @@
+"""Weight-only int8 quantization (ops/quantization.py) + quantized decode."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import multiverso_tpu as mv
+from multiverso_tpu.models import transformer as tfm
+from multiverso_tpu.ops import quantization as qz
+
+
+@pytest.fixture(autouse=True)
+def _init():
+    yield
+    if mv.Zoo.get().started:
+        mv.shutdown()
+
+
+class TestQuantize:
+    def test_roundtrip_error_bound(self):
+        rng = np.random.default_rng(0)
+        w = jnp.asarray(rng.normal(0, 3.0, (64, 32)), jnp.float32)
+        t = qz.quantize(w, keep_axes=(-1,))
+        assert t.q.dtype == jnp.int8
+        assert t.scale.shape == (1, 32)
+        err = jnp.abs(qz.dequantize(t) - w)
+        assert float((err <= t.scale / 2 + 1e-6).all())
+
+    def test_stacked_per_layer_scales(self):
+        rng = np.random.default_rng(1)
+        w = jnp.asarray(rng.normal(size=(3, 16, 8)), jnp.float32)
+        t = qz.quantize(w, keep_axes=(0, -1))
+        assert t.scale.shape == (3, 1, 8)
+        np.testing.assert_allclose(np.asarray(qz.dequantize(t)),
+                                   np.asarray(w), atol=0.05)
+
+    def test_lm_tree_quantizes_matrices_keeps_norms(self):
+        cfg = tfm.TransformerConfig(vocab_size=32, dim=16, num_heads=2,
+                                    num_layers=2, max_seq=8)
+        params = tfm.init_params(cfg, seed=0)
+        qp = qz.quantize_lm_params(params)
+        assert isinstance(qp["embed"], qz.QuantizedTensor)
+        assert isinstance(qp["layers"]["wqkv"], qz.QuantizedTensor)
+        assert not isinstance(qp["layers"]["ln1"], qz.QuantizedTensor)
+        assert qp["layers"]["wqkv"].scale.shape == (2, 1, 48)
+
+
+class TestQuantizedDecode:
+    def test_trained_lm_generates_identically_after_quantization(self):
+        mv.init()
+        cfg = tfm.TransformerConfig(vocab_size=16, dim=32, num_heads=4,
+                                    num_layers=2, max_seq=32, attn="local")
+        params = tfm.init_params(cfg, seed=0)
+        seq = np.tile(np.arange(8), 5)[:33]
+        tok = jnp.asarray(np.stack([seq[:-1]] * 4), jnp.int32)
+        tgt = jnp.asarray(np.stack([seq[1:]] * 4), jnp.int32)
+        step = jax.jit(tfm.make_train_step(cfg, 0.5))
+        for _ in range(150):
+            params, loss = step(params, tok, tgt)
+        prompt = jnp.asarray([[0, 1, 2, 3]], jnp.int32)
+        full = tfm.generate(params, prompt, cfg, max_new_tokens=12)
+        quant = tfm.generate(qz.quantize_lm_params(params), prompt, cfg,
+                             max_new_tokens=12)
+        # a confidently-trained model must survive int8: same continuation
+        np.testing.assert_array_equal(np.asarray(full), np.asarray(quant))
+        expect = [(i % 8) for i in range(16)]
+        assert np.asarray(quant)[0].tolist() == expect
+
+    def test_bf16_quantized_decode_runs(self):
+        mv.init()
+        cfg = tfm.TransformerConfig(vocab_size=32, dim=16, num_heads=2,
+                                    num_layers=1, max_seq=8, attn="local",
+                                    dtype=jnp.bfloat16)
+        qp = qz.quantize_lm_params(tfm.init_params(cfg, seed=2))
+        out = tfm.generate(qp, jnp.zeros((1, 2), jnp.int32), cfg,
+                           max_new_tokens=3)
+        arr = np.asarray(out)
+        assert arr.shape == (1, 5) and arr.max() < 32 and arr.min() >= 0
