@@ -357,6 +357,14 @@ def generate(params: Dict[str, Any], prompt: jax.Array,
     def _rows(e, idx):
         """Embedding-row lookup without materializing the full table."""
         if _is_q(e):
+            want = (e.q.shape[0],) + (1,) * (e.q.ndim - 1)
+            if e.scale.shape != want:
+                # out-of-bounds gathers clamp silently, so a wrong scale
+                # layout would corrupt decoding without any error
+                raise ValueError(
+                    f"embedding QuantizedTensor needs per-row scales "
+                    f"{want}, got {e.scale.shape}; quantize embeddings "
+                    "with keep_axes=(0,) (quantize_lm_params does)")
             return e.q[idx].astype(jnp.float32) * e.scale[idx]
         return e[idx]
     if cfg.moe_experts:

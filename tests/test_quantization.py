@@ -77,3 +77,13 @@ class TestQuantizedDecode:
                            max_new_tokens=3)
         arr = np.asarray(out)
         assert arr.shape == (1, 5) and arr.max() < 32 and arr.min() >= 0
+
+    def test_generate_rejects_wrong_scale_layout(self):
+        mv.init()
+        cfg = tfm.TransformerConfig(vocab_size=32, dim=16, num_heads=2,
+                                    num_layers=1, max_seq=8, attn="local")
+        params = tfm.init_params(cfg, seed=3)
+        bad = dict(params)
+        bad["embed"] = qz.quantize(params["embed"])  # per-column: wrong
+        with pytest.raises(ValueError, match="per-row"):
+            tfm.generate(bad, jnp.zeros((1, 2), jnp.int32), cfg, 2)

@@ -1,0 +1,91 @@
+"""Randomized differential test: a long random op sequence against every
+table type must match a plain numpy model exactly (the catch-all for
+sharding/padding/bucketing/async edge cases)."""
+
+import jax
+import numpy as np
+import pytest
+
+import multiverso_tpu as mv
+
+
+@pytest.fixture(autouse=True)
+def _init():
+    mv.init()
+    yield
+    mv.shutdown()
+
+
+def test_array_table_matches_numpy_model():
+    rng = np.random.default_rng(0)
+    size = 613  # awkward: not divisible by 8 shards, exercises padding
+    t = mv.ArrayTable(size, name="fuzz_a")
+    model = np.zeros(size, np.float32)
+    pending = []
+    for step in range(60):
+        op = rng.choice(["add", "add_async", "get", "wait"])
+        if op == "add":
+            d = rng.normal(size=size).astype(np.float32)
+            t.add(d)
+            model += d
+        elif op == "add_async":
+            d = rng.normal(size=size).astype(np.float32)
+            pending.append(t.add_async(d))
+            model += d
+        elif op == "wait" and pending:
+            t.wait(pending.pop(rng.integers(len(pending))))
+        else:
+            np.testing.assert_allclose(t.get(), model, rtol=2e-5,
+                                       atol=2e-4)
+    for msg_id in pending:
+        t.wait(msg_id)
+    np.testing.assert_allclose(t.get(), model, rtol=2e-5, atol=2e-4)
+
+
+def test_matrix_table_matches_numpy_model():
+    rng = np.random.default_rng(1)
+    rows, cols = 207, 12  # awkward row count
+    t = mv.MatrixTable(rows, cols, name="fuzz_m")
+    model = np.zeros((rows, cols), np.float32)
+    for step in range(50):
+        op = rng.choice(["add", "add_rows", "get", "get_rows", "get_row"])
+        if op == "add":
+            d = rng.normal(size=(rows, cols)).astype(np.float32)
+            t.add(d)
+            model += d
+        elif op == "add_rows":
+            k = int(rng.integers(1, 40))
+            # duplicates allowed: the table accumulates them (+=), so the
+            # model must too (np.add.at, not fancy-index +=)
+            ids = rng.choice(rows, size=k, replace=True)
+            d = rng.normal(size=(k, cols)).astype(np.float32)
+            t.add_rows(ids, d)
+            np.add.at(model, ids, d)
+        elif op == "get_rows":
+            k = int(rng.integers(1, 40))
+            ids = rng.choice(rows, size=k, replace=False)
+            np.testing.assert_allclose(t.get_rows(ids), model[ids],
+                                       rtol=2e-5, atol=2e-4)
+        elif op == "get_row":
+            i = int(rng.integers(rows))
+            np.testing.assert_allclose(t.get_row(i), model[i],
+                                       rtol=2e-5, atol=2e-4)
+        else:
+            np.testing.assert_allclose(t.get(), model, rtol=2e-5,
+                                       atol=2e-4)
+
+
+def test_kv_table_matches_dict_model():
+    rng = np.random.default_rng(2)
+    t = mv.KVTable(name="fuzz_kv")
+    model = {}
+    for step in range(80):
+        if rng.random() < 0.7:
+            keys = rng.integers(0, 50, size=rng.integers(1, 6)).tolist()
+            vals = rng.integers(-5, 6, size=len(keys)).tolist()
+            t.add(keys, vals)
+            for k, v in zip(keys, vals):
+                model[k] = model.get(k, 0) + v
+        else:
+            for k, v in model.items():
+                assert t[k] == v, (k, t[k], v)
