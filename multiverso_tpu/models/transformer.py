@@ -335,14 +335,19 @@ def make_optax_train_step(cfg: TransformerConfig, optimizer):
 def generate(params: Dict[str, Any], prompt: jax.Array,
              cfg: TransformerConfig, max_new_tokens: int,
              temperature: float = 0.0,
-             key: Optional[jax.Array] = None) -> jax.Array:
+             key: Optional[jax.Array] = None,
+             top_p: float = 1.0) -> jax.Array:
     """Autoregressive decode with a static KV cache: one ``lax.scan`` over
     decode steps, each step one fused single-token pass (no recompute of
-    the prefix). Greedy at ``temperature=0.0``, else samples with ``key``.
+    the prefix). Greedy at ``temperature=0.0``, else samples with ``key``;
+    ``top_p < 1.0`` restricts sampling to the nucleus (smallest probability
+    mass >= top_p).
 
     prompt: [B, P] int32 -> returns [B, P + max_new_tokens]. Decoding is
-    inherently sequential so there is no sequence axis here (dense configs
-    only: attn is ignored); run it data-parallel by sharding B.
+    inherently sequential so there is no sequence axis here (dense and MoE
+    configs; attn is ignored); run it data-parallel by sharding B. MoE
+    layers decode with exact top-k routing — each token gathers only its
+    chosen experts' weights.
 
     ``params`` may be an int8 weight-only tree from
     ``ops.quantization.quantize_lm_params`` — weights stay int8 in HBM and
@@ -367,8 +372,11 @@ def generate(params: Dict[str, Any], prompt: jax.Array,
                     "with keep_axes=(0,) (quantize_lm_params does)")
             return e.q[idx].astype(jnp.float32) * e.scale[idx]
         return e[idx]
-    if cfg.moe_experts:
-        raise NotImplementedError("generate() supports dense MLPs only")
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if cfg.moe_experts and not 1 <= cfg.moe_top_k <= cfg.moe_experts:
+        raise ValueError(f"top_k={cfg.moe_top_k} out of range for "
+                         f"{cfg.moe_experts} experts")
     b, p = prompt.shape
     h, d = cfg.num_heads, cfg.dim
     hd = d // h
@@ -420,6 +428,21 @@ def generate(params: Dict[str, Any], prompt: jax.Array,
             o = jnp.einsum("bhk,bhkd->bhd", pattn, cv).reshape(b, d)
             x = x + o @ pl["wo"]
             y = _rmsnorm(x, pl["ln2"])
+            if cfg.moe_experts:
+                # exact top-k routing: each token gathers only its chosen
+                # experts' weights (no capacity/dropping at decode time);
+                # gating convention shared with the training path
+                from multiverso_tpu.parallel.moe import top_k_gates
+                probs = jax.nn.softmax(
+                    (y @ pl["moe_router"]).astype(jnp.float32), -1)
+                gates, topi = top_k_gates(probs, cfg.moe_top_k)
+                w1_sel = pl["moe_w1"][topi]          # [B, K, D, M]
+                w2_sel = pl["moe_w2"][topi]          # [B, K, M, D]
+                hmid = jax.nn.gelu(
+                    jnp.einsum("bd,bkdm->bkm", y, w1_sel))
+                out = jnp.einsum("bkm,bkmd->bkd", hmid, w2_sel)
+                mlp = (out * gates[..., None].astype(out.dtype)).sum(1)
+                return (x + mlp,), (ck, cv)
             y = jax.nn.gelu(y @ pl["w1"])
             return (x + y @ pl["w2"],), (ck, cv)
 
@@ -457,8 +480,17 @@ def generate(params: Dict[str, Any], prompt: jax.Array,
     def pick(logits, k):
         if temperature <= 0.0:
             return jnp.argmax(logits, -1).astype(prompt.dtype)
-        return jax.random.categorical(
-            k, logits / temperature).astype(prompt.dtype)
+        logits = logits / temperature
+        if top_p < 1.0:
+            # nucleus filter: drop tokens outside the smallest set whose
+            # probability mass reaches top_p (the top token always stays)
+            sorted_logits = jnp.sort(logits, -1)[:, ::-1]
+            csum = jnp.cumsum(jax.nn.softmax(sorted_logits, -1), -1)
+            cutoff_idx = jnp.sum(csum < top_p, -1)  # first idx reaching p
+            cutoff = jnp.take_along_axis(sorted_logits,
+                                         cutoff_idx[:, None], -1)
+            logits = jnp.where(logits >= cutoff, logits, neg_inf)
+        return jax.random.categorical(k, logits).astype(prompt.dtype)
 
     def decode(carry, i):
         caches, logits, k = carry

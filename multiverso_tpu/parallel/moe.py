@@ -67,6 +67,16 @@ def shard_experts(params: Dict, cfg: MoEConfig,
     }
 
 
+def top_k_gates(probs, kk: int):
+    """Top-k expert selection with the gating convention shared by training
+    (:func:`_route`) and decode (models/transformer.generate): raw top
+    probability for k=1 (switch), renormalized top-k for k>1 (GShard).
+    Returns (gates [T, K], topi [T, K])."""
+    topv, topi = jax.lax.top_k(probs, kk)
+    gates = topv if kk == 1 else topv / topv.sum(-1, keepdims=True)
+    return gates, topi
+
+
 def _route(probs, kk: int, capacity: int):
     """Priority routing over the [T, E] expert probabilities: assignments
     are flattened **k-major** ([all 1st choices, then all 2nd choices, ...])
@@ -75,8 +85,7 @@ def _route(probs, kk: int, capacity: int):
     keep, onehot), each over the K*T assignments; gates are the raw top
     probability for k=1 (switch) and renormalized for k>1 (GShard)."""
     t, e = probs.shape
-    topv, topi = jax.lax.top_k(probs, kk)                  # [T, K]
-    gates = topv if kk == 1 else topv / topv.sum(-1, keepdims=True)
+    gates, topi = top_k_gates(probs, kk)                   # [T, K]
     expert = topi.T.reshape(-1)                            # [K*T]
     gate = gates.T.reshape(-1)
     onehot = jax.nn.one_hot(expert, e, dtype=jnp.int32)    # [K*T, E]

@@ -458,3 +458,79 @@ class TestRematAndOptax:
         assert losses[-1] < losses[0] * 0.7, losses[::10]
         mu = opt_state[0].mu["embed"]
         assert {s.data.shape[0] for s in mu.addressable_shards} == {64 // 8}
+
+
+class TestGenerateMoEAndTopP:
+    def test_moe_single_expert_decode_equals_dense(self):
+        devices = np.asarray(jax.devices()).reshape(8, 1)
+        mv.init(mesh=Mesh(devices, ("dp", "ep")))
+        mcfg = tf.TransformerConfig(vocab_size=32, dim=16, num_heads=2,
+                                    num_layers=2, max_seq=16, attn="local",
+                                    moe_experts=1, moe_axis="ep")
+        mparams = tf.init_params(mcfg, seed=0)
+        dcfg = mcfg._replace(moe_experts=0)
+        dparams = tf.init_params(dcfg, seed=0)
+        dparams["layers"]["w1"] = mparams["layers"]["moe_w1"][:, 0]
+        dparams["layers"]["w2"] = mparams["layers"]["moe_w2"][:, 0]
+        for k in ("wqkv", "wo", "ln1", "ln2"):
+            dparams["layers"][k] = mparams["layers"][k]
+        for k in ("embed", "pos", "ln_f"):
+            dparams[k] = mparams[k]
+        prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+        with jax.default_matmul_precision("float32"):
+            dense = tf.generate(dparams, prompt, dcfg, 5)
+            moe = tf.generate(mparams, prompt, mcfg, 5)
+        np.testing.assert_array_equal(np.asarray(dense), np.asarray(moe))
+
+    def test_moe_top2_decode_matches_forward_argmax(self):
+        # ep axis of size 1 so the forward oracle accepts every prefix
+        # length (decode itself never touches the mesh)
+        devices = np.asarray(jax.devices()).reshape(8, 1)
+        mv.init(mesh=Mesh(devices, ("dp", "ep")))
+        cfg = tf.TransformerConfig(vocab_size=32, dim=16, num_heads=2,
+                                   num_layers=1, max_seq=16, attn="local",
+                                   moe_experts=8, moe_axis="ep",
+                                   moe_top_k=2, moe_capacity_factor=100.0)
+        params = tf.init_params(cfg, seed=1)
+        sharded = tf.shard_params_moe(params, cfg)
+        prompt = jnp.asarray([[4, 7]], jnp.int32)
+        with jax.default_matmul_precision("float32"):
+            out = tf.generate(params, prompt, cfg, 4)
+            # oracle: full forward (generous capacity -> no drops) on each
+            # growing prefix
+            seq = np.asarray(prompt)
+            for _ in range(4):
+                logits = tf.forward(sharded, jnp.asarray(seq), cfg)
+                nxt = np.argmax(np.asarray(logits[:, -1]), -1)
+                seq = np.concatenate([seq, nxt[:, None]], 1)
+        np.testing.assert_array_equal(np.asarray(out), seq)
+
+    def test_top_p_sampling(self):
+        mv.init()
+        cfg = tf.TransformerConfig(vocab_size=32, dim=16, num_heads=2,
+                                   num_layers=1, max_seq=32, attn="local")
+        params = tf.init_params(cfg, seed=2)
+        prompt = jnp.zeros((2, 2), jnp.int32)
+        k = jax.random.key(3)
+        a = tf.generate(params, prompt, cfg, 8, temperature=1.0, key=k,
+                        top_p=0.9)
+        b = tf.generate(params, prompt, cfg, 8, temperature=1.0, key=k,
+                        top_p=0.9)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # top_p -> 0 collapses to greedy (only the top token survives)
+        g = tf.generate(params, prompt, cfg, 8)
+        s = tf.generate(params, prompt, cfg, 8, temperature=1.0, key=k,
+                        top_p=1e-6)
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(s))
+        with pytest.raises(ValueError, match="top_p"):
+            tf.generate(params, prompt, cfg, 2, temperature=1.0, key=k,
+                        top_p=0.0)
+
+    def test_moe_decode_rejects_bad_top_k(self):
+        mv.init()
+        cfg = tf.TransformerConfig(vocab_size=32, dim=16, num_heads=2,
+                                   num_layers=1, max_seq=8, attn="local",
+                                   moe_experts=8, moe_top_k=0)
+        params = tf.init_params(cfg, seed=0)
+        with pytest.raises(ValueError, match="top_k"):
+            tf.generate(params, jnp.zeros((1, 2), jnp.int32), cfg, 2)
