@@ -1,4 +1,8 @@
 from multiverso_tpu.io.stream import Stream, TextReader, open_stream
 from multiverso_tpu.io.sample_reader import SampleReader
+from multiverso_tpu.io.lm_data import (TokenBatches, evaluate_perplexity,
+                                       pack_tokens, pack_tokens_padded)
 
-__all__ = ["Stream", "TextReader", "open_stream", "SampleReader"]
+__all__ = ["SampleReader", "Stream", "TextReader", "TokenBatches",
+           "evaluate_perplexity", "open_stream", "pack_tokens",
+           "pack_tokens_padded"]

@@ -1,0 +1,169 @@
+"""LM data pipeline: token packing + prefetched, mesh-sharded batches.
+
+The reference's data story is background-thread readers feeding fixed-size
+blocks (LR SampleReader ring buffer, WE DataBlock queue — SURVEY §2.7);
+this is the same capability for the transformer family: a flat token
+stream is packed into fixed [seq+1] windows (static shapes for XLA), and
+an iterator yields (tokens, targets) pairs already ``shard_batch``-placed
+over the model's mesh axes, with the NEXT batch's host->device transfer
+overlapped behind the current step via AsyncBuffer (the ref's
+double-buffered prefetch, util/async_buffer.h).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterator, Optional, Tuple
+
+import jax
+import numpy as np
+
+from multiverso_tpu.utils.async_buffer import AsyncBuffer
+
+
+def _window(ids: np.ndarray, n: int, seq_len: int) -> np.ndarray:
+    """[N, seq+1] overlapping windows with one vectorized view (no
+    Python-level per-window slicing)."""
+    view = np.lib.stride_tricks.sliding_window_view(
+        ids[: n * seq_len + 1], seq_len + 1)
+    return np.ascontiguousarray(view[::seq_len]).astype(np.int32)
+
+
+def pack_tokens(ids: np.ndarray, seq_len: int,
+                drop_remainder: bool = True) -> np.ndarray:
+    """Pack a flat token stream into [N, seq_len + 1] windows (each row
+    holds inputs ``[:-1]`` and next-token targets ``[1:]``). Windows
+    overlap by one token so no target is lost at a boundary. With
+    ``drop_remainder=False`` use :func:`pack_tokens_padded` instead — it
+    returns the target mask that keeps pad positions out of the loss."""
+    ids = np.asarray(ids).reshape(-1)
+    n = (ids.size - 1) // seq_len
+    if not drop_remainder:
+        raise ValueError("padding needs a target mask; use "
+                         "pack_tokens_padded")
+    if n < 1:
+        raise ValueError(f"stream of {ids.size} tokens is shorter than one "
+                         f"window of {seq_len + 1}")
+    return _window(ids, n, seq_len)
+
+
+def pack_tokens_padded(ids: np.ndarray, seq_len: int, pad_id: int = 0
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+    """Like :func:`pack_tokens` but keeps the ragged tail, zero-padding the
+    last window. Returns (windows [N, seq+1], target_mask [N, seq]) —
+    feed the mask to ``loss_fn``/``TokenBatches(masks=...)`` so fabricated
+    pad targets never count."""
+    ids = np.asarray(ids).reshape(-1)
+    if ids.size < 2:
+        raise ValueError("need at least 2 tokens (one target)")
+    n = -(-(ids.size - 1) // seq_len)  # ceil
+    pad = n * seq_len + 1 - ids.size
+    real_targets = ids.size - 1
+    if pad:
+        ids = np.concatenate([ids, np.full(pad, pad_id, ids.dtype)])
+    windows = _window(ids, n, seq_len)
+    mask = (np.arange(n * seq_len) < real_targets).reshape(n, seq_len)
+    return windows, mask.astype(np.float32)
+
+
+class TokenBatches:
+    """Iterate (tokens, targets) device batches over an epoch.
+
+    Shuffles windows per epoch, groups them into [batch, seq] pairs, and
+    ``shard_batch``-places each pair for ``cfg``'s mesh axes; the next
+    batch's placement runs on a background thread while the caller's step
+    executes (set ``prefetch=False`` to disable)."""
+
+    def __init__(self, windows: np.ndarray, batch_size: int, cfg,
+                 mesh=None, seed: int = 0, prefetch: bool = True,
+                 masks: Optional[np.ndarray] = None):
+        if windows.ndim != 2:
+            raise ValueError("windows must be [N, seq+1] (use pack_tokens)")
+        if windows.shape[0] < batch_size:
+            raise ValueError(f"{windows.shape[0]} windows < batch_size "
+                             f"{batch_size}")
+        if masks is not None and masks.shape != (windows.shape[0],
+                                                 windows.shape[1] - 1):
+            raise ValueError(f"masks shape {masks.shape} != "
+                             f"{(windows.shape[0], windows.shape[1] - 1)}")
+        self._windows = windows
+        self._masks = masks
+        self._batch = batch_size
+        self._cfg = cfg
+        self._mesh = mesh
+        self._rng = np.random.default_rng(seed)
+        self._prefetch = prefetch
+
+    def __len__(self) -> int:
+        return self._windows.shape[0] // self._batch
+
+    def _place(self, idx: np.ndarray):
+        from multiverso_tpu.models.transformer import shard_batch
+        rows = self._windows[idx]
+        out = (shard_batch(rows[:, :-1], self._cfg, self._mesh),
+               shard_batch(rows[:, 1:], self._cfg, self._mesh))
+        if self._masks is not None:
+            # the mask must stay in ORIGINAL order — loss_fn permutes it
+            # itself for zigzag — so place it without shard_batch's perm
+            mask_cfg = (self._cfg._replace(attn="local")
+                        if self._cfg.attn == "zigzag" else self._cfg)
+            out += (shard_batch(self._masks[idx], mask_cfg, self._mesh),)
+        return out
+
+    def __iter__(self) -> Iterator[Tuple[jax.Array, ...]]:
+        """Yields (tokens, targets) pairs, or (tokens, targets, mask)
+        triples when the batches carry padding masks."""
+        order = self._rng.permutation(self._windows.shape[0])
+        nb = len(self)
+        batches = (order[i * self._batch: (i + 1) * self._batch]
+                   for i in range(nb))
+        if not self._prefetch:
+            for idx in batches:
+                yield self._place(idx)
+            return
+        it = iter(batches)
+
+        def pull():
+            idx = next(it, None)
+            return None if idx is None else self._place(idx)
+
+        buf = AsyncBuffer(pull)
+        try:
+            while True:
+                batch = buf.get()  # kicks off the next pull in background
+                if batch is None:
+                    return
+                yield batch
+        finally:
+            buf.stop()
+
+
+@functools.lru_cache(maxsize=16)
+def _eval_fns(cfg):
+    """Jitted loss closures per config (cached, so repeated per-epoch
+    evaluation compiles once)."""
+    from multiverso_tpu.models import transformer as tfm
+    return (jax.jit(lambda p, a, b: tfm.loss_fn(p, a, b, cfg)),
+            jax.jit(lambda p, a, b, m: tfm.loss_fn(p, a, b, cfg, mask=m)))
+
+
+def evaluate_perplexity(params, batches, cfg,
+                        loss_fn=None) -> Tuple[float, float]:
+    """Mean next-token loss and perplexity over an iterable of
+    (tokens, targets[, mask]) batches (e.g. a :class:`TokenBatches` with
+    ``prefetch`` on — evaluation overlaps transfer too; masked batches
+    keep padding out of the score)."""
+    plain, masked = (loss_fn, loss_fn) if loss_fn else _eval_fns(cfg)
+    total, count = 0.0, 0
+    for batch in batches:
+        if len(batch) == 3:
+            tok, tgt, m = batch
+            total += float(masked(params, tok, tgt, m))
+        else:
+            tok, tgt = batch
+            total += float(plain(params, tok, tgt))
+        count += 1
+    if count == 0:
+        raise ValueError("no batches to evaluate")
+    mean = total / count
+    return mean, float(np.exp(mean))
