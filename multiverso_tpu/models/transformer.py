@@ -336,12 +336,14 @@ def generate(params: Dict[str, Any], prompt: jax.Array,
              cfg: TransformerConfig, max_new_tokens: int,
              temperature: float = 0.0,
              key: Optional[jax.Array] = None,
-             top_p: float = 1.0) -> jax.Array:
+             top_p: float = 1.0,
+             eos_id: Optional[int] = None) -> jax.Array:
     """Autoregressive decode with a static KV cache: one ``lax.scan`` over
     decode steps, each step one fused single-token pass (no recompute of
     the prefix). Greedy at ``temperature=0.0``, else samples with ``key``;
     ``top_p < 1.0`` restricts sampling to the nucleus (smallest probability
-    mass >= top_p).
+    mass >= top_p); with ``eos_id`` set, a sequence that emits it keeps
+    emitting it (shapes stay static — trim on the host).
 
     prompt: [B, P] int32 -> returns [B, P + max_new_tokens]. Decoding is
     inherently sequential so there is no sequence axis here (dense and MoE
@@ -377,6 +379,9 @@ def generate(params: Dict[str, Any], prompt: jax.Array,
     if cfg.moe_experts and not 1 <= cfg.moe_top_k <= cfg.moe_experts:
         raise ValueError(f"top_k={cfg.moe_top_k} out of range for "
                          f"{cfg.moe_experts} experts")
+    if eos_id is not None and not 0 <= eos_id < cfg.vocab_size:
+        raise ValueError(f"eos_id={eos_id} outside vocab of "
+                         f"{cfg.vocab_size} (the latch could never fire)")
     b, p = prompt.shape
     h, d = cfg.num_heads, cfg.dim
     hd = d // h
@@ -492,20 +497,28 @@ def generate(params: Dict[str, Any], prompt: jax.Array,
             logits = jnp.where(logits >= cutoff, logits, neg_inf)
         return jax.random.categorical(k, logits).astype(prompt.dtype)
 
+    def finish(tok, done):
+        """Latch eos: once a row emits it, it keeps emitting it."""
+        if eos_id is None:
+            return tok, done
+        tok = jnp.where(done, jnp.asarray(eos_id, tok.dtype), tok)
+        return tok, done | (tok == eos_id)
+
     def decode(carry, i):
-        caches, logits, k = carry
+        caches, logits, k, done = carry
         k, sub = jax.random.split(k)
-        tok = pick(logits, sub)
+        tok, done = finish(pick(logits, sub), done)
         caches, logits = step_token(caches, tok, p + i)
-        return (caches, logits, k), tok
+        return (caches, logits, k, done), tok
 
     # scan max_new_tokens - 1 steps; the final token needs only the last
     # logits, not another forward pass
     k0 = key if key is not None else jax.random.key(0)
-    (_, logits, kf), new = jax.lax.scan(
-        decode, (caches, logits, k0), jnp.arange(max_new_tokens - 1))
+    done0 = jnp.zeros((b,), bool)
+    (_, logits, kf, done), new = jax.lax.scan(
+        decode, (caches, logits, k0, done0), jnp.arange(max_new_tokens - 1))
     _, sub = jax.random.split(kf)
-    last = pick(logits, sub)
+    last, _ = finish(pick(logits, sub), done)
     new = (jnp.concatenate([new.T, last[:, None]], axis=1)
            if max_new_tokens > 1 else last[:, None])
     return jnp.concatenate([prompt, new], axis=1)

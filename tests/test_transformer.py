@@ -534,3 +534,35 @@ class TestGenerateMoEAndTopP:
         params = tf.init_params(cfg, seed=0)
         with pytest.raises(ValueError, match="top_k"):
             tf.generate(params, jnp.zeros((1, 2), jnp.int32), cfg, 2)
+
+    def test_eos_latches(self):
+        mv.init()
+        cfg = tf.TransformerConfig(vocab_size=8, dim=32, num_heads=4,
+                                   num_layers=2, max_seq=32, attn="local")
+        params = tf.init_params(cfg, seed=0)
+        # train to emit the cycle 0..7; token 3 will appear mid-cycle
+        seq = np.tile(np.arange(8), 5)[:33]
+        tok = jnp.asarray(np.stack([seq[:-1]] * 4), jnp.int32)
+        tgt = jnp.asarray(np.stack([seq[1:]] * 4), jnp.int32)
+        step = jax.jit(tf.make_train_step(cfg, 0.5))
+        for _ in range(150):
+            params, _ = step(params, tok, tgt)
+        prompt = jnp.asarray([[0, 1]], jnp.int32)
+        out = np.asarray(tf.generate(params, prompt, cfg, 10, eos_id=3))[0]
+        assert (out == 3).any(), f"model never emitted eos: {out.tolist()}"
+        # first emission of 3 latches: everything after stays 3
+        first = int(np.argmax(out == 3))
+        assert out[first] == 3
+        assert (out[first:] == 3).all(), out.tolist()
+        # without eos the cycle continues past 3
+        out2 = np.asarray(tf.generate(params, prompt, cfg, 10))[0]
+        assert not (out2[first:] == 3).all()
+
+    def test_eos_out_of_vocab_rejected(self):
+        mv.init()
+        cfg = tf.TransformerConfig(vocab_size=8, dim=16, num_heads=2,
+                                   num_layers=1, max_seq=8, attn="local")
+        params = tf.init_params(cfg)
+        with pytest.raises(ValueError, match="eos_id"):
+            tf.generate(params, jnp.zeros((1, 2), jnp.int32), cfg, 2,
+                        eos_id=8)
