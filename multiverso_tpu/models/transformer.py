@@ -332,6 +332,137 @@ def make_optax_train_step(cfg: TransformerConfig, optimizer):
     return step
 
 
+def _decode_step(params, caches, tok, t, cfg: TransformerConfig):
+    """One token through all layers, reading/updating the KV cache.
+    caches: dict of [L, B, H, max_seq, hd]; tok [B]; t scalar position.
+    Returns (caches, logits [B, V] f32). Accepts int8 quantized trees
+    (weights dequantize one layer at a time)."""
+    from multiverso_tpu.ops.quantization import (QuantizedTensor,
+                                                 maybe_dequantize)
+
+    def _is_q(x):
+        return isinstance(x, QuantizedTensor)
+
+    def _rows(e, idx):
+        """Embedding-row lookup without materializing the full table."""
+        if _is_q(e):
+            want = (e.q.shape[0],) + (1,) * (e.q.ndim - 1)
+            if e.scale.shape != want:
+                # out-of-bounds gathers clamp silently, so a wrong scale
+                # layout would corrupt decoding without any error
+                raise ValueError(
+                    f"embedding QuantizedTensor needs per-row scales "
+                    f"{want}, got {e.scale.shape}; quantize embeddings "
+                    "with keep_axes=(0,) (quantize_lm_params does)")
+            return e.q[idx].astype(jnp.float32) * e.scale[idx]
+        return e[idx]
+
+    b = tok.shape[0]
+    h, d = cfg.num_heads, cfg.dim
+    hd = d // h
+    neg_inf = jnp.asarray(-1e30, jnp.float32)
+    x = (_rows(params["embed"], tok)
+         + _rows(params["pos"], t)).astype(cfg.dtype)    # [B, D]
+
+    def layer(carry, inputs):
+        x, = carry
+        pl, ck, cv = inputs
+        pl = jax.tree.map(lambda l: maybe_dequantize(l, cfg.dtype),
+                          pl, is_leaf=_is_q)
+        y = _rmsnorm(x, pl["ln1"])
+        qkv = y @ pl["wqkv"]                             # [B, 3D]
+        q, kk, vv = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, h, hd)
+        kk = kk.reshape(b, h, hd)
+        vv = vv.reshape(b, h, hd)
+        ck = jax.lax.dynamic_update_slice_in_dim(
+            ck, kk[:, :, None], t, axis=2)               # [B,H,max,hd]
+        cv = jax.lax.dynamic_update_slice_in_dim(
+            cv, vv[:, :, None], t, axis=2)
+        # f32 score/output accumulation, matching reference_attention's
+        # preferred_element_type so bf16 greedy decode agrees with
+        # forward()
+        s = jnp.einsum("bhd,bhkd->bhk", q, ck,
+                       preferred_element_type=jnp.float32)
+        s = s / (hd ** 0.5)
+        live = jnp.arange(cfg.max_seq)[None, None] <= t
+        s = jnp.where(live, s, neg_inf)
+        pattn = jax.nn.softmax(s, -1).astype(cv.dtype)
+        o = jnp.einsum("bhk,bhkd->bhd", pattn, cv).reshape(b, d)
+        x = x + o @ pl["wo"]
+        y = _rmsnorm(x, pl["ln2"])
+        if cfg.moe_experts:
+            # exact top-k routing: each token gathers only its chosen
+            # experts' weights (no capacity/dropping at decode time);
+            # gating convention shared with the training path
+            from multiverso_tpu.parallel.moe import top_k_gates
+            probs = jax.nn.softmax(
+                (y @ pl["moe_router"]).astype(jnp.float32), -1)
+            gates, topi = top_k_gates(probs, cfg.moe_top_k)
+            w1_sel = pl["moe_w1"][topi]          # [B, K, D, M]
+            w2_sel = pl["moe_w2"][topi]          # [B, K, M, D]
+            hmid = jax.nn.gelu(
+                jnp.einsum("bd,bkdm->bkm", y, w1_sel))
+            out = jnp.einsum("bkm,bkmd->bkd", hmid, w2_sel)
+            mlp = (out * gates[..., None].astype(out.dtype)).sum(1)
+            return (x + mlp,), (ck, cv)
+        y = jax.nn.gelu(y @ pl["w1"])
+        return (x + y @ pl["w2"],), (ck, cv)
+
+    (x,), (ck, cv) = jax.lax.scan(
+        layer, (x,), (params["layers"], caches["k"], caches["v"]))
+    x = _rmsnorm(x, params["ln_f"])
+    e = params["embed"]
+    if _is_q(e):
+        # int8 operand straight into the dot (the convert fuses), then
+        # the per-row scale applied on the small [B, V] logits — the
+        # [V, D] f32 table is never materialized
+        logits = jnp.einsum("bd,vd->bv", x, e.q.astype(x.dtype),
+                            preferred_element_type=jnp.float32)
+        logits = logits * e.scale[:, 0][None]
+    else:
+        logits = jnp.einsum("bd,vd->bv", x, e,
+                            preferred_element_type=jnp.float32)
+    return {"k": ck, "v": cv}, logits
+
+
+def _prefill(params, prompt, cfg: TransformerConfig, total: int):
+    """Validate a decode request, build empty KV caches, and feed the
+    prompt token by token. Returns (caches, next-token logits)."""
+    b, p = prompt.shape
+    if p < 1:
+        raise ValueError("prompt must contain at least one token (an "
+                         "empty prompt would decode from placeholder "
+                         "logits)")
+    if total <= p:
+        raise ValueError("max_new_tokens must be >= 1")
+    if total > cfg.max_seq:
+        raise ValueError(f"prompt + new tokens = {total} exceeds "
+                         f"max_seq={cfg.max_seq}")
+    if cfg.moe_experts and not 1 <= cfg.moe_top_k <= cfg.moe_experts:
+        raise ValueError(f"top_k={cfg.moe_top_k} out of range for "
+                         f"{cfg.moe_experts} experts")
+    h, d = cfg.num_heads, cfg.dim
+    caches = {
+        "k": jnp.zeros((cfg.num_layers, b, h, cfg.max_seq, d // h),
+                       cfg.dtype),
+        "v": jnp.zeros((cfg.num_layers, b, h, cfg.max_seq, d // h),
+                       cfg.dtype),
+    }
+
+    # prompt tokens one at a time (simple; prompt lengths here are small —
+    # a batched prefill pass is the known optimization)
+    def prefill(carry, i):
+        caches, last = carry
+        caches, logits = _decode_step(params, caches, prompt[:, i], i, cfg)
+        return (caches, logits), None
+
+    (caches, logits), _ = jax.lax.scan(
+        prefill, (caches, jnp.zeros((b, cfg.vocab_size), jnp.float32)),
+        jnp.arange(p))
+    return caches, logits
+
+
 def generate(params: Dict[str, Any], prompt: jax.Array,
              cfg: TransformerConfig, max_new_tokens: int,
              temperature: float = 0.0,
@@ -355,132 +486,16 @@ def generate(params: Dict[str, Any], prompt: jax.Array,
     ``ops.quantization.quantize_lm_params`` — weights stay int8 in HBM and
     are dequantized one layer at a time inside the decode scan.
     """
-    from multiverso_tpu.ops.quantization import (QuantizedTensor,
-                                                 maybe_dequantize)
-
-    def _is_q(x):
-        return isinstance(x, QuantizedTensor)
-
-    def _rows(e, idx):
-        """Embedding-row lookup without materializing the full table."""
-        if _is_q(e):
-            want = (e.q.shape[0],) + (1,) * (e.q.ndim - 1)
-            if e.scale.shape != want:
-                # out-of-bounds gathers clamp silently, so a wrong scale
-                # layout would corrupt decoding without any error
-                raise ValueError(
-                    f"embedding QuantizedTensor needs per-row scales "
-                    f"{want}, got {e.scale.shape}; quantize embeddings "
-                    "with keep_axes=(0,) (quantize_lm_params does)")
-            return e.q[idx].astype(jnp.float32) * e.scale[idx]
-        return e[idx]
     if not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
-    if cfg.moe_experts and not 1 <= cfg.moe_top_k <= cfg.moe_experts:
-        raise ValueError(f"top_k={cfg.moe_top_k} out of range for "
-                         f"{cfg.moe_experts} experts")
     if eos_id is not None and not 0 <= eos_id < cfg.vocab_size:
         raise ValueError(f"eos_id={eos_id} outside vocab of "
                          f"{cfg.vocab_size} (the latch could never fire)")
-    b, p = prompt.shape
-    h, d = cfg.num_heads, cfg.dim
-    hd = d // h
-    L = cfg.num_layers
-    total = p + max_new_tokens
-    if p < 1:
-        raise ValueError("prompt must contain at least one token (an "
-                         "empty prompt would decode from placeholder "
-                         "logits)")
-    if max_new_tokens < 1:
-        raise ValueError("max_new_tokens must be >= 1")
-    if total > cfg.max_seq:
-        raise ValueError(f"prompt + new tokens = {total} exceeds "
-                         f"max_seq={cfg.max_seq}")
     if temperature > 0.0 and key is None:
         raise ValueError("sampling (temperature > 0) needs a PRNG key")
+    b, p = prompt.shape
+    caches, logits = _prefill(params, prompt, cfg, p + max_new_tokens)
     neg_inf = jnp.asarray(-1e30, jnp.float32)
-
-    def step_token(caches, tok, t):
-        """One token through all layers, reading/updating the KV cache.
-        caches: dict of [L, B, H, max, hd]; tok [B]; t scalar position."""
-        x = (_rows(params["embed"], tok)
-             + _rows(params["pos"], t)).astype(cfg.dtype)    # [B, D]
-
-        def layer(carry, inputs):
-            x, = carry
-            pl, ck, cv = inputs
-            pl = jax.tree.map(lambda l: maybe_dequantize(l, cfg.dtype),
-                              pl, is_leaf=_is_q)
-            y = _rmsnorm(x, pl["ln1"])
-            qkv = y @ pl["wqkv"]                             # [B, 3D]
-            q, kk, vv = jnp.split(qkv, 3, axis=-1)
-            q = q.reshape(b, h, hd)
-            kk = kk.reshape(b, h, hd)
-            vv = vv.reshape(b, h, hd)
-            ck = jax.lax.dynamic_update_slice_in_dim(
-                ck, kk[:, :, None], t, axis=2)               # [B,H,max,hd]
-            cv = jax.lax.dynamic_update_slice_in_dim(
-                cv, vv[:, :, None], t, axis=2)
-            # f32 score/output accumulation, matching reference_attention's
-            # preferred_element_type so bf16 greedy decode agrees with
-            # forward()
-            s = jnp.einsum("bhd,bhkd->bhk", q, ck,
-                           preferred_element_type=jnp.float32)
-            s = s / (hd ** 0.5)
-            live = jnp.arange(cfg.max_seq)[None, None] <= t
-            s = jnp.where(live, s, neg_inf)
-            pattn = jax.nn.softmax(s, -1).astype(cv.dtype)
-            o = jnp.einsum("bhk,bhkd->bhd", pattn, cv).reshape(b, d)
-            x = x + o @ pl["wo"]
-            y = _rmsnorm(x, pl["ln2"])
-            if cfg.moe_experts:
-                # exact top-k routing: each token gathers only its chosen
-                # experts' weights (no capacity/dropping at decode time);
-                # gating convention shared with the training path
-                from multiverso_tpu.parallel.moe import top_k_gates
-                probs = jax.nn.softmax(
-                    (y @ pl["moe_router"]).astype(jnp.float32), -1)
-                gates, topi = top_k_gates(probs, cfg.moe_top_k)
-                w1_sel = pl["moe_w1"][topi]          # [B, K, D, M]
-                w2_sel = pl["moe_w2"][topi]          # [B, K, M, D]
-                hmid = jax.nn.gelu(
-                    jnp.einsum("bd,bkdm->bkm", y, w1_sel))
-                out = jnp.einsum("bkm,bkmd->bkd", hmid, w2_sel)
-                mlp = (out * gates[..., None].astype(out.dtype)).sum(1)
-                return (x + mlp,), (ck, cv)
-            y = jax.nn.gelu(y @ pl["w1"])
-            return (x + y @ pl["w2"],), (ck, cv)
-
-        (x,), (ck, cv) = jax.lax.scan(
-            layer, (x,), (params["layers"], caches["k"], caches["v"]))
-        x = _rmsnorm(x, params["ln_f"])
-        e = params["embed"]
-        if _is_q(e):
-            # int8 operand straight into the dot (the convert fuses), then
-            # the per-row scale applied on the small [B, V] logits — the
-            # [V, D] f32 table is never materialized
-            logits = jnp.einsum("bd,vd->bv", x, e.q.astype(x.dtype),
-                                preferred_element_type=jnp.float32)
-            logits = logits * e.scale[:, 0][None]
-        else:
-            logits = jnp.einsum("bd,vd->bv", x, e,
-                                preferred_element_type=jnp.float32)
-        return {"k": ck, "v": cv}, logits
-
-    caches = {
-        "k": jnp.zeros((L, b, h, cfg.max_seq, hd), cfg.dtype),
-        "v": jnp.zeros((L, b, h, cfg.max_seq, hd), cfg.dtype),
-    }
-    # prefill: feed prompt tokens one at a time (simple; prompt lengths
-    # here are small — a batched prefill pass is the known optimization)
-    def prefill(carry, i):
-        caches, last = carry
-        caches, logits = step_token(caches, prompt[:, i], i)
-        return (caches, logits), None
-
-    (caches, logits), _ = jax.lax.scan(
-        prefill, (caches, jnp.zeros((b, cfg.vocab_size), jnp.float32)),
-        jnp.arange(p))
 
     def pick(logits, k):
         if temperature <= 0.0:
@@ -508,7 +523,7 @@ def generate(params: Dict[str, Any], prompt: jax.Array,
         caches, logits, k, done = carry
         k, sub = jax.random.split(k)
         tok, done = finish(pick(logits, sub), done)
-        caches, logits = step_token(caches, tok, p + i)
+        caches, logits = _decode_step(params, caches, tok, p + i, cfg)
         return (caches, logits, k, done), tok
 
     # scan max_new_tokens - 1 steps; the final token needs only the last
@@ -522,6 +537,70 @@ def generate(params: Dict[str, Any], prompt: jax.Array,
     new = (jnp.concatenate([new.T, last[:, None]], axis=1)
            if max_new_tokens > 1 else last[:, None])
     return jnp.concatenate([prompt, new], axis=1)
+
+
+def generate_beam(params: Dict[str, Any], prompt: jax.Array,
+                  cfg: TransformerConfig, max_new_tokens: int,
+                  num_beams: int = 4, return_score: bool = False):
+    """Beam-search decode: keep the ``num_beams`` highest-logprob
+    continuations per sequence, return the best [B, P + max_new_tokens]
+    (with its total continuation log-prob [B] when ``return_score``).
+
+    Built on the same KV-cache machinery as :func:`generate` by running
+    the batch expanded to B*W rows; each step reorders the caches along
+    the beam dim (one gather) after the top-k over (beam, token) pairs.
+    ``num_beams=1`` reduces exactly to greedy decoding. Note beam search
+    maximizes over the searched set — the greedy path itself can be
+    pruned, so the result is not pointwise >= greedy in log-prob.
+    """
+    if num_beams < 1:
+        raise ValueError(f"num_beams must be >= 1, got {num_beams}")
+    b, p = prompt.shape
+    w = num_beams
+    v = cfg.vocab_size
+
+    # prefill once per sequence, then fan the caches out to the W beams
+    # (each batch row's beams start identical); scores start [0, -inf, ...]
+    # so the first expansion step picks W distinct tokens from beam 0
+    caches, logits = _prefill(params, prompt, cfg, p + max_new_tokens)
+    caches = jax.tree.map(lambda c: jnp.repeat(c, w, axis=1), caches)
+    logits = jnp.repeat(logits, w, axis=0)                   # [B*W, V]
+    scores = jnp.tile(jnp.asarray([0.0] + [-1e30] * (w - 1), jnp.float32),
+                      (b, 1))                                # [B, W]
+
+    def step(carry, i):
+        caches, logits, scores, toks = carry
+        logp = jax.nn.log_softmax(logits, -1).reshape(b, w, v)
+        cand = scores[..., None] + logp                      # [B, W, V]
+        scores, flat = jax.lax.top_k(cand.reshape(b, w * v), w)
+        origin = flat // v                                   # [B, W]
+        tok = (flat % v).astype(prompt.dtype)
+        # reorder beam state to follow the surviving beams
+        gather = (jnp.arange(b)[:, None] * w + origin).reshape(-1)
+        caches = jax.tree.map(lambda c: c[:, gather], caches)
+        toks = toks[jnp.arange(b)[:, None], origin]          # [B, W, T]
+        toks = toks.at[:, :, i].set(tok)
+        caches, logits = _decode_step(params, caches, tok.reshape(-1),
+                                      p + i, cfg)
+        return (caches, logits, scores, toks), None
+
+    toks0 = jnp.zeros((b, w, max_new_tokens), prompt.dtype)
+    (caches, logits, scores, toks), _ = jax.lax.scan(
+        step, (caches, logits, scores, toks0),
+        jnp.arange(max_new_tokens - 1))
+    # final token from the last logits, no further forward pass
+    logp = jax.nn.log_softmax(logits, -1).reshape(b, w, v)
+    cand = scores[..., None] + logp
+    scores, flat = jax.lax.top_k(cand.reshape(b, w * v), w)
+    origin, tok = flat // v, (flat % v).astype(prompt.dtype)
+    toks = toks[jnp.arange(b)[:, None], origin]
+    toks = toks.at[:, :, max_new_tokens - 1].set(tok)
+    best = jnp.argmax(scores, -1)                            # [B]
+    new = toks[jnp.arange(b), best]                          # [B, T]
+    out = jnp.concatenate([prompt, new], axis=1)
+    if return_score:
+        return out, scores[jnp.arange(b), best]
+    return out
 
 
 def shard_batch(tokens: np.ndarray, cfg: TransformerConfig,

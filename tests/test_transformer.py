@@ -566,3 +566,61 @@ class TestGenerateMoEAndTopP:
         with pytest.raises(ValueError, match="eos_id"):
             tf.generate(params, jnp.zeros((1, 2), jnp.int32), cfg, 2,
                         eos_id=8)
+
+
+class TestBeamSearch:
+    def _seq_logprob(self, params, cfg, seq, p):
+        """Total log-prob of seq[p:] under the model, via full forward."""
+        logits = tf.forward(params, jnp.asarray(seq[:, :-1]), cfg)
+        logp = jax.nn.log_softmax(np.asarray(logits, np.float32), -1)
+        total = 0.0
+        for t in range(p - 1, seq.shape[1] - 1):
+            total += float(logp[0, t, seq[0, t + 1]])
+        return total
+
+    def test_single_beam_equals_greedy(self):
+        mv.init()
+        cfg = tf.TransformerConfig(vocab_size=32, dim=16, num_heads=2,
+                                   num_layers=2, max_seq=24, attn="local")
+        params = tf.init_params(cfg, seed=0)
+        prompt = jnp.asarray([[3, 1], [9, 4]], jnp.int32)
+        with jax.default_matmul_precision("float32"):
+            greedy = tf.generate(params, prompt, cfg, 6)
+            beam1 = tf.generate_beam(params, prompt, cfg, 6, num_beams=1)
+        np.testing.assert_array_equal(np.asarray(greedy), np.asarray(beam1))
+
+    def test_wide_beam_finds_global_optimum(self):
+        # V=4, T=3, W=16 >= V^(T-1): the search is exhaustive, so the
+        # result must be the brute-force argmax continuation
+        mv.init()
+        cfg = tf.TransformerConfig(vocab_size=4, dim=16, num_heads=2,
+                                   num_layers=2, max_seq=8, attn="local")
+        params = tf.init_params(cfg, seed=5)
+        prompt = np.asarray([[1, 2]], np.int32)
+        with jax.default_matmul_precision("float32"):
+            beam, score = tf.generate_beam(params, jnp.asarray(prompt),
+                                           cfg, 3, num_beams=16,
+                                           return_score=True)
+            best_lp, best_seq = -1e30, None
+            for a in range(4):
+                for bb in range(4):
+                    for c in range(4):
+                        seq = np.concatenate(
+                            [prompt, [[a, bb, c]]], axis=1)
+                        lp = self._seq_logprob(params, cfg, seq, 2)
+                        if lp > best_lp:
+                            best_lp, best_seq = lp, seq
+        np.testing.assert_array_equal(np.asarray(beam), best_seq)
+        # the internal accumulated score equals the true sequence log-prob
+        np.testing.assert_allclose(float(score[0]), best_lp, atol=1e-4)
+
+    def test_beam_validation(self):
+        mv.init()
+        cfg = tf.TransformerConfig(vocab_size=16, dim=16, num_heads=2,
+                                   num_layers=1, max_seq=8, attn="local")
+        params = tf.init_params(cfg)
+        with pytest.raises(ValueError, match="num_beams"):
+            tf.generate_beam(params, jnp.zeros((1, 2), jnp.int32), cfg, 2,
+                             num_beams=0)
+        with pytest.raises(ValueError, match="max_seq"):
+            tf.generate_beam(params, jnp.zeros((1, 6), jnp.int32), cfg, 4)
