@@ -291,14 +291,25 @@ class WordEmbedding:
     # PS block path (reference block pipeline; multi-worker capable)
     # ------------------------------------------------------------------ #
     def _block_step_fn(self):
+        """Jitted per-minibatch step for the active (cbow, hs) mode; the
+        PS-block path supports all four variants like the reference's
+        distributed trainer (ref wordembedding.cpp FeedForward/HS/NS
+        branches)."""
         if not hasattr(self, "_block_jit"):
             cfg = self.cfg
-
-            def step(win_l, wout_l, c_l, x_l, neg_l):
-                return w2v.skipgram_ns_step(win_l, wout_l, c_l, x_l, neg_l,
-                                            cfg.alpha)
-
-            self._block_jit = jax.jit(step)
+            if cfg.cbow and cfg.hs:
+                fn = lambda a, b, w, m, c, p, pm: w2v.cbow_hs_step(
+                    a, b, w, m, c, p, pm, cfg.alpha)
+            elif cfg.cbow:
+                fn = lambda a, b, w, m, t, n: w2v.cbow_ns_step(
+                    a, b, w, m, t, n, cfg.alpha)
+            elif cfg.hs:
+                fn = lambda a, b, c, cd, p, pm: w2v.skipgram_hs_step(
+                    a, b, c, cd, p, pm, cfg.alpha)
+            else:
+                fn = lambda a, b, c, x, n: w2v.skipgram_ns_step(
+                    a, b, c, x, n, cfg.alpha)
+            self._block_jit = jax.jit(fn)
         return self._block_jit
 
     def train_ps_blocks(self, ids: np.ndarray,
@@ -308,10 +319,6 @@ class WordEmbedding:
         dispatched before block N trains (ref :202-223 OMP overlap thread) —
         its device gather + host transfer proceed while block N computes, at
         the cost of the same one-block staleness the reference accepts."""
-        if self.cfg.cbow or self.cfg.hs:
-            raise NotImplementedError(
-                "PS block mode currently trains skipgram-NS only; use "
-                "train_fused for CBOW / hierarchical softmax")
         cfg = self.cfg
         epochs = epochs or cfg.epoch
         rng = np.random.default_rng(cfg.seed)
@@ -341,24 +348,56 @@ class WordEmbedding:
 
     def _prepare_block(self, block: np.ndarray, rng) -> Dict:
         """Host-side block prep + *dispatch* of the row pulls
-        (ref RequestParameter, communicator.cpp:104-142)."""
+        (ref RequestParameter, communicator.cpp:104-142). Builds the
+        mode-specific training arrays, the block's input-vocab remap, and
+        — for HS modes — the block's Huffman inner-node set/remap."""
         cfg = self.cfg
         with monitor("we.prepare"):
-            centers, contexts = _gen_pairs(block, cfg.window,
-                                           int(rng.integers(1 << 31)))
-            negs = rng.choice(len(self.dict),
-                              size=(max(centers.size, 1), cfg.negative),
-                              p=self.unigram).astype(np.int32)
-            vocab = np.unique(np.concatenate([centers, contexts,
-                                              negs.reshape(-1)]))
+            prep: Dict = {}
+            if cfg.cbow:
+                windows, masks, targets = w2v.generate_cbow_batches(
+                    block, cfg.window)
+                prep.update(windows=windows, masks=masks, targets=targets)
+                used = [windows.reshape(-1), targets, np.zeros(1, np.int64)]
+                examples = targets   # the word whose path/negs are scored
+            else:
+                centers, contexts = _gen_pairs(block, cfg.window,
+                                               int(rng.integers(1 << 31)))
+                prep.update(centers=centers, contexts=contexts)
+                used = [centers, contexts]
+                examples = contexts
+            if cfg.hs:
+                codes, points, lengths = self._hs
+                t = np.asarray(examples, np.int64)
+                pmask = (np.arange(codes.shape[1])[None, :]
+                         < lengths[t][:, None])
+                prep.update(codes=codes[t], points=points[t], pmask=pmask)
+                hs_rows = np.unique(prep["points"][pmask])
+                # remap path points into the pulled hs block; padded path
+                # slots route to a dummy extra row (their grads are masked
+                # to zero, the scatter just needs a valid index)
+                remap_hs = np.full(self.table_hs.shape[0] + 1,
+                                   hs_rows.size, np.int64)
+                remap_hs[hs_rows] = np.arange(hs_rows.size)
+                prep.update(hs_rows=hs_rows, remap_hs=remap_hs,
+                            pull_hs=self.table_hs.get_rows_async(hs_rows))
+            else:
+                negs = rng.choice(
+                    len(self.dict),
+                    size=(max(examples.size, 1), cfg.negative),
+                    p=self.unigram).astype(np.int32)
+                prep["negs"] = negs
+                used.append(negs.reshape(-1))
+            vocab = np.unique(np.concatenate(
+                [np.asarray(u).reshape(-1) for u in used]))
             remap = np.full(len(self.dict), -1, np.int64)
             remap[vocab] = np.arange(vocab.size)
-            return {
-                "centers": centers, "contexts": contexts, "negs": negs,
-                "vocab": vocab, "remap": remap,
-                "pull_in": self.table_in.get_rows_async(vocab),
-                "pull_out": self.table_out.get_rows_async(vocab),
-            }
+            prep.update(
+                vocab=vocab, remap=remap,
+                pull_in=self.table_in.get_rows_async(vocab))
+            if not cfg.hs:
+                prep["pull_out"] = self.table_out.get_rows_async(vocab)
+            return prep
 
     def _read_pull(self, table, msg_id):
         return jnp.asarray(table.wait(msg_id))
@@ -367,31 +406,61 @@ class WordEmbedding:
         cfg = self.cfg
         with monitor("we.block"):
             win_l = self._read_pull(self.table_in, prep["pull_in"])
-            wout_l = self._read_pull(self.table_out, prep["pull_out"])
-            if prep["centers"].size == 0:
+            examples = (prep["targets"] if cfg.cbow
+                        else prep["centers"])
+            if examples.size == 0:
                 return 0.0
-            old_in, old_out = win_l, wout_l
+            old_in = win_l
+            if cfg.hs:
+                pulled = self._read_pull(self.table_hs, prep["pull_hs"])
+                # one dummy extra row catches padded path slots (their
+                # grads are masked to zero; the scatter needs a valid id)
+                wsec_l = jnp.concatenate(
+                    [pulled, jnp.zeros((1, pulled.shape[1]),
+                                       pulled.dtype)])
+            else:
+                wsec_l = self._read_pull(self.table_out, prep["pull_out"])
+            old_sec = wsec_l
             step = self._block_step_fn()
-            centers, contexts, negs = (prep["centers"], prep["contexts"],
-                                       prep["negs"])
             remap = prep["remap"]
             b = cfg.batch_size
-            n = max((centers.size // b) * b, 0)
+            n = max((examples.size // b) * b, 0)
             loss_sum, nb = 0.0, 0
             for i in range(0, n, b):
-                win_l, wout_l, loss = step(
-                    win_l, wout_l,
-                    jnp.asarray(remap[centers[i:i+b]], jnp.int32),
-                    jnp.asarray(remap[contexts[i:i+b]], jnp.int32),
-                    jnp.asarray(remap[negs[i:i+b]], jnp.int32))
+                sl = slice(i, i + b)
+                if cfg.cbow:
+                    head = (jnp.asarray(remap[prep["windows"][sl]],
+                                        jnp.int32),
+                            jnp.asarray(prep["masks"][sl]))
+                else:
+                    head = (jnp.asarray(remap[prep["centers"][sl]],
+                                        jnp.int32),)
+                if cfg.hs:
+                    tail = (jnp.asarray(prep["codes"][sl], jnp.int32),
+                            jnp.asarray(prep["remap_hs"][prep["points"][sl]],
+                                        jnp.int32),
+                            jnp.asarray(prep["pmask"][sl]))
+                elif cfg.cbow:
+                    tail = (jnp.asarray(remap[prep["targets"][sl]],
+                                        jnp.int32),
+                            jnp.asarray(remap[prep["negs"][sl]], jnp.int32))
+                else:
+                    tail = (jnp.asarray(remap[prep["contexts"][sl]],
+                                        jnp.int32),
+                            jnp.asarray(remap[prep["negs"][sl]], jnp.int32))
+                win_l, wsec_l, loss = step(win_l, wsec_l, *head, *tail)
                 loss_sum, nb = loss_sum + float(loss), nb + 1
             # AddDeltaParameter: (new - old) / workers
             # (ref communicator.cpp:144-236)
             with monitor("we.push"):
                 d_in = np.asarray(win_l - old_in) / num_workers
-                d_out = np.asarray(wout_l - old_out) / num_workers
                 self.table_in.add_rows(prep["vocab"], d_in)
-                self.table_out.add_rows(prep["vocab"], d_out)
+                d_sec = np.asarray(wsec_l - old_sec) / num_workers
+                if cfg.hs:
+                    self.table_hs.add_rows(prep["hs_rows"],
+                                           d_sec[:-1])  # drop dummy row
+                else:
+                    self.table_out.add_rows(prep["vocab"], d_sec)
             return loss_sum / max(nb, 1)
 
     # ------------------------------------------------------------------ #

@@ -296,13 +296,22 @@ class TestModesAndRegressions:
         assert np.linalg.norm(we.table_hs.get()) > 0
         assert np.linalg.norm(we.embeddings()) > 0
 
-    def test_ps_blocks_reject_cbow_hs(self):
+    @pytest.mark.parametrize("cbow,hs", [(1, 0), (0, 1), (1, 1)])
+    def test_ps_blocks_all_variants(self, cbow, hs):
+        # the reference's distributed path trains every variant; so does
+        # the PS block path here (skipgram-NS is covered elsewhere)
         tokens = self._tokens()
-        cfg = WEConfig(size=16, min_count=5, batch_size=128, cbow=1)
+        cfg = WEConfig(size=16, min_count=5, batch_size=128, cbow=cbow,
+                       hs=hs, negative=3, data_block_size=4000)
         d = Dictionary.build(tokens, cfg.min_count)
         we = WordEmbedding(cfg, d)
-        with pytest.raises(NotImplementedError):
-            we.train_ps_blocks(we.prepare_ids(tokens))
+        stats = we.train_ps_blocks(we.prepare_ids(tokens), epochs=1)
+        assert stats["loss"] > 0
+        assert np.linalg.norm(we.embeddings()) > 0
+        if hs:
+            assert np.linalg.norm(we.table_hs.get()) > 0
+        else:
+            assert np.linalg.norm(we.table_out.get()) > 0
 
     def test_words_per_sec_counts_tokens(self):
         tokens = self._tokens()
