@@ -332,37 +332,79 @@ def make_optax_train_step(cfg: TransformerConfig, optimizer):
     return step
 
 
+def _is_q(x):
+    from multiverso_tpu.ops.quantization import QuantizedTensor
+    return isinstance(x, QuantizedTensor)
+
+
+def _emb_rows(e, idx):
+    """Embedding-row lookup without materializing the full table."""
+    if _is_q(e):
+        want = (e.q.shape[0],) + (1,) * (e.q.ndim - 1)
+        if e.scale.shape != want:
+            # out-of-bounds gathers clamp silently, so a wrong scale
+            # layout would corrupt decoding without any error
+            raise ValueError(
+                f"embedding QuantizedTensor needs per-row scales "
+                f"{want}, got {e.scale.shape}; quantize embeddings "
+                "with keep_axes=(0,) (quantize_lm_params does)")
+        return e.q[idx].astype(jnp.float32) * e.scale[idx]
+    return e[idx]
+
+
+def _tied_logits(x, e):
+    """[.., D] @ tied embedding -> [.., V] f32 logits. For int8 embeddings
+    the int8 operand feeds the dot directly (the convert fuses) and the
+    per-row scale lands on the small logits output — the [V, D] f32 table
+    is never materialized."""
+    if _is_q(e):
+        logits = jnp.einsum("bd,vd->bv", x, e.q.astype(x.dtype),
+                            preferred_element_type=jnp.float32)
+        return logits * e.scale[:, 0][None]
+    return jnp.einsum("bd,vd->bv", x, e,
+                      preferred_element_type=jnp.float32)
+
+
+def _moe_exact(y2d, pl, cfg: TransformerConfig, chunk: int = 64):
+    """Exact top-k MoE for [T, D] tokens, position-chunked so the per-token
+    expert-weight gather stays O(chunk * K * D * M) instead of
+    O(T * K * D * M) (a long prompt would otherwise materialize a private
+    copy of its experts' weights per position)."""
+    from multiverso_tpu.parallel.moe import top_k_gates
+    t, d = y2d.shape
+    c = min(t, chunk)
+    pad = (-t) % c
+    if pad:
+        y2d = jnp.concatenate(
+            [y2d, jnp.zeros((pad, d), y2d.dtype)])
+
+    def one_chunk(yc):
+        probs = jax.nn.softmax(
+            (yc @ pl["moe_router"]).astype(jnp.float32), -1)
+        gates, topi = top_k_gates(probs, cfg.moe_top_k)
+        w1_sel = pl["moe_w1"][topi]                  # [C, K, D, M]
+        w2_sel = pl["moe_w2"][topi]
+        hmid = jax.nn.gelu(jnp.einsum("td,tkdm->tkm", yc, w1_sel))
+        out = jnp.einsum("tkm,tkmd->tkd", hmid, w2_sel)
+        return (out * gates[..., None].astype(out.dtype)).sum(1)
+
+    mlp = jax.lax.map(one_chunk, y2d.reshape(-1, c, d)).reshape(-1, d)
+    return mlp[:t]
+
+
 def _decode_step(params, caches, tok, t, cfg: TransformerConfig):
     """One token through all layers, reading/updating the KV cache.
     caches: dict of [L, B, H, max_seq, hd]; tok [B]; t scalar position.
     Returns (caches, logits [B, V] f32). Accepts int8 quantized trees
     (weights dequantize one layer at a time)."""
-    from multiverso_tpu.ops.quantization import (QuantizedTensor,
-                                                 maybe_dequantize)
-
-    def _is_q(x):
-        return isinstance(x, QuantizedTensor)
-
-    def _rows(e, idx):
-        """Embedding-row lookup without materializing the full table."""
-        if _is_q(e):
-            want = (e.q.shape[0],) + (1,) * (e.q.ndim - 1)
-            if e.scale.shape != want:
-                # out-of-bounds gathers clamp silently, so a wrong scale
-                # layout would corrupt decoding without any error
-                raise ValueError(
-                    f"embedding QuantizedTensor needs per-row scales "
-                    f"{want}, got {e.scale.shape}; quantize embeddings "
-                    "with keep_axes=(0,) (quantize_lm_params does)")
-            return e.q[idx].astype(jnp.float32) * e.scale[idx]
-        return e[idx]
+    from multiverso_tpu.ops.quantization import maybe_dequantize
 
     b = tok.shape[0]
     h, d = cfg.num_heads, cfg.dim
     hd = d // h
     neg_inf = jnp.asarray(-1e30, jnp.float32)
-    x = (_rows(params["embed"], tok)
-         + _rows(params["pos"], t)).astype(cfg.dtype)    # [B, D]
+    x = (_emb_rows(params["embed"], tok)
+         + _emb_rows(params["pos"], t)).astype(cfg.dtype)    # [B, D]
 
     def layer(carry, inputs):
         x, = carry
@@ -393,42 +435,27 @@ def _decode_step(params, caches, tok, t, cfg: TransformerConfig):
         y = _rmsnorm(x, pl["ln2"])
         if cfg.moe_experts:
             # exact top-k routing: each token gathers only its chosen
-            # experts' weights (no capacity/dropping at decode time);
-            # gating convention shared with the training path
-            from multiverso_tpu.parallel.moe import top_k_gates
-            probs = jax.nn.softmax(
-                (y @ pl["moe_router"]).astype(jnp.float32), -1)
-            gates, topi = top_k_gates(probs, cfg.moe_top_k)
-            w1_sel = pl["moe_w1"][topi]          # [B, K, D, M]
-            w2_sel = pl["moe_w2"][topi]          # [B, K, M, D]
-            hmid = jax.nn.gelu(
-                jnp.einsum("bd,bkdm->bkm", y, w1_sel))
-            out = jnp.einsum("bkm,bkmd->bkd", hmid, w2_sel)
-            mlp = (out * gates[..., None].astype(out.dtype)).sum(1)
-            return (x + mlp,), (ck, cv)
+            # experts' weights (no capacity/dropping at decode time)
+            return (x + _moe_exact(y, pl, cfg),), (ck, cv)
         y = jax.nn.gelu(y @ pl["w1"])
         return (x + y @ pl["w2"],), (ck, cv)
 
     (x,), (ck, cv) = jax.lax.scan(
         layer, (x,), (params["layers"], caches["k"], caches["v"]))
     x = _rmsnorm(x, params["ln_f"])
-    e = params["embed"]
-    if _is_q(e):
-        # int8 operand straight into the dot (the convert fuses), then
-        # the per-row scale applied on the small [B, V] logits — the
-        # [V, D] f32 table is never materialized
-        logits = jnp.einsum("bd,vd->bv", x, e.q.astype(x.dtype),
-                            preferred_element_type=jnp.float32)
-        logits = logits * e.scale[:, 0][None]
-    else:
-        logits = jnp.einsum("bd,vd->bv", x, e,
-                            preferred_element_type=jnp.float32)
-    return {"k": ck, "v": cv}, logits
+    return {"k": ck, "v": cv}, _tied_logits(x, params["embed"])
 
 
-def _prefill(params, prompt, cfg: TransformerConfig, total: int):
-    """Validate a decode request, build empty KV caches, and feed the
-    prompt token by token. Returns (caches, next-token logits)."""
+def _prefill(params, prompt, cfg: TransformerConfig, total: int,
+             batched: bool = True):
+    """Validate a decode request, build the KV caches from the prompt, and
+    return (caches, next-token logits).
+
+    ``batched=True`` (default) runs ONE causal pass over all prompt
+    positions — the whole prompt hits the MXU as [B, P] matmuls instead
+    of P sequential single-token layer scans; ``batched=False`` keeps the
+    token-by-token path (the decode step itself, so the two must agree —
+    tested)."""
     b, p = prompt.shape
     if p < 1:
         raise ValueError("prompt must contain at least one token (an "
@@ -449,9 +476,14 @@ def _prefill(params, prompt, cfg: TransformerConfig, total: int):
         "v": jnp.zeros((cfg.num_layers, b, h, cfg.max_seq, d // h),
                        cfg.dtype),
     }
+    if batched:
+        ks, vs, logits = _prefill_pass(params, prompt, cfg)
+        caches = {
+            "k": caches["k"].at[:, :, :, :p].set(ks),
+            "v": caches["v"].at[:, :, :, :p].set(vs),
+        }
+        return caches, logits
 
-    # prompt tokens one at a time (simple; prompt lengths here are small —
-    # a batched prefill pass is the known optimization)
     def prefill(carry, i):
         caches, last = carry
         caches, logits = _decode_step(params, caches, prompt[:, i], i, cfg)
@@ -461,6 +493,50 @@ def _prefill(params, prompt, cfg: TransformerConfig, total: int):
         prefill, (caches, jnp.zeros((b, cfg.vocab_size), jnp.float32)),
         jnp.arange(p))
     return caches, logits
+
+
+def _prefill_pass(params, prompt, cfg: TransformerConfig):
+    """One causal pass over the prompt, capturing per-layer K/V.
+    Returns (ks [L,B,H,P,hd], vs [L,B,H,P,hd], last-position logits
+    [B, V] f32). Mirrors _decode_step's math (incl. quantized trees and
+    exact MoE routing) batched over positions."""
+    from multiverso_tpu.ops.quantization import maybe_dequantize
+
+    b, p = prompt.shape
+    h, d = cfg.num_heads, cfg.dim
+    hd = d // h
+    neg_inf = jnp.asarray(-1e30, jnp.float32)
+    x = (_emb_rows(params["embed"], prompt)
+         + _emb_rows(params["pos"], jnp.arange(p))[None]
+         ).astype(cfg.dtype)                                 # [B, P, D]
+    causal = jnp.tril(jnp.ones((p, p), bool))
+
+    def layer(carry, pl):
+        x, = carry
+        pl = jax.tree.map(lambda l: maybe_dequantize(l, cfg.dtype),
+                          pl, is_leaf=_is_q)
+        y = _rmsnorm(x, pl["ln1"])
+        qkv = jnp.einsum("bpd,de->bpe", y, pl["wqkv"])
+        q, kk, vv = jnp.split(qkv, 3, axis=-1)
+        split = lambda t: t.reshape(b, p, h, hd).transpose(0, 2, 1, 3)
+        q, kk, vv = split(q), split(kk), split(vv)           # [B,H,P,hd]
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, kk,
+                       preferred_element_type=jnp.float32) / (hd ** 0.5)
+        s = jnp.where(causal[None, None], s, neg_inf)
+        pattn = jax.nn.softmax(s, -1).astype(vv.dtype)
+        o = jnp.einsum("bhqk,bhkd->bhqd", pattn, vv)
+        o = o.transpose(0, 2, 1, 3).reshape(b, p, d)
+        x = x + jnp.einsum("bpd,de->bpe", o, pl["wo"])
+        y = _rmsnorm(x, pl["ln2"])
+        if cfg.moe_experts:
+            mlp = _moe_exact(y.reshape(b * p, d), pl, cfg)
+            return (x + mlp.reshape(b, p, d),), (kk, vv)
+        y = jax.nn.gelu(jnp.einsum("bpd,dm->bpm", y, pl["w1"]))
+        return (x + jnp.einsum("bpm,md->bpd", y, pl["w2"]),), (kk, vv)
+
+    (x,), (ks, vs) = jax.lax.scan(layer, (x,), params["layers"])
+    xl = _rmsnorm(x[:, -1], params["ln_f"])                  # [B, D]
+    return ks, vs, _tied_logits(xl, params["embed"])
 
 
 def generate(params: Dict[str, Any], prompt: jax.Array,

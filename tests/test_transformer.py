@@ -624,3 +624,30 @@ class TestBeamSearch:
                              num_beams=0)
         with pytest.raises(ValueError, match="max_seq"):
             tf.generate_beam(params, jnp.zeros((1, 6), jnp.int32), cfg, 4)
+
+
+class TestBatchedPrefill:
+    @pytest.mark.parametrize("variant", ["dense", "bf16", "moe", "int8"])
+    def test_batched_prefill_matches_sequential(self, variant):
+        mv.init()
+        kw = dict(vocab_size=32, dim=16, num_heads=2, num_layers=2,
+                  max_seq=24, attn="local")
+        if variant == "bf16":
+            kw["dtype"] = jnp.bfloat16
+        if variant == "moe":
+            kw.update(moe_experts=4, moe_top_k=2)
+        cfg = tf.TransformerConfig(**kw)
+        params = tf.init_params(cfg, seed=6)
+        if variant == "int8":
+            from multiverso_tpu.ops import quantize_lm_params
+            params = quantize_lm_params(params)
+        prompt = jnp.asarray([[4, 9, 1, 7, 2], [8, 8, 3, 0, 5]], jnp.int32)
+        with jax.default_matmul_precision("float32"):
+            cb, lb = tf._prefill(params, prompt, cfg, 10, batched=True)
+            cs, ls = tf._prefill(params, prompt, cfg, 10, batched=False)
+        np.testing.assert_allclose(np.asarray(lb), np.asarray(ls),
+                                   rtol=2e-4, atol=2e-4)
+        for k in ("k", "v"):
+            np.testing.assert_allclose(
+                np.asarray(cb[k], np.float32), np.asarray(cs[k], np.float32),
+                rtol=2e-4, atol=2e-4)
