@@ -184,6 +184,47 @@ def shard_params_tp(params: Dict[str, Any], cfg: TransformerConfig,
         params, tp_lib.transformer_tp_rules(cfg.tp_axis), mesh)
 
 
+def _make_layer_fn(cfg: TransformerConfig, tp_hint, heads_spec, hidden_spec,
+                   mcfg):
+    """One transformer block as a scan body ``(x, aux_sum), p -> ...``.
+
+    Shared by :func:`forward_with_aux` (scan over the whole stack) and
+    :func:`make_pp_train_step` (scan over one pipeline stage's slice of the
+    stack). Shapes are taken from the activation so the same body serves
+    full batches and pipeline microbatches.
+    """
+    h, d = cfg.num_heads, cfg.dim
+    hd = d // h
+    if cfg.moe_experts:
+        from multiverso_tpu.parallel import moe as moe_lib
+
+    def layer(carry, p):
+        x, aux_sum = carry
+        b, s = x.shape[0], x.shape[1]
+        y = _rmsnorm(x, p["ln1"])
+        qkv = jnp.einsum("bsd,de->bse", y, p["wqkv"])
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        # [B, S, D] -> [B, H, S, hd]; tp shards the head dim
+        split = lambda t: tp_hint(
+            t.reshape(b, s, h, hd).transpose(0, 2, 1, 3), heads_spec)
+        o = _attention(cfg, split(q), split(k), split(v))
+        o = o.transpose(0, 2, 1, 3).reshape(b, s, d)
+        x = x + jnp.einsum("bsd,de->bse", o, p["wo"])
+        y = _rmsnorm(x, p["ln2"])
+        if cfg.moe_experts:
+            mlp, aux, _ = moe_lib.moe_layer(
+                y, {"w1": p["moe_w1"], "w2": p["moe_w2"],
+                    "router": p["moe_router"]},
+                mcfg, batch_axis=cfg.batch_axis)
+            return (x + mlp, aux_sum + aux), None
+        # tp shards the MLP hidden dim (column-parallel w1, row-parallel w2)
+        y = tp_hint(jnp.einsum("bsd,dm->bsm", y, p["w1"]), hidden_spec)
+        y = jax.nn.gelu(y)
+        return (x + jnp.einsum("bsm,md->bsd", y, p["w2"]), aux_sum), None
+
+    return layer
+
+
 def forward(params: Dict[str, Any], tokens: jax.Array,
             cfg: TransformerConfig) -> jax.Array:
     """tokens [B, S] -> logits [B, S, V] (MoE aux loss discarded; training
@@ -196,9 +237,8 @@ def forward_with_aux(params: Dict[str, Any], tokens: jax.Array,
     """tokens [B, S] -> (logits [B, S, V], moe aux-loss scalar). Written at
     the global-logical level; the attention call shard_maps over the
     sequence axis and MoE MLPs all_to_all tokens over ``moe_axis``."""
-    b, s = tokens.shape
-    h, d = cfg.num_heads, cfg.dim
-    hd = d // h
+    s = tokens.shape[1]
+    d = cfg.dim
 
     if cfg.moe_experts:
         if cfg.seq_axis is not None or cfg.tp_axis is not None:
@@ -236,28 +276,8 @@ def forward_with_aux(params: Dict[str, Any], tokens: jax.Array,
         pos = params["pos"][:s]
     x = params["embed"][tokens] + pos[None]
 
-    def layer(carry, p):
-        x, aux_sum = carry
-        y = _rmsnorm(x, p["ln1"])
-        qkv = jnp.einsum("bsd,de->bse", y, p["wqkv"])
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        # [B, S, D] -> [B, H, S, hd]; tp shards the head dim
-        split = lambda t: tp_hint(
-            t.reshape(b, s, h, hd).transpose(0, 2, 1, 3), heads_spec)
-        o = _attention(cfg, split(q), split(k), split(v))
-        o = o.transpose(0, 2, 1, 3).reshape(b, s, d)
-        x = x + jnp.einsum("bsd,de->bse", o, p["wo"])
-        y = _rmsnorm(x, p["ln2"])
-        if cfg.moe_experts:
-            mlp, aux, _ = moe_lib.moe_layer(
-                y, {"w1": p["moe_w1"], "w2": p["moe_w2"],
-                    "router": p["moe_router"]},
-                mcfg, batch_axis=cfg.batch_axis)
-            return (x + mlp, aux_sum + aux), None
-        # tp shards the MLP hidden dim (column-parallel w1, row-parallel w2)
-        y = tp_hint(jnp.einsum("bsd,dm->bsm", y, p["w1"]), hidden_spec)
-        y = jax.nn.gelu(y)
-        return (x + jnp.einsum("bsm,md->bsd", y, p["w2"]), aux_sum), None
+    layer = _make_layer_fn(cfg, tp_hint, heads_spec, hidden_spec,
+                           mcfg if cfg.moe_experts else None)
 
     if cfg.remat:
         # prevent_cse=False: safe (and recommended) under lax.scan, avoids
@@ -265,8 +285,21 @@ def forward_with_aux(params: Dict[str, Any], tokens: jax.Array,
         layer = jax.checkpoint(layer, prevent_cse=False)
     (x, aux), _ = jax.lax.scan(layer, (x, jnp.zeros((), jnp.float32)),
                                params["layers"])
-    x = _rmsnorm(x, params["ln_f"])
-    return jnp.einsum("bsd,vd->bsv", x, params["embed"]), aux
+    return _lm_head(x, params["ln_f"], params["embed"]), aux
+
+
+def _lm_head(x, ln_f, embed):
+    """Final norm + tied-embedding projection: [B, S, D] -> [B, S, V]."""
+    return jnp.einsum("bsd,vd->bsv", _rmsnorm(x, ln_f), embed)
+
+
+def _nll(logits, targets, mask=None):
+    """Mean next-token cross-entropy in f32; ``mask`` weights positions."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
+    if mask is not None:
+        return (nll * mask).sum() / jnp.maximum(mask.sum(), 1)
+    return nll.mean()
 
 
 def loss_fn(params, tokens, targets, cfg: TransformerConfig,
@@ -284,12 +317,7 @@ def loss_fn(params, tokens, targets, cfg: TransformerConfig,
                                      _Zoo.get().mesh().shape[ax])
         mask = mask[:, perm]
     logits, aux = forward_with_aux(params, tokens, cfg)
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
-    if mask is not None:
-        nll = (nll * mask).sum() / jnp.maximum(mask.sum(), 1)
-    else:
-        nll = nll.mean()
+    nll = _nll(logits, targets, mask)
     if cfg.moe_experts:
         nll = nll + cfg.moe_aux_coef * aux
     return nll
@@ -328,6 +356,120 @@ def make_optax_train_step(cfg: TransformerConfig, optimizer):
                                                   cfg)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
+
+    return step
+
+
+def stack_pp_params(params: Dict[str, Any], cfg: TransformerConfig,
+                    n_stages: int) -> Dict[str, Any]:
+    """Regroup the [L, ...] layer stack as [n_stages, L/n_stages, ...].
+
+    The pipeline places stage s's slice on device s of the ``pp`` axis
+    (parallel/pipeline.py contract: leading dim = n_stages); each stage
+    scans its local L/n_stages layers per tick.
+    """
+    L = cfg.num_layers
+    if L % n_stages:
+        raise ValueError(f"num_layers={L} not divisible by "
+                         f"n_stages={n_stages}")
+    per = L // n_stages
+    out = {k: v for k, v in params.items() if k != "layers"}
+    out["stages"] = jax.tree.map(
+        lambda p: p.reshape(n_stages, per, *p.shape[1:]), params["layers"])
+    return out
+
+
+def unstack_pp_params(stacked: Dict[str, Any]) -> Dict[str, Any]:
+    """Inverse of :func:`stack_pp_params` (for eval/decode/checkpoint
+    interop with the plain [L, ...] layout)."""
+    out = {k: v for k, v in stacked.items() if k != "stages"}
+    out["layers"] = jax.tree.map(
+        lambda p: np.asarray(p).reshape(p.shape[0] * p.shape[1],
+                                        *p.shape[2:]),
+        stacked["stages"])
+    return out
+
+
+def shard_params_pp(stacked: Dict[str, Any], mesh=None,
+                    axis: str = "pp") -> Dict[str, Any]:
+    """Place a :func:`stack_pp_params` tree: stages split over ``axis``
+    (one stage's layers per device, via pipeline.shard_stages),
+    embeddings/final-norm replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from multiverso_tpu.parallel import pipeline as pp_lib
+    from multiverso_tpu.zoo import Zoo
+    mesh = mesh or Zoo.get().mesh()
+    out = {k: jax.tree.map(
+        lambda p: jax.device_put(p, NamedSharding(mesh, P())), v)
+        for k, v in stacked.items() if k != "stages"}
+    out["stages"] = pp_lib.shard_stages(stacked["stages"], axis=axis,
+                                        mesh=mesh)
+    return out
+
+
+def make_pp_train_step(cfg: TransformerConfig, n_micro: int,
+                       learning_rate: float = 1e-2, axis: str = "pp",
+                       mesh=None):
+    """Pipeline-parallel LM train step: GPipe microbatching over the
+    ``axis`` mesh dimension, backward included.
+
+    The reference's "pipeline" is communication/compute double-buffering
+    (SURVEY §2.10 — `async_buffer.h`, ps_model.cpp GetPipelineTable); layer
+    pipelining is the strategy the PS design could not express. Here the
+    stack runs through parallel/pipeline.py's single-scan microbatch ring
+    and ``jax.grad`` differentiates through the ppermute ring, which
+    reverses the schedule automatically: forward fills stage s at tick t,
+    backward drains it in the transposed order — the GPipe fill/drain
+    schedule without a hand-written backward pass.
+
+    Composition: combine with ``cfg.batch_axis`` on a ``(dp, pp)`` mesh for
+    data-parallel pipelines; ``cfg.remat=True`` recomputes each layer in
+    backward (the standard GPipe memory trade). Params must be
+    :func:`stack_pp_params` + :func:`shard_params_pp`.
+    Returns ``step(stacked, tokens, targets) -> (stacked, loss)``.
+    """
+    from multiverso_tpu.parallel import pipeline as pp_lib
+    from multiverso_tpu.zoo import Zoo
+    mesh = mesh or Zoo.get().mesh()
+    if cfg.moe_experts or cfg.tp_axis is not None or cfg.seq_axis is not None:
+        raise ValueError("the pp step pipelines the dense stack; tp/sp/moe "
+                         "combinations are separate strategies (see "
+                         "shard_params_tp / seq_axis / moe_experts)")
+    if cfg.attn not in ("local", "flash"):
+        raise ValueError("pipeline stages attend within a microbatch that "
+                         "is fully local to the stage; use attn='local' "
+                         "(or 'flash' for the fused per-chip kernel)")
+    n_stages = mesh.shape[axis]
+    if cfg.num_layers % n_stages:
+        raise ValueError(f"num_layers={cfg.num_layers} not divisible by "
+                         f"pp={n_stages}")
+    # inside the pipeline body activations are stage-local, so the layer is
+    # built without global sharding hints (flash lowers to the direct
+    # kernel call rather than its own shard_map)
+    pcfg = cfg._replace(batch_axis=None, tp_axis=None, seq_axis=None)
+    layer = _make_layer_fn(pcfg, lambda t, spec: t, None, None, None)
+    if cfg.remat:
+        layer = jax.checkpoint(layer, prevent_cse=False)
+
+    def stage_fn(p, x):
+        (x, _), _ = jax.lax.scan(layer, (x, jnp.zeros((), jnp.float32)), p)
+        return x
+
+    def loss(stacked, tokens, targets):
+        s = tokens.shape[1]
+        x = stacked["embed"][tokens] + stacked["pos"][:s][None]
+        x = pp_lib.pipeline_apply(stage_fn, stacked["stages"], x, n_micro,
+                                  axis=axis, mesh=mesh,
+                                  batch_axis=cfg.batch_axis)
+        return _nll(_lm_head(x, stacked["ln_f"], stacked["embed"]), targets)
+
+    def step(stacked, tokens, targets):
+        loss_v, grads = jax.value_and_grad(loss)(stacked, tokens, targets)
+        stacked = jax.tree.map(
+            lambda p, g: p - jnp.asarray(learning_rate, p.dtype) * g,
+            stacked, grads)
+        return stacked, loss_v
 
     return step
 
