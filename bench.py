@@ -12,7 +12,19 @@ else 1.0. The recorded baseline (150,881 w/s) is this framework's first
 working implementation — reference-shaped per-pair negative sampling, no
 fusion or batch tuning — so the ratio reads as "TPU-first design over naive
 translation" measured at equal loss (batch/pool retunes are only taken at
-loss parity, see bench_wordembedding).
+loss parity, see bench_wordembedding). Methodology note: the baseline was
+recorded with wall-clock timing (fixed sync cost included), which
+understates the naive implementation's device rate by the intercept's share
+of its ~8 s run — so the slope-vs-wall-clock ratio carries at most a
+few percent of methodology inflation on top of the real speedup.
+
+Timing methodology: the tunneled chip in this environment adds a large
+(~100 ms) fixed per-sync latency, and ``jax.block_until_ready`` does not
+reliably gate on it — so every metric here is measured DIFFERENTIALLY: run
+the workload at two repeat counts with a host readback as the sync point and
+take the slope. The slope is the steady-state device time per unit of work;
+the fixed intercept (tunnel round-trip + dispatch) is reported alongside in
+"extra" for transparency.
 """
 
 from __future__ import annotations
@@ -28,7 +40,18 @@ def _percentile_ms(samples):
     return float(np.percentile(np.asarray(samples) * 1e3, 50))
 
 
-def bench_wordembedding(epochs: int = 3):
+def _differential(run, n_lo: int, n_hi: int):
+    """Two-point slope timing: ``run(n)`` performs n units of work ending in
+    a host readback and returns its wall seconds. Returns
+    ``(sec_per_unit, intercept_s)`` — the steady-state device time per unit
+    and the fixed sync/dispatch cost the slope removed."""
+    t_lo = run(n_lo)
+    t_hi = run(n_hi)
+    slope = (t_hi - t_lo) / (n_hi - n_lo)
+    return slope, max(t_lo - n_lo * slope, 0.0)
+
+
+def bench_wordembedding(n_lo: int = 2, n_hi: int = 10):
     import multiverso_tpu as mv
     from multiverso_tpu.apps.word_embedding import (WEConfig, WordEmbedding,
                                                     synthetic_corpus)
@@ -38,7 +61,8 @@ def bench_wordembedding(epochs: int = 3):
     # batch/negative-pool tuned on-chip: bs=16384 with a 256-wide shared
     # pool matches the bs=4096/K'=64 loss (0.498 vs 0.497 after 5 epochs)
     # at ~1.2x the throughput — bigger scatters amortize, and the larger
-    # pool keeps the negative-sharing correlation at parity
+    # pool keeps the negative-sharing correlation at parity. (A later sweep
+    # found bs=32768 ~6% faster but at a worse 5-epoch loss — rejected.)
     cfg = WEConfig(size=128, min_count=5, batch_size=16384, negative=5,
                    window=5, epoch=1, shared_negatives=256)
     d = Dictionary.build(tokens, cfg.min_count)
@@ -47,9 +71,22 @@ def bench_wordembedding(epochs: int = 3):
     # warmup: compile + first dispatch; 2 epochs because the donated-table
     # epoch fn compiles twice (initial device_put layout vs donated layout)
     we.train_fused(ids, epochs=2)
-    stats = we.train_fused(ids, epochs=epochs)
+    # differential timing: slope between n_lo and n_hi epochs removes the
+    # fixed tunnel/dispatch intercept (train_fused reads the loss back on
+    # the host, which is the reliable sync point here)
+    last = {}
+
+    def run(n):
+        last.update(we.train_fused(ids, epochs=n))
+        return last["seconds"]
+
+    sec_per_epoch, intercept = _differential(run, n_lo, n_hi)
+    words_per_sec = ids.size / sec_per_epoch
     n_chips = max(len(mv.mesh().devices.reshape(-1)), 1)
-    return stats["words_per_sec"] / n_chips, stats
+    stats = {"loss": last["loss"], "sec_per_epoch": sec_per_epoch,
+             "fixed_overhead_s": intercept,
+             "words_per_sec": words_per_sec}
+    return words_per_sec / n_chips, stats
 
 
 def bench_array_table(size: int = 1_000_000, iters: int = 10):
@@ -85,14 +122,20 @@ def bench_array_table(size: int = 1_000_000, iters: int = 10):
             state, None, length=chain)[0]
 
     state = fadd_chain(t.state, delta_dev)  # compile
-    jax.block_until_ready(state["data"])
-    dev_adds = []
-    for _ in range(iters):
+    float(state["data"][0])
+    box = {"state": state}
+
+    def run(n):
         t0 = time.perf_counter()
-        state = fadd_chain(state, delta_dev)
-        jax.block_until_ready(state["data"])
-        dev_adds.append((time.perf_counter() - t0) / chain)
-    t.adopt(state)
+        for _ in range(n):
+            box["state"] = fadd_chain(box["state"], delta_dev)
+        float(box["state"]["data"][0])  # host readback = reliable sync
+        return time.perf_counter() - t0
+
+    # differential over chained runs: slope removes the fixed sync cost
+    per_chain, dev_intercept = _differential(run, 2, 8)
+    dev_add_s = per_chain / chain
+    t.adopt(box["state"])
 
     nbytes = size * 4
     return {
@@ -100,13 +143,14 @@ def bench_array_table(size: int = 1_000_000, iters: int = 10):
         "get_p50_ms": _percentile_ms(gets),
         "add_gbps": nbytes / np.percentile(adds, 50) / 1e9,
         "get_gbps": nbytes / np.percentile(gets, 50) / 1e9,
-        "device_add_p50_ms": _percentile_ms(dev_adds),
-        "device_add_gbps": nbytes / np.percentile(dev_adds, 50) / 1e9,
+        "device_add_ms": dev_add_s * 1e3,
+        "device_add_gbps": nbytes / dev_add_s / 1e9,
+        "fixed_overhead_ms": dev_intercept * 1e3,
         "size_mb": nbytes / 1e6,
     }
 
 
-def bench_transformer(steps: int = 10):
+def bench_transformer(steps: int = 40):
     """LM train-step throughput (tokens/sec) with the fused flash-attention
     kernel on TPU (reference_attention elsewhere — interpret-mode Pallas
     would measure the interpreter, not the chip)."""
@@ -127,15 +171,57 @@ def bench_transformer(steps: int = 10):
     tok, tgt = jnp.asarray(toks[:, :-1]), jnp.asarray(toks[:, 1:])
     step = jax.jit(tfm.make_train_step(cfg, 1e-2))
     params, loss = step(params, tok, tgt)  # compile
-    jax.block_until_ready(loss)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, loss = step(params, tok, tgt)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
-    return {"lm_tokens_per_sec": b * s * steps / dt,
-            "lm_step_ms": dt / steps * 1e3,
-            "attn": cfg.attn, "loss": float(loss)}
+    float(loss)
+
+    last = {}
+
+    def run(n):
+        nonlocal params
+        t0 = time.perf_counter()
+        for _ in range(n):
+            params, loss = step(params, tok, tgt)
+        last["loss"] = float(loss)  # host readback = reliable sync
+        return time.perf_counter() - t0
+
+    step_s, intercept = _differential(run, max(steps // 4, 1), steps)
+    return {"lm_tokens_per_sec": b * s / step_s,
+            "lm_step_ms": step_s * 1e3,
+            "fixed_overhead_ms": intercept * 1e3,
+            "attn": cfg.attn, "loss": last["loss"]}
+
+
+def bench_resnet(depth: int = 32, n_images: int = 50_000):
+    """CIFAR ResNet sec/epoch — the reference's published headline
+    (binding BENCHMARK.md tables: Lasagne ResNet-32 100.02 s/epoch on a
+    GTX TITAN X; Torch 20.366 s/epoch; see BASELINE.md). Synthetic CIFAR
+    (no egress), same 50k-image epoch, batch 128, data-parallel trainer
+    with all params in one Adam ArrayTable."""
+    import jax.numpy as jnp
+
+    from multiverso_tpu.apps.resnet_cifar import ResNetTrainer
+    from multiverso_tpu.models import resnet as resnet_lib
+
+    trainer = ResNetTrainer(depth=depth, batch_size=128)
+    x, y = resnet_lib.synthetic_cifar(n_images, seed=1)
+    # upload the dataset ONCE (the 600 MB host->device transfer would
+    # otherwise dominate every timed call over the tunnel)
+    x, y = jnp.asarray(x), jnp.asarray(y)
+    # warm twice: the epoch fn can compile a second time when the adopted
+    # (donated) buffer layout differs from the first device_put
+    trainer.train(x, y, epochs=1)
+    trainer.train(x, y, epochs=1)
+    sec_per_epoch, intercept = _differential(
+        lambda n: trainer.train(x, y, epochs=n)["seconds"], 1, 9)
+    # the trainer drops the 50k % 128 remainder; count what actually ran,
+    # and scale the reference comparison to a full-50k-image epoch
+    n_eff = (n_images // 128) * 128
+    sec_50k = sec_per_epoch * n_images / n_eff
+    return {"sec_per_epoch": sec_per_epoch,
+            "images_per_sec": n_eff / sec_per_epoch,
+            "images_per_epoch": n_eff, "depth": depth,
+            "fixed_overhead_s": intercept,
+            "vs_ref_theano_titanx": 100.02 / sec_50k,
+            "vs_ref_torch_titanx": 20.366 / sec_50k}
 
 
 def main() -> None:
@@ -148,6 +234,10 @@ def main() -> None:
         lm_stats = bench_transformer()
     except Exception as e:  # secondary metric must never sink the bench
         lm_stats = {"error": f"{type(e).__name__}: {e}"[:200]}
+    try:
+        resnet_stats = bench_resnet()
+    except Exception as e:
+        resnet_stats = {"error": f"{type(e).__name__}: {e}"[:200]}
     mv.shutdown()
 
     baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -177,8 +267,10 @@ def main() -> None:
         "vs_baseline": round(vs_baseline, 3),
         "extra": {
             "we_loss": round(we_stats["loss"], 4),
+            "we_sec_per_epoch": round(we_stats["sec_per_epoch"], 4),
             "array_table_4M_float32": array_stats,
             "transformer_lm_bs8_seq512_d256_L4": lm_stats,
+            "resnet32_cifar_50k": resnet_stats,
         },
     }))
 

@@ -105,12 +105,14 @@ class ResNetTrainer:
         t0, losses = time.perf_counter(), None
         for _ in range(epochs):
             state, bn, losses = epoch(state, bn, xb, yb)
-        jax.block_until_ready(state["data"])
+        # host readback = reliable device drain (block_until_ready can
+        # return early over a remote/tunneled PJRT transport)
+        loss = float(jnp.mean(losses))
         dt = time.perf_counter() - t0
         self.table.adopt(state)
         self.bn = bn
         n = int(np.prod(yb.shape)) * epochs
-        return {"loss": float(jnp.mean(losses)),
+        return {"loss": loss,
                 "images_per_sec": n / dt, "seconds": dt,
                 "sec_per_epoch": dt / epochs}
 

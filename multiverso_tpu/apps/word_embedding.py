@@ -277,13 +277,17 @@ class WordEmbedding:
                                       "ustate": state_out["ustate"]})
             self.table_in.adopt({"data": win, "ustate": state_in["ustate"]})
 
+        # host readback of the scalar loss is the reliable device-drain sync
+        # (block_until_ready alone can return early over a remote/tunneled
+        # PJRT transport), so fetch it BEFORE stopping the clock
+        loss_f = float(loss)
         dt = time.perf_counter() - t0
         # words/sec follows the word2vec convention: corpus *tokens* consumed
         # per second (ref trainer.cpp words/sec), not training pairs.
         words = epochs * int(ids.size)
         self._trained_words += words
         self.word_count.add([0], [words])
-        return {"loss": float(loss), "words_per_sec": words / dt,
+        return {"loss": loss_f, "words_per_sec": words / dt,
                 "seconds": dt, "pairs": int(pairs),
                 "pairs_per_sec": epochs * pairs / dt}
 

@@ -408,11 +408,10 @@ def shard_params_pp(stacked: Dict[str, Any], mesh=None,
     return out
 
 
-def make_pp_train_step(cfg: TransformerConfig, n_micro: int,
-                       learning_rate: float = 1e-2, axis: str = "pp",
-                       mesh=None):
-    """Pipeline-parallel LM train step: GPipe microbatching over the
-    ``axis`` mesh dimension, backward included.
+def make_pp_loss_fn(cfg: TransformerConfig, n_micro: int, axis: str = "pp",
+                    mesh=None):
+    """Pipelined LM loss ``loss(stacked, tokens, targets)`` over the
+    ``axis`` mesh dimension (GPipe microbatch ring, parallel/pipeline.py).
 
     The reference's "pipeline" is communication/compute double-buffering
     (SURVEY §2.10 — `async_buffer.h`, ps_model.cpp GetPipelineTable); layer
@@ -427,7 +426,6 @@ def make_pp_train_step(cfg: TransformerConfig, n_micro: int,
     data-parallel pipelines; ``cfg.remat=True`` recomputes each layer in
     backward (the standard GPipe memory trade). Params must be
     :func:`stack_pp_params` + :func:`shard_params_pp`.
-    Returns ``step(stacked, tokens, targets) -> (stacked, loss)``.
     """
     from multiverso_tpu.parallel import pipeline as pp_lib
     from multiverso_tpu.zoo import Zoo
@@ -464,12 +462,43 @@ def make_pp_train_step(cfg: TransformerConfig, n_micro: int,
                                   batch_axis=cfg.batch_axis)
         return _nll(_lm_head(x, stacked["ln_f"], stacked["embed"]), targets)
 
+    return loss
+
+
+def make_pp_train_step(cfg: TransformerConfig, n_micro: int,
+                       learning_rate: float = 1e-2, axis: str = "pp",
+                       mesh=None):
+    """Plain-SGD pipeline-parallel LM train step (see
+    :func:`make_pp_loss_fn` for the pipelining semantics).
+    Returns ``step(stacked, tokens, targets) -> (stacked, loss)``."""
+    loss = make_pp_loss_fn(cfg, n_micro, axis, mesh)
+
     def step(stacked, tokens, targets):
         loss_v, grads = jax.value_and_grad(loss)(stacked, tokens, targets)
         stacked = jax.tree.map(
             lambda p, g: p - jnp.asarray(learning_rate, p.dtype) * g,
             stacked, grads)
         return stacked, loss_v
+
+    return step
+
+
+def make_pp_optax_train_step(cfg: TransformerConfig, n_micro: int,
+                             optimizer, axis: str = "pp", mesh=None):
+    """Pipelined step for any optax GradientTransformation:
+    ``(stacked, opt_state, tokens, targets) -> (stacked, opt_state, loss)``.
+    Initialize with ``optimizer.init(stacked)`` — optimizer moments inherit
+    each stage's placement, so Adam state for stage s lives only on device
+    s of the ``pp`` axis (the reference pays per-shard updater state the
+    same way, ref adagrad_updater.h:19)."""
+    import optax
+
+    loss = make_pp_loss_fn(cfg, n_micro, axis, mesh)
+
+    def step(stacked, opt_state, tokens, targets):
+        loss_v, grads = jax.value_and_grad(loss)(stacked, tokens, targets)
+        updates, opt_state = optimizer.update(grads, opt_state, stacked)
+        return optax.apply_updates(stacked, updates), opt_state, loss_v
 
     return step
 

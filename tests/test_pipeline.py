@@ -211,3 +211,36 @@ class TestPipelinedTransformerLM:
             tfm.make_pp_train_step(self._cfg(moe_experts=4), 4, mesh=mesh)
         with pytest.raises(ValueError, match="divisible"):
             tfm.make_pp_train_step(self._cfg(num_layers=12), 4, mesh=mesh)
+
+    def test_optax_step_matches_single_program(self):
+        import optax
+
+        from multiverso_tpu.models import transformer as tfm
+        mesh = Mesh(np.asarray(jax.devices()), ("pp",))
+        mv.init(mesh=mesh)
+        cfg = self._cfg()
+        opt = optax.adamw(1e-2)
+        params = tfm.init_params(cfg, seed=7)
+        tok, tgt = self._batch(cfg, seed=9)
+
+        ref_step = jax.jit(tfm.make_optax_train_step(cfg, opt),
+                           static_argnums=())
+        expect, _, expect_loss = ref_step(params, opt.init(params), tok, tgt)
+
+        stacked = tfm.shard_params_pp(
+            tfm.stack_pp_params(params, cfg, 8), mesh=mesh)
+        step = jax.jit(tfm.make_pp_optax_train_step(cfg, n_micro=4,
+                                                    optimizer=opt,
+                                                    mesh=mesh))
+        new, _, loss = step(stacked, opt.init(stacked), tok, tgt)
+        np.testing.assert_allclose(float(loss), float(expect_loss),
+                                   rtol=1e-5)
+        got = tfm.unstack_pp_params(new)
+        for k, v in got["layers"].items():
+            np.testing.assert_allclose(np.asarray(v),
+                                       np.asarray(expect["layers"][k]),
+                                       rtol=2e-2, atol=1e-3,
+                                       err_msg=f"layers[{k}]")
+        np.testing.assert_allclose(np.asarray(got["embed"]),
+                                   np.asarray(expect["embed"]),
+                                   rtol=2e-2, atol=1e-3)
