@@ -360,41 +360,99 @@ def make_optax_train_step(cfg: TransformerConfig, optimizer):
     return step
 
 
+def _qkv_head_perm(d: int, h: int) -> np.ndarray:
+    """Column permutation taking wqkv's [q_all | k_all | v_all] layout to
+    head-grouped [(q,k,v) of head 0 | (q,k,v) of head 1 | ...].
+
+    Needed for tensor parallelism inside pipeline stages: sharding the
+    3d output dim contiguously must hand each tp member whole heads (the
+    Megatron interleaved-qkv trick)."""
+    hd = d // h
+    return np.asarray([c * d + g * hd + i
+                       for g in range(h) for c in range(3)
+                       for i in range(hd)], dtype=np.int64)
+
+
 def stack_pp_params(params: Dict[str, Any], cfg: TransformerConfig,
-                    n_stages: int) -> Dict[str, Any]:
+                    n_stages: int,
+                    tp: Optional[bool] = None) -> Dict[str, Any]:
     """Regroup the [L, ...] layer stack as [n_stages, L/n_stages, ...].
 
     The pipeline places stage s's slice on device s of the ``pp`` axis
     (parallel/pipeline.py contract: leading dim = n_stages); each stage
-    scans its local L/n_stages layers per tick.
+    scans its local L/n_stages layers per tick. When the config has a
+    ``tp_axis`` (default ``tp=None`` reads it from ``cfg``, so the same
+    config drives stacking, sharding and the step consistently) the wqkv
+    columns are permuted head-grouped (see :func:`_qkv_head_perm`) so a
+    contiguous tp shard owns whole heads.
     """
+    if tp is None:
+        tp = cfg.tp_axis is not None
     L = cfg.num_layers
     if L % n_stages:
         raise ValueError(f"num_layers={L} not divisible by "
                          f"n_stages={n_stages}")
     per = L // n_stages
+    layers = dict(params["layers"])
+    if tp:
+        layers["wqkv"] = layers["wqkv"][
+            ..., _qkv_head_perm(cfg.dim, cfg.num_heads)]
     out = {k: v for k, v in params.items() if k != "layers"}
     out["stages"] = jax.tree.map(
-        lambda p: p.reshape(n_stages, per, *p.shape[1:]), params["layers"])
+        lambda p: p.reshape(n_stages, per, *p.shape[1:]), layers)
     return out
 
 
-def unstack_pp_params(stacked: Dict[str, Any]) -> Dict[str, Any]:
+def unstack_pp_params(stacked: Dict[str, Any],
+                      cfg: Optional[TransformerConfig] = None,
+                      tp: Optional[bool] = None) -> Dict[str, Any]:
     """Inverse of :func:`stack_pp_params` (for eval/decode/checkpoint
-    interop with the plain [L, ...] layout)."""
+    interop with the plain [L, ...] layout). Pass the same ``cfg`` used at
+    stack time so the head-grouped qkv layout is undone (``tp`` defaults
+    from ``cfg.tp_axis`` exactly like :func:`stack_pp_params`)."""
+    if tp is None:
+        tp = cfg is not None and cfg.tp_axis is not None
     out = {k: v for k, v in stacked.items() if k != "stages"}
-    out["layers"] = jax.tree.map(
+    layers = jax.tree.map(
         lambda p: np.asarray(p).reshape(p.shape[0] * p.shape[1],
                                         *p.shape[2:]),
         stacked["stages"])
+    if tp:
+        if cfg is None:
+            raise ValueError("unstack_pp_params(tp=True) needs cfg to "
+                             "invert the head-grouped qkv layout")
+        perm = _qkv_head_perm(cfg.dim, cfg.num_heads)
+        inv = np.empty_like(perm)
+        inv[perm] = np.arange(perm.size)
+        layers["wqkv"] = layers["wqkv"][..., inv]
+    out["layers"] = layers
     return out
 
 
+def _pp_stage_specs(cfg: TransformerConfig, axis: str):
+    """PartitionSpecs for the stages subtree under pp x tp: weights split
+    over ``cfg.tp_axis`` on the Megatron dims (qkv/w1 output-sharded,
+    wo/w2 input-sharded), norms pp-only."""
+    from jax.sharding import PartitionSpec as P
+    t = cfg.tp_axis
+    return {
+        "wqkv": P(axis, None, None, t),
+        "wo": P(axis, None, t, None),
+        "ln1": P(axis), "ln2": P(axis),
+        "w1": P(axis, None, None, t),
+        "w2": P(axis, None, t, None),
+    }
+
+
 def shard_params_pp(stacked: Dict[str, Any], mesh=None,
-                    axis: str = "pp") -> Dict[str, Any]:
+                    axis: str = "pp",
+                    cfg: Optional[TransformerConfig] = None
+                    ) -> Dict[str, Any]:
     """Place a :func:`stack_pp_params` tree: stages split over ``axis``
     (one stage's layers per device, via pipeline.shard_stages),
-    embeddings/final-norm replicated."""
+    embeddings/final-norm replicated. Pass ``cfg`` with ``tp_axis`` set to
+    additionally shard each stage's weights tensor-parallel
+    (:func:`_pp_stage_specs`)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from multiverso_tpu.parallel import pipeline as pp_lib
@@ -403,9 +461,47 @@ def shard_params_pp(stacked: Dict[str, Any], mesh=None,
     out = {k: jax.tree.map(
         lambda p: jax.device_put(p, NamedSharding(mesh, P())), v)
         for k, v in stacked.items() if k != "stages"}
-    out["stages"] = pp_lib.shard_stages(stacked["stages"], axis=axis,
-                                        mesh=mesh)
+    if cfg is not None and cfg.tp_axis is not None:
+        specs = _pp_stage_specs(cfg, axis)
+        out["stages"] = {
+            k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+            for k, v in stacked["stages"].items()}
+    else:
+        out["stages"] = pp_lib.shard_stages(stacked["stages"], axis=axis,
+                                            mesh=mesh)
     return out
+
+
+def _make_tp_layer_fn(cfg: TransformerConfig, tp_axis: str, n_tp: int):
+    """Transformer block with EXPLICIT Megatron tensor parallelism, for use
+    inside an enclosing shard_map (the pipeline body): weights arrive as
+    tp-local shards (head-grouped qkv — whole heads per member; w1
+    column-, wo/w2 row-sharded) and each sublayer ends in ONE
+    ``lax.psum`` over ``tp_axis`` — the column->row pairing of
+    parallel/tp.py spelled out at the collective level because GSPMD hints
+    cannot cross a manual shard_map boundary."""
+    h, d = cfg.num_heads, cfg.dim
+    hd = d // h
+    h_loc = h // n_tp
+
+    def layer(carry, p):
+        x, aux_sum = carry
+        b, s = x.shape[0], x.shape[1]
+        y = _rmsnorm(x, p["ln1"])
+        qkv = jnp.einsum("bsd,de->bse", y, p["wqkv"])  # [b,s,3d/t] by head
+        qkv = qkv.reshape(b, s, h_loc, 3, hd).transpose(0, 2, 3, 1, 4)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        o = _attention(cfg, q, k, v)                   # local heads
+        o = o.transpose(0, 2, 1, 3).reshape(b, s, h_loc * hd)
+        x = x + jax.lax.psum(
+            jnp.einsum("bsd,de->bse", o, p["wo"]), tp_axis)
+        y = _rmsnorm(x, p["ln2"])
+        y = jax.nn.gelu(jnp.einsum("bsd,dm->bsm", y, p["w1"]))
+        x = x + jax.lax.psum(
+            jnp.einsum("bsm,md->bsd", y, p["w2"]), tp_axis)
+        return (x, aux_sum), None
+
+    return layer
 
 
 def make_pp_loss_fn(cfg: TransformerConfig, n_micro: int, axis: str = "pp",
@@ -423,17 +519,20 @@ def make_pp_loss_fn(cfg: TransformerConfig, n_micro: int, axis: str = "pp",
     schedule without a hand-written backward pass.
 
     Composition: combine with ``cfg.batch_axis`` on a ``(dp, pp)`` mesh for
-    data-parallel pipelines; ``cfg.remat=True`` recomputes each layer in
-    backward (the standard GPipe memory trade). Params must be
-    :func:`stack_pp_params` + :func:`shard_params_pp`.
+    data-parallel pipelines; set ``cfg.tp_axis`` on a ``(dp, pp, tp)`` mesh
+    to additionally run Megatron tensor parallelism INSIDE each stage
+    (explicit psum layer, :func:`_make_tp_layer_fn`; stack with ``tp=True``
+    and shard with ``cfg=`` so qkv is head-grouped); ``cfg.remat=True``
+    recomputes each layer in backward (the standard GPipe memory trade).
+    Params must be :func:`stack_pp_params` + :func:`shard_params_pp`.
     """
     from multiverso_tpu.parallel import pipeline as pp_lib
     from multiverso_tpu.zoo import Zoo
     mesh = mesh or Zoo.get().mesh()
-    if cfg.moe_experts or cfg.tp_axis is not None or cfg.seq_axis is not None:
-        raise ValueError("the pp step pipelines the dense stack; tp/sp/moe "
+    if cfg.moe_experts or cfg.seq_axis is not None:
+        raise ValueError("the pp step pipelines the dense stack; sp/moe "
                          "combinations are separate strategies (see "
-                         "shard_params_tp / seq_axis / moe_experts)")
+                         "seq_axis / moe_experts)")
     if cfg.attn not in ("local", "flash"):
         raise ValueError("pipeline stages attend within a microbatch that "
                          "is fully local to the stage; use attn='local' "
@@ -446,7 +545,18 @@ def make_pp_loss_fn(cfg: TransformerConfig, n_micro: int, axis: str = "pp",
     # built without global sharding hints (flash lowers to the direct
     # kernel call rather than its own shard_map)
     pcfg = cfg._replace(batch_axis=None, tp_axis=None, seq_axis=None)
-    layer = _make_layer_fn(pcfg, lambda t, spec: t, None, None, None)
+    param_specs = None
+    if cfg.tp_axis is not None:
+        n_tp = mesh.shape[cfg.tp_axis]
+        if cfg.num_heads % n_tp or (cfg.mlp_ratio * cfg.dim) % n_tp:
+            raise ValueError(
+                f"num_heads={cfg.num_heads} and mlp hidden "
+                f"{cfg.mlp_ratio * cfg.dim} must both be divisible by "
+                f"tp={n_tp}")
+        layer = _make_tp_layer_fn(pcfg, cfg.tp_axis, n_tp)
+        param_specs = _pp_stage_specs(cfg, axis)
+    else:
+        layer = _make_layer_fn(pcfg, lambda t, spec: t, None, None, None)
     if cfg.remat:
         layer = jax.checkpoint(layer, prevent_cse=False)
 
@@ -459,7 +569,8 @@ def make_pp_loss_fn(cfg: TransformerConfig, n_micro: int, axis: str = "pp",
         x = stacked["embed"][tokens] + stacked["pos"][:s][None]
         x = pp_lib.pipeline_apply(stage_fn, stacked["stages"], x, n_micro,
                                   axis=axis, mesh=mesh,
-                                  batch_axis=cfg.batch_axis)
+                                  batch_axis=cfg.batch_axis,
+                                  param_specs=param_specs)
         return _nll(_lm_head(x, stacked["ln_f"], stacked["embed"]), targets)
 
     return loss

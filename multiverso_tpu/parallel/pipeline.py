@@ -42,7 +42,8 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
                    stage_params: Any, x: jax.Array,
                    n_micro: int, axis: str = "pp",
                    mesh: Optional[Mesh] = None,
-                   batch_axis: Optional[str] = None) -> jax.Array:
+                   batch_axis: Optional[str] = None,
+                   param_specs: Any = None) -> jax.Array:
     """Run ``x`` [B, ...] through ``n_stages`` pipelined applications of
     ``stage_fn``; batch is split into ``n_micro`` microbatches on the fly.
 
@@ -51,6 +52,13 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
     activation shape (the identical-blocks contract of layer pipelining).
     On a multi-axis mesh pass ``batch_axis`` to shard the microbatch dim
     (each batch shard runs its own pipeline over the same stage weights).
+
+    ``param_specs``: optional PartitionSpec pytree (same structure as
+    ``stage_params``) when stage weights are sharded over ADDITIONAL mesh
+    axes beyond the leading ``axis`` dim — e.g. tensor parallelism inside
+    each stage, ``P('pp', None, None, 'tp')``. Each spec's first entry must
+    be ``axis``; ``stage_fn`` then sees tp-local weight shards and may use
+    ``jax.lax.psum`` over those axes (it runs inside this shard_map).
     """
     mesh = mesh or Zoo.get().mesh()
     n_stages = mesh.shape[axis]
@@ -60,6 +68,13 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
                 f"stage_params leaf {jax.tree_util.keystr(path)} has leading "
                 f"dim {leaf.shape[0]}, expected n_stages={n_stages} "
                 f"(mesh axis {axis!r}); fold extra layers into stage_fn")
+    if param_specs is not None:
+        for path, spec in jax.tree_util.tree_leaves_with_path(
+                param_specs, is_leaf=lambda s: isinstance(s, P)):
+            if not spec or spec[0] != axis:
+                raise ValueError(
+                    f"param_specs leaf {jax.tree_util.keystr(path)} must "
+                    f"lead with the pipeline axis {axis!r}, got {spec}")
     b = x.shape[0]
     if b % n_micro:
         raise ValueError(f"batch {b} not divisible by {n_micro} microbatches")
@@ -94,7 +109,8 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
         # every stage holds zeros except the last; psum replicates the result
         return jax.lax.psum(outs, axis)
 
-    pspec = jax.tree.map(lambda _: P(axis), stage_params)
+    pspec = (param_specs if param_specs is not None
+             else jax.tree.map(lambda _: P(axis), stage_params))
     xspec = P(None, batch_axis) if batch_axis else P()
     out = jax.shard_map(body, mesh=mesh,
                         in_specs=(pspec, xspec), out_specs=xspec,

@@ -244,3 +244,61 @@ class TestPipelinedTransformerLM:
         np.testing.assert_allclose(np.asarray(got["embed"]),
                                    np.asarray(expect["embed"]),
                                    rtol=2e-2, atol=1e-3)
+
+    def test_pp_tp_matches_single_program(self):
+        from multiverso_tpu.models import transformer as tfm
+        mesh = Mesh(np.asarray(jax.devices()).reshape(4, 2), ("pp", "tp"))
+        mv.init(mesh=mesh)
+        cfg = self._cfg(tp_axis="tp")
+        lr = 0.05
+        params = tfm.init_params(cfg, seed=5)
+        tok, tgt = self._batch(cfg, seed=11)
+
+        # oracle on the plain (unsharded) single-program path
+        ref_cfg = cfg._replace(tp_axis=None)
+        expect_loss = tfm.loss_fn(params, tok, tgt, ref_cfg)
+        grads = jax.grad(tfm.loss_fn)(params, tok, tgt, ref_cfg)
+        expect = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+
+        stacked = tfm.shard_params_pp(
+            tfm.stack_pp_params(params, cfg, 4, tp=True), mesh=mesh,
+            cfg=cfg)
+        step = jax.jit(tfm.make_pp_train_step(cfg, n_micro=4,
+                                              learning_rate=lr, mesh=mesh))
+        new, loss = step(stacked, tok, tgt)
+        np.testing.assert_allclose(float(loss), float(expect_loss),
+                                   rtol=1e-5)
+        got = tfm.unstack_pp_params(new, cfg=cfg, tp=True)
+        for k in ("embed", "pos", "ln_f"):
+            np.testing.assert_allclose(np.asarray(got[k]),
+                                       np.asarray(expect[k]),
+                                       rtol=5e-4, atol=1e-5)
+        for k, v in got["layers"].items():
+            np.testing.assert_allclose(np.asarray(v),
+                                       np.asarray(expect["layers"][k]),
+                                       rtol=5e-4, atol=1e-5,
+                                       err_msg=f"layers[{k}]")
+
+    def test_dp_pp_tp_trains(self):
+        from multiverso_tpu.models import transformer as tfm
+        mesh = Mesh(np.asarray(jax.devices()).reshape(2, 2, 2),
+                    ("dp", "pp", "tp"))
+        mv.init(mesh=mesh)
+        cfg = self._cfg(batch_axis="dp", tp_axis="tp", num_layers=4)
+        params = tfm.init_params(cfg, seed=2)
+        tok, tgt = self._batch(cfg, b=8, seed=13)
+        expect_loss = float(
+            tfm.loss_fn(params, tok, tgt, cfg._replace(tp_axis=None,
+                                                       batch_axis=None)))
+        stacked = tfm.shard_params_pp(
+            tfm.stack_pp_params(params, cfg, 2, tp=True), mesh=mesh,
+            cfg=cfg)
+        step = jax.jit(tfm.make_pp_train_step(cfg, n_micro=2,
+                                              learning_rate=0.1, mesh=mesh))
+        new, first = step(stacked, tok, tgt)
+        np.testing.assert_allclose(float(first), expect_loss, rtol=1e-5)
+        losses = [float(first)]
+        for _ in range(6):
+            new, l = step(new, tok, tgt)
+            losses.append(float(l))
+        assert losses[-1] < losses[0] - 0.1, losses
