@@ -245,6 +245,26 @@ _LCG_A = np.uint32(1664525)
 _LCG_C = np.uint32(1013904223)
 
 
+@functools.lru_cache(maxsize=8)
+def _lcg_jump_consts(n: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Closed-form LCG jump constants: ``s_t = A^t * s_0 + C_t (mod 2^32)``
+    for t = 1..n, so a whole epoch's negative-sampler states come from one
+    vectorized [n, K'] expression instead of n sequential in-scan steps
+    (which profiled at ~17% of the epoch). Bit-identical to stepping the
+    recurrence n times."""
+    At = np.empty(n, np.uint32)
+    Ct = np.empty(n, np.uint32)
+    # python ints masked to 32 bits: np.uint32 scalar arithmetic would wrap
+    # correctly too but spews RuntimeWarnings on every overflow
+    mask, A, C = 0xFFFFFFFF, int(_LCG_A), int(_LCG_C)
+    a, c = A, C
+    for t in range(n):
+        At[t], Ct[t] = a, c
+        a = (a * A) & mask
+        c = (c * A + C) & mask
+    return At, Ct
+
+
 def shared_neg_step(win: jax.Array, wout: jax.Array, centers: jax.Array,
                     contexts: jax.Array, neg_ids: jax.Array, lr: float,
                     neg_weight: float = 1.0,
@@ -280,6 +300,9 @@ def shared_neg_step(win: jax.Array, wout: jax.Array, centers: jax.Array,
             - neg_weight * jnp.mean(
                 jnp.sum(jax.nn.log_sigmoid(-negs), axis=-1)))
     win = win.at[centers].add(dv.astype(win.dtype))
+    # two scatters, NOT one concat'd scatter: the K'-row pool scatter is
+    # nearly free while concatenation forces an extra [B+K', D]
+    # materialization (measured ~30% slower per batch on-chip)
     wout = wout.at[contexts].add(dup.astype(wout.dtype))
     wout = wout.at[neg_ids].add(dun.astype(wout.dtype))
     return win, wout, loss
@@ -308,19 +331,26 @@ def make_fused_shared_epoch(cfg: W2VConfig, unigram: np.ndarray,
     # every call pays a full-table copy before the first scatter
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def epoch_fn(win, wout, centers, contexts, lcg_state):
+        # the whole epoch's sampler states in one closed-form jump + ONE
+        # batched table gather (bit-identical to stepping the LCG per
+        # batch, which serialized ~17% of the epoch on small VPU ops)
+        At, Ct = _lcg_jump_consts(centers.shape[0])
+        s_all = (lcg_state[None, :] * jnp.asarray(At)[:, None]
+                 + jnp.asarray(Ct)[:, None])
+        nids = jnp.take(neg_table, (s_all >> shift).astype(jnp.int32),
+                        axis=0)
+
         def body(carry, batch):
-            win, wout, s = carry
-            c, x = batch
-            s = s * _LCG_A + _LCG_C
-            nid = jnp.take(neg_table, (s >> shift).astype(jnp.int32), axis=0)
+            win, wout, = carry
+            c, x, nid = batch
             win, wout, loss = shared_neg_step(
                 win, wout, c, x, nid, cfg.learning_rate, neg_weight,
                 compute_dtype)
-            return (win, wout, s), loss
+            return (win, wout), loss
 
-        (win, wout, s), losses = jax.lax.scan(
-            body, (win, wout, lcg_state), (centers, contexts))
-        return win, wout, jnp.mean(losses), s
+        (win, wout), losses = jax.lax.scan(
+            body, (win, wout), (centers, contexts, nids))
+        return win, wout, jnp.mean(losses), s_all[-1]
 
     return epoch_fn
 
