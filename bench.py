@@ -111,7 +111,9 @@ def bench_array_table(size: int = 1_000_000, iters: int = 10):
     import jax
 
     delta_dev = jax.device_put(t.pad_delta(delta), t.sharding)
-    chain = 100
+    # long chain: the per-add time is ~us-scale, so the slope base must be
+    # large enough that ~10 ms of sync jitter cannot swamp it
+    chain = 1000
 
     # chain the adds inside one program: per-dispatch tunnel round-trips
     # (~10s of ms here) would otherwise swamp the ~us-scale device op

@@ -46,6 +46,9 @@ class TransformerConfig(NamedTuple):
     # for the dominant per-layer activation memory; the HBM lever for deep
     # stacks / long sequences
     remat: bool = False
+    # interleaved pipeline schedule: virtual chunks per pp device (see
+    # parallel/pipeline.pipeline_apply_interleaved); 1 = plain GPipe
+    pp_chunks: int = 1
     # expert-parallel MoE MLPs (parallel/moe.py): 0 = dense MLP
     moe_experts: int = 0
     moe_axis: str = "ep"             # mesh axis the experts shard over
@@ -374,8 +377,8 @@ def _qkv_head_perm(d: int, h: int) -> np.ndarray:
 
 
 def stack_pp_params(params: Dict[str, Any], cfg: TransformerConfig,
-                    n_stages: int,
-                    tp: Optional[bool] = None) -> Dict[str, Any]:
+                    n_stages: int, tp: Optional[bool] = None,
+                    pp_chunks: Optional[int] = None) -> Dict[str, Any]:
     """Regroup the [L, ...] layer stack as [n_stages, L/n_stages, ...].
 
     The pipeline places stage s's slice on device s of the ``pp`` axis
@@ -384,39 +387,64 @@ def stack_pp_params(params: Dict[str, Any], cfg: TransformerConfig,
     ``tp_axis`` (default ``tp=None`` reads it from ``cfg``, so the same
     config drives stacking, sharding and the step consistently) the wqkv
     columns are permuted head-grouped (see :func:`_qkv_head_perm`) so a
-    contiguous tp shard owns whole heads.
+    contiguous tp shard owns whole heads. ``pp_chunks > 1`` produces the
+    [n_stages, pp_chunks, per, ...] layout of the interleaved schedule
+    (pipeline.pipeline_apply_interleaved).
     """
     if tp is None:
         tp = cfg.tp_axis is not None
+    if pp_chunks is None:
+        pp_chunks = cfg.pp_chunks
     L = cfg.num_layers
-    if L % n_stages:
+    groups = n_stages * pp_chunks
+    if L % groups:
         raise ValueError(f"num_layers={L} not divisible by "
-                         f"n_stages={n_stages}")
-    per = L // n_stages
+                         f"n_stages*pp_chunks={groups}")
+    per = L // groups
     layers = dict(params["layers"])
     if tp:
+        if pp_chunks > 1:
+            raise ValueError("tp_axis with pp_chunks > 1 is not supported "
+                             "yet; pick one of tensor parallelism or the "
+                             "interleaved schedule per step")
         layers["wqkv"] = layers["wqkv"][
             ..., _qkv_head_perm(cfg.dim, cfg.num_heads)]
     out = {k: v for k, v in params.items() if k != "layers"}
-    out["stages"] = jax.tree.map(
-        lambda p: p.reshape(n_stages, per, *p.shape[1:]), layers)
+    if pp_chunks > 1:
+        # interleaved layout: global group g -> (device g % S, chunk g // S)
+        out["stages"] = jax.tree.map(
+            lambda p: p.reshape(pp_chunks, n_stages, per, *p.shape[1:])
+                       .swapaxes(0, 1), layers)
+    else:
+        out["stages"] = jax.tree.map(
+            lambda p: p.reshape(n_stages, per, *p.shape[1:]), layers)
     return out
 
 
 def unstack_pp_params(stacked: Dict[str, Any],
                       cfg: Optional[TransformerConfig] = None,
-                      tp: Optional[bool] = None) -> Dict[str, Any]:
+                      tp: Optional[bool] = None,
+                      pp_chunks: Optional[int] = None) -> Dict[str, Any]:
     """Inverse of :func:`stack_pp_params` (for eval/decode/checkpoint
-    interop with the plain [L, ...] layout). Pass the same ``cfg`` used at
-    stack time so the head-grouped qkv layout is undone (``tp`` defaults
-    from ``cfg.tp_axis`` exactly like :func:`stack_pp_params`)."""
+    interop with the plain [L, ...] layout). Pass the same ``cfg`` (and
+    ``pp_chunks``) used at stack time so the head-grouped qkv layout and
+    the interleaved chunk layout are undone (``tp`` defaults from
+    ``cfg.tp_axis`` exactly like :func:`stack_pp_params`)."""
     if tp is None:
         tp = cfg is not None and cfg.tp_axis is not None
+    if pp_chunks is None:
+        pp_chunks = cfg.pp_chunks if cfg is not None else 1
     out = {k: v for k, v in stacked.items() if k != "stages"}
-    layers = jax.tree.map(
-        lambda p: np.asarray(p).reshape(p.shape[0] * p.shape[1],
-                                        *p.shape[2:]),
-        stacked["stages"])
+    if pp_chunks > 1:
+        layers = jax.tree.map(
+            lambda p: np.asarray(p).swapaxes(0, 1).reshape(
+                p.shape[0] * p.shape[1] * p.shape[2], *p.shape[3:]),
+            stacked["stages"])
+    else:
+        layers = jax.tree.map(
+            lambda p: np.asarray(p).reshape(p.shape[0] * p.shape[1],
+                                            *p.shape[2:]),
+            stacked["stages"])
     if tp:
         if cfg is None:
             raise ValueError("unstack_pp_params(tp=True) needs cfg to "
@@ -505,7 +533,7 @@ def _make_tp_layer_fn(cfg: TransformerConfig, tp_axis: str, n_tp: int):
 
 
 def make_pp_loss_fn(cfg: TransformerConfig, n_micro: int, axis: str = "pp",
-                    mesh=None):
+                    mesh=None, pp_chunks: Optional[int] = None):
     """Pipelined LM loss ``loss(stacked, tokens, targets)`` over the
     ``axis`` mesh dimension (GPipe microbatch ring, parallel/pipeline.py).
 
@@ -529,6 +557,8 @@ def make_pp_loss_fn(cfg: TransformerConfig, n_micro: int, axis: str = "pp",
     from multiverso_tpu.parallel import pipeline as pp_lib
     from multiverso_tpu.zoo import Zoo
     mesh = mesh or Zoo.get().mesh()
+    if pp_chunks is None:
+        pp_chunks = cfg.pp_chunks
     if cfg.moe_experts or cfg.seq_axis is not None:
         raise ValueError("the pp step pipelines the dense stack; sp/moe "
                          "combinations are separate strategies (see "
@@ -538,9 +568,17 @@ def make_pp_loss_fn(cfg: TransformerConfig, n_micro: int, axis: str = "pp",
                          "is fully local to the stage; use attn='local' "
                          "(or 'flash' for the fused per-chip kernel)")
     n_stages = mesh.shape[axis]
-    if cfg.num_layers % n_stages:
+    if cfg.num_layers % (n_stages * pp_chunks):
         raise ValueError(f"num_layers={cfg.num_layers} not divisible by "
-                         f"pp={n_stages}")
+                         f"pp={n_stages} x pp_chunks={pp_chunks}")
+    if pp_chunks > 1:
+        if cfg.tp_axis is not None:
+            raise ValueError("tp_axis with pp_chunks > 1 is not supported "
+                             "yet")
+        if n_micro != n_stages:
+            raise ValueError(f"the interleaved schedule runs a fixed "
+                             f"n_micro == pp ({n_stages}); got "
+                             f"n_micro={n_micro}")
     # inside the pipeline body activations are stage-local, so the layer is
     # built without global sharding hints (flash lowers to the direct
     # kernel call rather than its own shard_map)
@@ -567,10 +605,15 @@ def make_pp_loss_fn(cfg: TransformerConfig, n_micro: int, axis: str = "pp",
     def loss(stacked, tokens, targets):
         s = tokens.shape[1]
         x = stacked["embed"][tokens] + stacked["pos"][:s][None]
-        x = pp_lib.pipeline_apply(stage_fn, stacked["stages"], x, n_micro,
-                                  axis=axis, mesh=mesh,
-                                  batch_axis=cfg.batch_axis,
-                                  param_specs=param_specs)
+        if pp_chunks > 1:
+            x = pp_lib.pipeline_apply_interleaved(
+                stage_fn, stacked["stages"], x, axis=axis, mesh=mesh,
+                batch_axis=cfg.batch_axis)
+        else:
+            x = pp_lib.pipeline_apply(stage_fn, stacked["stages"], x,
+                                      n_micro, axis=axis, mesh=mesh,
+                                      batch_axis=cfg.batch_axis,
+                                      param_specs=param_specs)
         return _nll(_lm_head(x, stacked["ln_f"], stacked["embed"]), targets)
 
     return loss
@@ -578,11 +621,11 @@ def make_pp_loss_fn(cfg: TransformerConfig, n_micro: int, axis: str = "pp",
 
 def make_pp_train_step(cfg: TransformerConfig, n_micro: int,
                        learning_rate: float = 1e-2, axis: str = "pp",
-                       mesh=None):
+                       mesh=None, pp_chunks: Optional[int] = None):
     """Plain-SGD pipeline-parallel LM train step (see
     :func:`make_pp_loss_fn` for the pipelining semantics).
     Returns ``step(stacked, tokens, targets) -> (stacked, loss)``."""
-    loss = make_pp_loss_fn(cfg, n_micro, axis, mesh)
+    loss = make_pp_loss_fn(cfg, n_micro, axis, mesh, pp_chunks)
 
     def step(stacked, tokens, targets):
         loss_v, grads = jax.value_and_grad(loss)(stacked, tokens, targets)
@@ -595,7 +638,8 @@ def make_pp_train_step(cfg: TransformerConfig, n_micro: int,
 
 
 def make_pp_optax_train_step(cfg: TransformerConfig, n_micro: int,
-                             optimizer, axis: str = "pp", mesh=None):
+                             optimizer, axis: str = "pp", mesh=None,
+                             pp_chunks: Optional[int] = None):
     """Pipelined step for any optax GradientTransformation:
     ``(stacked, opt_state, tokens, targets) -> (stacked, opt_state, loss)``.
     Initialize with ``optimizer.init(stacked)`` — optimizer moments inherit
@@ -604,7 +648,7 @@ def make_pp_optax_train_step(cfg: TransformerConfig, n_micro: int,
     same way, ref adagrad_updater.h:19)."""
     import optax
 
-    loss = make_pp_loss_fn(cfg, n_micro, axis, mesh)
+    loss = make_pp_loss_fn(cfg, n_micro, axis, mesh, pp_chunks)
 
     def step(stacked, opt_state, tokens, targets):
         loss_v, grads = jax.value_and_grad(loss)(stacked, tokens, targets)

@@ -314,9 +314,11 @@ def make_fused_shared_epoch(cfg: W2VConfig, unigram: np.ndarray,
 
     The negative draw uses the reference's own RNG design — word2vec.c's
     ``next_random = next_random * A + C`` linear congruential stream (the
-    reference inherits it at wordembedding.cpp SampleNegative) — carried as a
-    (K',) uint32 lane through the scan: two VPU ops per batch instead of a
-    threefry invocation (which profiled at ~55% of the whole epoch).
+    reference inherits it at wordembedding.cpp SampleNegative). The whole
+    epoch's (K',)-lane states come from closed-form jumps
+    (:func:`_lcg_jump_consts`) + one batched table gather before the scan,
+    replacing both a threefry invocation (profiled at ~55% of the epoch)
+    and the earlier per-batch in-scan LCG step (~17%).
     Returns ``epoch_fn(win, wout, centers, contexts, lcg_state) ->
     (win, wout, mean_loss, lcg_state)``.
     """

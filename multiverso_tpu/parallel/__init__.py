@@ -6,7 +6,9 @@ from multiverso_tpu.parallel.ring import (
     zigzag_ring_attention, zigzag_shard_ids)
 from multiverso_tpu.parallel.moe import (
     MoEConfig, init_experts, moe_layer, shard_experts)
-from multiverso_tpu.parallel.pipeline import pipeline_apply, shard_stages
+from multiverso_tpu.parallel.pipeline import (
+    pipeline_apply, pipeline_apply_interleaved, shard_stages,
+    shard_stages_interleaved)
 from multiverso_tpu.parallel.tp import (
     column_parallel, mlp_block, row_parallel, transformer_fsdp_rules,
     transformer_tp_rules)
@@ -17,7 +19,8 @@ __all__ = [
     "ring_attention", "sequence_shard", "ulysses_attention",
     "zigzag_ring_attention", "zigzag_shard_ids",
     "MoEConfig", "init_experts", "moe_layer", "shard_experts",
-    "pipeline_apply", "shard_stages",
+    "pipeline_apply", "pipeline_apply_interleaved", "shard_stages",
+    "shard_stages_interleaved",
     "column_parallel", "mlp_block", "row_parallel", "transformer_fsdp_rules",
     "transformer_tp_rules",
 ]

@@ -116,3 +116,105 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
                         in_specs=(pspec, xspec), out_specs=xspec,
                         check_vma=False)(stage_params, xs)
     return out.reshape(b, *x.shape[1:])
+
+
+def shard_stages_interleaved(stacked_params: Any, n_stages: int,
+                             axis: str = "pp",
+                             mesh: Optional[Mesh] = None) -> Any:
+    """Regroup a [n_total, ...] stage stack for the interleaved schedule
+    and place it: global stage g runs as chunk v = g // n_stages on device
+    d = g % n_stages, so the [n_total, ...] leaves become [n_stages,
+    n_chunks, ...] (device-major) sharded over ``axis``."""
+    mesh = mesh or Zoo.get().mesh()
+
+    def regroup(p):
+        if p.shape[0] % n_stages:
+            raise ValueError(f"stage count {p.shape[0]} not divisible by "
+                             f"n_stages={n_stages}")
+        v = p.shape[0] // n_stages
+        p = p.reshape(v, n_stages, *p.shape[1:]).swapaxes(0, 1)
+        return jax.device_put(
+            p, NamedSharding(mesh, P(axis, *([None] * (p.ndim - 1)))))
+
+    return jax.tree.map(regroup, stacked_params)
+
+
+def pipeline_apply_interleaved(stage_fn: Callable[[Any, jax.Array],
+                                                  jax.Array],
+                               stage_params: Any, x: jax.Array,
+                               axis: str = "pp",
+                               mesh: Optional[Mesh] = None,
+                               batch_axis: Optional[str] = None) -> jax.Array:
+    """Interleaved (virtual-chunk) pipeline: each device holds ``n_chunks``
+    NON-contiguous stages, Megatron's interleaved schedule adapted to the
+    microbatch ring.
+
+    vs :func:`pipeline_apply` (GPipe): with the stack split into V chunks
+    per device, an activation circles the ring V times, and a device works
+    on chunk v of one microbatch while later microbatches are still in its
+    earlier chunks. Fill/drain cost is ``n_stages - 1`` ticks of ONE
+    chunk's work instead of the whole per-device stack — the bubble
+    fraction drops from (S-1)/(S-1+M) to (S-1)/(S-1+M*V) for the same
+    microbatch count. The price: V times more ppermute hops (cheap on the
+    ICI torus) and a fixed microbatch count of ``n_stages``.
+
+    ``stage_params`` leaves are [n_stages, n_chunks, ...] (use
+    :func:`shard_stages_interleaved`); batch must split into exactly
+    ``n_stages`` microbatches; ``stage_fn(chunk_params, act) -> act``
+    applies one chunk.
+    """
+    mesh = mesh or Zoo.get().mesh()
+    n_stages = mesh.shape[axis]
+    leaves = jax.tree_util.tree_leaves_with_path(stage_params)
+    n_chunks = leaves[0][1].shape[1] if leaves else 1
+    for path, leaf in leaves:
+        if leaf.shape[0] != n_stages or leaf.shape[1] != n_chunks:
+            raise ValueError(
+                f"stage_params leaf {jax.tree_util.keystr(path)} has "
+                f"leading dims {leaf.shape[:2]}, expected "
+                f"({n_stages}, {n_chunks})")
+    b = x.shape[0]
+    if b % n_stages:
+        raise ValueError(f"batch {b} not divisible by the interleaved "
+                         f"schedule's fixed n_micro={n_stages}")
+    mb = b // n_stages
+    xs = x.reshape(n_stages, mb, *x.shape[1:])
+
+    def body(params, xs):
+        params = jax.tree.map(lambda p: p[0], params)  # [V, ...] local
+        idx = jax.lax.axis_index(axis)
+        S, V = n_stages, n_chunks
+        fwd = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(carry, t):
+            act, outs = carry
+            u = t - idx                    # ticks since this device's first
+            v = jnp.clip(u // S, 0, V - 1)  # chunk this device runs now
+            # device 0 ingests microbatch t during the first S ticks; later
+            # ticks it continues chunks arriving back around the ring
+            act = jnp.where((idx == 0) & (t < S),
+                            xs[jnp.clip(t, 0, S - 1)], act)
+            pv = jax.tree.map(
+                lambda q: jax.lax.dynamic_index_in_dim(
+                    q, v, 0, keepdims=False), params)
+            act = stage_fn(pv, act)
+            # last device emits microbatch u - (V-1)S while running the
+            # final chunk
+            slot = jnp.clip(u - (V - 1) * S, 0, S - 1)
+            valid = (idx == S - 1) & (u >= (V - 1) * S) & (u < V * S)
+            outs = outs.at[slot].add(jnp.where(valid, act, 0.0))
+            act = jax.lax.ppermute(act, axis, fwd)
+            return (act, outs), None
+
+        act0 = jnp.zeros(xs.shape[1:], xs.dtype)
+        outs0 = jnp.zeros_like(xs)
+        (_, outs), _ = jax.lax.scan(
+            tick, (act0, outs0), jnp.arange(S * V + S - 1))
+        return jax.lax.psum(outs, axis)
+
+    pspec = jax.tree.map(lambda _: P(axis), stage_params)
+    xspec = P(None, batch_axis) if batch_axis else P()
+    out = jax.shard_map(body, mesh=mesh,
+                        in_specs=(pspec, xspec), out_specs=xspec,
+                        check_vma=False)(stage_params, xs)
+    return out.reshape(b, *x.shape[1:])

@@ -128,32 +128,34 @@ class TestPipeline:
                                    rtol=2e-4, atol=2e-5)
 
 
+def _lm_cfg(**kw):
+    from multiverso_tpu.models import transformer as tfm
+    base = dict(vocab_size=61, dim=32, num_heads=4, num_layers=8,
+                max_seq=16, attn="local")
+    base.update(kw)
+    return tfm.TransformerConfig(**base)
+
+
+def _lm_batch(cfg, b=8, seed=0):
+    rng = np.random.default_rng(seed)
+    toks = rng.integers(0, cfg.vocab_size, (b, cfg.max_seq + 1))
+    return (jnp.asarray(toks[:, :-1].astype(np.int32)),
+            jnp.asarray(toks[:, 1:].astype(np.int32)))
+
+
 class TestPipelinedTransformerLM:
     """make_pp_train_step vs the plain single-program train step: same
     params, same batch => same loss and same updated parameters (GPipe
     fwd+bwd through the ppermute ring is exact, not approximate)."""
 
-    def _cfg(self, **kw):
-        from multiverso_tpu.models import transformer as tfm
-        base = dict(vocab_size=61, dim=32, num_heads=4, num_layers=8,
-                    max_seq=16, attn="local")
-        base.update(kw)
-        return tfm.TransformerConfig(**base)
-
-    def _batch(self, cfg, b=8, seed=0):
-        rng = np.random.default_rng(seed)
-        toks = rng.integers(0, cfg.vocab_size, (b, cfg.max_seq + 1))
-        return (jnp.asarray(toks[:, :-1].astype(np.int32)),
-                jnp.asarray(toks[:, 1:].astype(np.int32)))
-
     def test_matches_single_program_step(self):
         from multiverso_tpu.models import transformer as tfm
         mesh = Mesh(np.asarray(jax.devices()), ("pp",))
         mv.init(mesh=mesh)
-        cfg = self._cfg()
+        cfg = _lm_cfg()
         lr = 0.05
         params = tfm.init_params(cfg, seed=3)
-        tok, tgt = self._batch(cfg)
+        tok, tgt = _lm_batch(cfg)
 
         expect_loss = tfm.loss_fn(params, tok, tgt, cfg)
         grads = jax.grad(tfm.loss_fn)(params, tok, tgt, cfg)
@@ -181,9 +183,9 @@ class TestPipelinedTransformerLM:
         from multiverso_tpu.models import transformer as tfm
         mesh = Mesh(np.asarray(jax.devices()).reshape(2, 4), ("dp", "pp"))
         mv.init(mesh=mesh)
-        cfg = self._cfg(batch_axis="dp", remat=True)
+        cfg = _lm_cfg(batch_axis="dp", remat=True)
         params = tfm.init_params(cfg, seed=1)
-        tok, tgt = self._batch(cfg, b=8, seed=4)
+        tok, tgt = _lm_batch(cfg, b=8, seed=4)
         expect_loss = float(tfm.loss_fn(params, tok, tgt, cfg))
 
         stacked = tfm.shard_params_pp(
@@ -203,14 +205,14 @@ class TestPipelinedTransformerLM:
         mesh = Mesh(np.asarray(jax.devices()), ("pp",))
         mv.init(mesh=mesh)
         with pytest.raises(ValueError, match="divisible"):
-            tfm.stack_pp_params(tfm.init_params(self._cfg(num_layers=6)),
-                                self._cfg(num_layers=6), 4)
+            tfm.stack_pp_params(tfm.init_params(_lm_cfg(num_layers=6)),
+                                _lm_cfg(num_layers=6), 4)
         with pytest.raises(ValueError, match="attend"):
-            tfm.make_pp_train_step(self._cfg(attn="ring"), 4, mesh=mesh)
+            tfm.make_pp_train_step(_lm_cfg(attn="ring"), 4, mesh=mesh)
         with pytest.raises(ValueError, match="strategies"):
-            tfm.make_pp_train_step(self._cfg(moe_experts=4), 4, mesh=mesh)
+            tfm.make_pp_train_step(_lm_cfg(moe_experts=4), 4, mesh=mesh)
         with pytest.raises(ValueError, match="divisible"):
-            tfm.make_pp_train_step(self._cfg(num_layers=12), 4, mesh=mesh)
+            tfm.make_pp_train_step(_lm_cfg(num_layers=12), 4, mesh=mesh)
 
     def test_optax_step_matches_single_program(self):
         import optax
@@ -218,10 +220,10 @@ class TestPipelinedTransformerLM:
         from multiverso_tpu.models import transformer as tfm
         mesh = Mesh(np.asarray(jax.devices()), ("pp",))
         mv.init(mesh=mesh)
-        cfg = self._cfg()
+        cfg = _lm_cfg()
         opt = optax.adamw(1e-2)
         params = tfm.init_params(cfg, seed=7)
-        tok, tgt = self._batch(cfg, seed=9)
+        tok, tgt = _lm_batch(cfg, seed=9)
 
         ref_step = jax.jit(tfm.make_optax_train_step(cfg, opt),
                            static_argnums=())
@@ -249,10 +251,10 @@ class TestPipelinedTransformerLM:
         from multiverso_tpu.models import transformer as tfm
         mesh = Mesh(np.asarray(jax.devices()).reshape(4, 2), ("pp", "tp"))
         mv.init(mesh=mesh)
-        cfg = self._cfg(tp_axis="tp")
+        cfg = _lm_cfg(tp_axis="tp")
         lr = 0.05
         params = tfm.init_params(cfg, seed=5)
-        tok, tgt = self._batch(cfg, seed=11)
+        tok, tgt = _lm_batch(cfg, seed=11)
 
         # oracle on the plain (unsharded) single-program path
         ref_cfg = cfg._replace(tp_axis=None)
@@ -284,9 +286,9 @@ class TestPipelinedTransformerLM:
         mesh = Mesh(np.asarray(jax.devices()).reshape(2, 2, 2),
                     ("dp", "pp", "tp"))
         mv.init(mesh=mesh)
-        cfg = self._cfg(batch_axis="dp", tp_axis="tp", num_layers=4)
+        cfg = _lm_cfg(batch_axis="dp", tp_axis="tp", num_layers=4)
         params = tfm.init_params(cfg, seed=2)
-        tok, tgt = self._batch(cfg, b=8, seed=13)
+        tok, tgt = _lm_batch(cfg, b=8, seed=13)
         expect_loss = float(
             tfm.loss_fn(params, tok, tgt, cfg._replace(tp_axis=None,
                                                        batch_axis=None)))
@@ -302,3 +304,127 @@ class TestPipelinedTransformerLM:
             new, l = step(new, tok, tgt)
             losses.append(float(l))
         assert losses[-1] < losses[0] - 0.1, losses
+
+
+class TestInterleavedPipeline:
+    """pipeline_apply_interleaved vs the sequential oracle: chunked stage
+    placement (global stage g -> device g % S, chunk g // S) must compute
+    the same stack."""
+
+    def test_matches_sequential(self):
+        mesh = Mesh(np.asarray(jax.devices()), ("pp",))
+        mv.init(mesh=mesh)
+        params = _stages(16, 12)  # 16 global stages = 8 devices x 2 chunks
+        rng = np.random.default_rng(21)
+        x = jnp.asarray(rng.normal(size=(32, 12)).astype(np.float32))
+        expect = _oracle(params, x)
+        placed = pipeline.shard_stages_interleaved(params, 8)
+        got = pipeline.pipeline_apply_interleaved(_stage_fn, placed, x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_single_chunk_equals_gpipe(self):
+        mesh = Mesh(np.asarray(jax.devices()), ("pp",))
+        mv.init(mesh=mesh)
+        params = _stages(8, 8, seed=3)
+        x = jnp.asarray(np.random.default_rng(22)
+                        .normal(size=(16, 8)).astype(np.float32))
+        expect = pipeline.pipeline_apply(
+            _stage_fn, pipeline.shard_stages(params), x, n_micro=8)
+        placed = pipeline.shard_stages_interleaved(params, 8)
+        got = pipeline.pipeline_apply_interleaved(_stage_fn, placed, x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_four_chunks_under_grad(self):
+        mesh = Mesh(np.asarray(jax.devices()), ("pp",))
+        mv.init(mesh=mesh)
+        params = _stages(32, 8, seed=5)  # 8 devices x 4 chunks
+        x = jnp.asarray(np.random.default_rng(23)
+                        .normal(size=(16, 8)).astype(np.float32))
+        placed = pipeline.shard_stages_interleaved(params, 8)
+
+        def loss_pipe(p, x):
+            return jnp.mean(pipeline.pipeline_apply_interleaved(
+                _stage_fn, p, x) ** 2)
+
+        def loss_ref(p, x):
+            return jnp.mean(_oracle(p, x) ** 2)
+
+        np.testing.assert_allclose(float(jax.jit(loss_pipe)(placed, x)),
+                                   float(loss_ref(params, x)), rtol=1e-5)
+        g = jax.jit(jax.grad(loss_pipe))(placed, x)
+        g_ref = jax.grad(loss_ref)(params, x)
+        # regroup reference grads into the interleaved layout
+        for k in ("w", "b"):
+            ref = np.asarray(g_ref[k])
+            v = ref.shape[0] // 8
+            ref = ref.reshape(v, 8, *ref.shape[1:]).swapaxes(0, 1)
+            np.testing.assert_allclose(np.asarray(g[k]), ref,
+                                       rtol=2e-4, atol=2e-5, err_msg=k)
+
+    def test_validation(self):
+        mesh = Mesh(np.asarray(jax.devices()), ("pp",))
+        mv.init(mesh=mesh)
+        with pytest.raises(ValueError, match="divisible"):
+            pipeline.shard_stages_interleaved(_stages(12, 8), 8)
+        placed = pipeline.shard_stages_interleaved(_stages(16, 8), 8)
+        with pytest.raises(ValueError, match="n_micro"):
+            pipeline.pipeline_apply_interleaved(
+                _stage_fn, placed, jnp.zeros((12, 8), jnp.float32))
+
+    def test_interleaved_lm_matches_single_program(self):
+        from multiverso_tpu.models import transformer as tfm
+        mesh = Mesh(np.asarray(jax.devices()), ("pp",))
+        mv.init(mesh=mesh)
+        cfg = _lm_cfg(num_layers=16)  # 8 devices x 2 chunks x 1 layer
+        lr = 0.05
+        params = tfm.init_params(cfg, seed=8)
+        tok, tgt = _lm_batch(cfg, seed=15)
+
+        expect_loss = tfm.loss_fn(params, tok, tgt, cfg)
+        grads = jax.grad(tfm.loss_fn)(params, tok, tgt, cfg)
+        expect = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+
+        stacked = tfm.shard_params_pp(
+            tfm.stack_pp_params(params, cfg, 8, pp_chunks=2), mesh=mesh)
+        step = jax.jit(tfm.make_pp_train_step(cfg, n_micro=8,
+                                              learning_rate=lr, mesh=mesh,
+                                              pp_chunks=2))
+        new, loss = step(stacked, tok, tgt)
+        np.testing.assert_allclose(float(loss), float(expect_loss),
+                                   rtol=1e-5)
+        got = tfm.unstack_pp_params(new, pp_chunks=2)
+        for k, v in got["layers"].items():
+            np.testing.assert_allclose(np.asarray(v),
+                                       np.asarray(expect["layers"][k]),
+                                       rtol=5e-4, atol=2e-5,
+                                       err_msg=f"layers[{k}]")
+        np.testing.assert_allclose(np.asarray(got["embed"]),
+                                   np.asarray(expect["embed"]),
+                                   rtol=5e-4, atol=2e-5)
+
+    def test_interleaved_validation(self):
+        from multiverso_tpu.models import transformer as tfm
+        mesh = Mesh(np.asarray(jax.devices()), ("pp",))
+        mv.init(mesh=mesh)
+        with pytest.raises(ValueError, match="pp_chunks"):
+            tfm.stack_pp_params(
+                tfm.init_params(_lm_cfg(tp_axis="tp", num_layers=16)),
+                _lm_cfg(tp_axis="tp", num_layers=16), 8, pp_chunks=2)
+        with pytest.raises(ValueError, match="n_micro == pp"):
+            tfm.make_pp_train_step(_lm_cfg(num_layers=16), n_micro=4,
+                                   mesh=mesh, pp_chunks=2)
+
+    def test_interleaved_dp_pp_matches_oracle(self):
+        mesh = Mesh(np.asarray(jax.devices()).reshape(2, 4), ("dp", "pp"))
+        mv.init(mesh=mesh)
+        params = _stages(8, 8, seed=9)  # 4 devices x 2 chunks
+        x = jnp.asarray(np.random.default_rng(31)
+                        .normal(size=(16, 8)).astype(np.float32))
+        expect = _oracle(params, x)
+        placed = pipeline.shard_stages_interleaved(params, 4, mesh=mesh)
+        got = pipeline.pipeline_apply_interleaved(
+            _stage_fn, placed, x, mesh=mesh, batch_axis="dp")
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                                   rtol=2e-4, atol=2e-5)
