@@ -1,0 +1,76 @@
+"""Pipeline-parallel LM training over a (dp, pp) mesh, GPipe and
+interleaved schedules.
+
+The reference's only "pipeline" is double-buffered communication/compute
+overlap (SURVEY §2.10 — async_buffer.h, ps_model.cpp GetPipelineTable);
+layer pipelining is the strategy its parameter-server design could not
+express. Here the layer stack is split across the ``pp`` mesh axis,
+microbatches ride a ``ppermute`` ring, and ``jax.grad`` differentiates
+straight through the ring — the backward pass drains the pipeline in the
+transposed schedule with no hand-written reverse code. Setting
+``pp_chunks > 1`` switches to the interleaved virtual-chunk schedule
+(each device holds V non-contiguous chunks; bubble shrinks V-fold).
+
+Run: python examples/pipelined_lm.py   (8 virtual CPU devices stand in
+for 8 chips; the same code runs unchanged on a TPU pod slice.)
+"""
+
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")  # repo-root execution
+
+import jax
+
+if "--tpu" not in sys.argv:
+    from multiverso_tpu.utils.platform import force_cpu_mesh
+    force_cpu_mesh(8)
+
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+import multiverso_tpu as mv
+from multiverso_tpu.models import transformer as tfm
+
+
+def main() -> int:
+    devices = np.asarray(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devices, ("dp", "pp"))
+    mv.init(mesh=mesh)
+
+    cfg = tfm.TransformerConfig(
+        vocab_size=256, dim=64, num_heads=4, num_layers=8, max_seq=32,
+        attn="local", batch_axis="dp",
+        pp_chunks=2,   # interleaved: 4 pp devices x 2 chunks x 1 layer
+        remat=True)    # recompute layers in backward (GPipe memory trade)
+    params = tfm.init_params(cfg, seed=0)
+    stacked = tfm.shard_params_pp(
+        tfm.stack_pp_params(params, cfg, n_stages=4), mesh=mesh, cfg=cfg)
+
+    # the interleaved schedule runs a fixed n_micro == pp extent
+    step = jax.jit(tfm.make_pp_train_step(cfg, n_micro=4,
+                                          learning_rate=0.1, mesh=mesh))
+
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, cfg.vocab_size, (8, cfg.max_seq + 1))
+    tok = jnp.asarray(toks[:, :-1].astype(np.int32))
+    tgt = jnp.asarray(toks[:, 1:].astype(np.int32))
+
+    for i in range(20):
+        stacked, loss = step(stacked, tok, tgt)
+        if i % 5 == 0:
+            print(f"step {i:3d}  loss {float(loss):.4f}")
+    print(f"final loss {float(loss):.4f}")
+
+    # interop: fold back to the plain [L, ...] layout for decoding
+    plain = tfm.unstack_pp_params(stacked, cfg=cfg)
+    out = tfm.generate(plain, tok[:2, :4],
+                       cfg._replace(batch_axis=None, pp_chunks=1), 8)
+    print("decoded shape:", out.shape)
+    mv.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -352,6 +352,9 @@ def main(argv=None) -> int:
         xt, yt = mnist.load(cfg.mnist_dir, "test")
         log.info("test accuracy: %.4f", lr.test_arrays(xt, yt))
     else:
+        if not cfg.train_file:
+            log.fatal("config needs train_file=<path> (or mnist_dir=) — "
+                      "nothing to train on")
         lr = LogReg(cfg)
         stats = lr.train_file()
         log.info("train done: %s", stats)
