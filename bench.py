@@ -192,6 +192,49 @@ def bench_transformer(steps: int = 40):
             "attn": cfg.attn, "loss": last["loss"]}
 
 
+def bench_matrix_rows(rows: int = 100_000, cols: int = 128,
+                      batch: int = 4096):
+    """Sparse row push (the PS differentiator: WE pushes only the block's
+    rows, ref Test/main.cpp TestSparsePerf) — device-plane row-batch add
+    through the updater, differential-timed like everything else."""
+    import jax
+
+    import multiverso_tpu as mv
+    from multiverso_tpu.updaters import AddOption
+
+    t = mv.MatrixTable(rows, cols, updater="adagrad", name="bench_rows")
+    rng = np.random.default_rng(0)
+    ids = jax.device_put(rng.integers(0, rows, batch).astype(np.int32))
+    vals = jax.device_put(rng.normal(size=(batch, cols)).astype(np.float32))
+    opt = AddOption(learning_rate=0.05, rho=0.1)
+    chain = 200
+
+    @jax.jit
+    def chain_add(state, ids, vals):
+        return jax.lax.scan(
+            lambda s, _: (t.functional_add_rows(s, ids, vals, opt), None),
+            state, None, length=chain)[0]
+
+    box = {"state": chain_add(t.state, ids, vals)}
+    float(box["state"]["data"][0, 0])
+
+    def run(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            box["state"] = chain_add(box["state"], ids, vals)
+        float(box["state"]["data"][0, 0])
+        return time.perf_counter() - t0
+
+    per_chain, _ = _differential(run, 2, 8)
+    per_add = per_chain / chain
+    t.adopt(box["state"])
+    nbytes = batch * cols * 4
+    return {"row_add_us": per_add * 1e6,
+            "rows_per_sec": batch / per_add,
+            "row_add_gbps": nbytes / per_add / 1e9,
+            "batch_rows": batch, "table": f"{rows}x{cols}"}
+
+
 def bench_resnet(depth: int = 32, n_images: int = 50_000):
     """CIFAR ResNet sec/epoch — the reference's published headline
     (binding BENCHMARK.md tables: Lasagne ResNet-32 100.02 s/epoch on a
@@ -240,6 +283,10 @@ def main() -> None:
         resnet_stats = bench_resnet()
     except Exception as e:
         resnet_stats = {"error": f"{type(e).__name__}: {e}"[:200]}
+    try:
+        rows_stats = bench_matrix_rows()
+    except Exception as e:
+        rows_stats = {"error": f"{type(e).__name__}: {e}"[:200]}
     mv.shutdown()
 
     baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -273,6 +320,7 @@ def main() -> None:
             "array_table_4M_float32": array_stats,
             "transformer_lm_bs8_seq512_d256_L4": lm_stats,
             "resnet32_cifar_50k": resnet_stats,
+            "matrix_sparse_row_add": rows_stats,
         },
     }))
 

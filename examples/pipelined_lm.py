@@ -35,25 +35,28 @@ from multiverso_tpu.models import transformer as tfm
 
 
 def main() -> int:
-    devices = np.asarray(jax.devices()[:8]).reshape(2, 4)
+    devices = np.asarray(jax.devices())
+    dp = 2 if devices.size % 2 == 0 else 1
+    devices = devices.reshape(dp, devices.size // dp)
     mesh = Mesh(devices, ("dp", "pp"))
     mv.init(mesh=mesh)
 
+    pp = devices.shape[1]
     cfg = tfm.TransformerConfig(
-        vocab_size=256, dim=64, num_heads=4, num_layers=8, max_seq=32,
+        vocab_size=256, dim=64, num_heads=4, num_layers=2 * pp, max_seq=32,
         attn="local", batch_axis="dp",
-        pp_chunks=2,   # interleaved: 4 pp devices x 2 chunks x 1 layer
+        pp_chunks=2,   # interleaved: pp devices x 2 chunks x 1 layer
         remat=True)    # recompute layers in backward (GPipe memory trade)
     params = tfm.init_params(cfg, seed=0)
     stacked = tfm.shard_params_pp(
-        tfm.stack_pp_params(params, cfg, n_stages=4), mesh=mesh, cfg=cfg)
+        tfm.stack_pp_params(params, cfg, n_stages=pp), mesh=mesh, cfg=cfg)
 
     # the interleaved schedule runs a fixed n_micro == pp extent
-    step = jax.jit(tfm.make_pp_train_step(cfg, n_micro=4,
+    step = jax.jit(tfm.make_pp_train_step(cfg, n_micro=pp,
                                           learning_rate=0.1, mesh=mesh))
 
     rng = np.random.default_rng(0)
-    toks = rng.integers(0, cfg.vocab_size, (8, cfg.max_seq + 1))
+    toks = rng.integers(0, cfg.vocab_size, (pp * dp, cfg.max_seq + 1))
     tok = jnp.asarray(toks[:, :-1].astype(np.int32))
     tgt = jnp.asarray(toks[:, 1:].astype(np.int32))
 

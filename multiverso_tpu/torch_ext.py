@@ -85,9 +85,10 @@ class TorchParamManager:
             off = 0
             for p, shape, size in zip(self._module.parameters(),
                                       self._shapes, self._sizes):
-                chunk = flat[off: off + size].reshape(shape)
-                p.copy_(torch.from_numpy(np.ascontiguousarray(chunk))
-                        .to(p.dtype))
+                # np.array(copy=True): from_numpy on a read-only view
+                # (e.g. a jax export) warns about non-writable tensors
+                chunk = np.array(flat[off: off + size].reshape(shape))
+                p.copy_(torch.from_numpy(chunk).to(p.dtype))
                 off += size
 
     def sync(self) -> None:
