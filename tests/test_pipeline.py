@@ -428,3 +428,17 @@ class TestInterleavedPipeline:
             _stage_fn, placed, x, mesh=mesh, batch_axis="dp")
         np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
                                    rtol=2e-4, atol=2e-5)
+
+    @pytest.mark.parametrize("n_dev,chunks", [(2, 4), (4, 2), (8, 4)])
+    def test_mesh_and_chunk_extents(self, n_dev, chunks):
+        mesh = Mesh(np.asarray(jax.devices()[:n_dev]), ("pp",))
+        mv.init(mesh=mesh)
+        params = _stages(n_dev * chunks, 8, seed=n_dev)
+        x = jnp.asarray(np.random.default_rng(n_dev * 10)
+                        .normal(size=(n_dev * 2, 8)).astype(np.float32))
+        expect = _oracle(params, x)
+        placed = pipeline.shard_stages_interleaved(params, n_dev, mesh=mesh)
+        got = pipeline.pipeline_apply_interleaved(_stage_fn, placed, x,
+                                                  mesh=mesh)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                                   rtol=2e-4, atol=2e-5)
