@@ -235,6 +235,45 @@ def bench_matrix_rows(rows: int = 100_000, cols: int = 128,
             "batch_rows": batch, "table": f"{rows}x{cols}"}
 
 
+def bench_decode(new_tokens: int = 128, b: int = 8):
+    """Autoregressive decode throughput (tokens/sec) on the KV-cache scan,
+    f32 weights vs weight-only int8 (ops/quantization.py) — the decode
+    surface (prefill, cache, sampling) has its own perf profile distinct
+    from training."""
+    import jax
+    import jax.numpy as jnp
+
+    from multiverso_tpu.models import transformer as tfm
+    from multiverso_tpu.ops.quantization import quantize_lm_params
+
+    s = 64 + new_tokens
+    cfg = tfm.TransformerConfig(vocab_size=8192, dim=256, num_heads=8,
+                                num_layers=4, max_seq=s, attn="local")
+    params = tfm.init_params(cfg, seed=0)
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab_size,
+                                      (b, 64)).astype(np.int32))
+    out = {}
+    for label, p in (("f32", params), ("int8", quantize_lm_params(params))):
+        # jit the whole decode (the serving shape); a bare generate call
+        # would re-trace its scan every invocation
+        gen = jax.jit(lambda p, pr: tfm.generate(p, pr, cfg, new_tokens))
+        gen(p, prompt)  # compile
+
+        def run(n):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                toks = gen(p, prompt)
+            np.asarray(toks[0, -1:])  # host readback = reliable sync
+            return time.perf_counter() - t0
+
+        run(2)  # settle: secondary compiles / queue state
+        per_call, _ = _differential(run, 4, 40)
+        out[f"decode_tok_per_sec_{label}"] = b * new_tokens / per_call
+        out[f"decode_ms_per_step_{label}"] = per_call / new_tokens * 1e3
+    return out
+
+
 def bench_resnet(depth: int = 32, n_images: int = 50_000):
     """CIFAR ResNet sec/epoch — the reference's published headline
     (binding BENCHMARK.md tables: Lasagne ResNet-32 100.02 s/epoch on a
@@ -287,6 +326,10 @@ def main() -> None:
         rows_stats = bench_matrix_rows()
     except Exception as e:
         rows_stats = {"error": f"{type(e).__name__}: {e}"[:200]}
+    try:
+        decode_stats = bench_decode()
+    except Exception as e:
+        decode_stats = {"error": f"{type(e).__name__}: {e}"[:200]}
     mv.shutdown()
 
     baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -321,6 +364,7 @@ def main() -> None:
             "transformer_lm_bs8_seq512_d256_L4": lm_stats,
             "resnet32_cifar_50k": resnet_stats,
             "matrix_sparse_row_add": rows_stats,
+            "lm_decode_b8_d256_L4": decode_stats,
         },
     }))
 
