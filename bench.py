@@ -135,7 +135,8 @@ def bench_array_table(size: int = 1_000_000, iters: int = 10):
         return time.perf_counter() - t0
 
     # differential over chained runs: slope removes the fixed sync cost
-    per_chain, dev_intercept = _differential(run, 2, 8)
+    # (wide 4->32 spread: the signal must dominate ~100 ms sync jitter)
+    per_chain, dev_intercept = _differential(run, 4, 32)
     dev_add_s = per_chain / chain
     t.adopt(box["state"])
 
@@ -186,8 +187,15 @@ def bench_transformer(steps: int = 40):
         return time.perf_counter() - t0
 
     step_s, intercept = _differential(run, max(steps // 4, 1), steps)
+    # fwd+bwd FLOPs ~ 6 * params * tokens (dense matmul count), the
+    # standard LM accounting; reported so MFU vs the chip's peak is one
+    # division away
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree.leaves(params))
+    tflops = 6.0 * n_params * b * s / step_s / 1e12
     return {"lm_tokens_per_sec": b * s / step_s,
             "lm_step_ms": step_s * 1e3,
+            "lm_tflops_per_sec": tflops,
             "fixed_overhead_ms": intercept * 1e3,
             "attn": cfg.attn, "loss": last["loss"]}
 
