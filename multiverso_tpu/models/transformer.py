@@ -403,10 +403,6 @@ def stack_pp_params(params: Dict[str, Any], cfg: TransformerConfig,
     per = L // groups
     layers = dict(params["layers"])
     if tp:
-        if pp_chunks > 1:
-            raise ValueError("tp_axis with pp_chunks > 1 is not supported "
-                             "yet; pick one of tensor parallelism or the "
-                             "interleaved schedule per step")
         layers["wqkv"] = layers["wqkv"][
             ..., _qkv_head_perm(cfg.dim, cfg.num_heads)]
     out = {k: v for k, v in params.items() if k != "layers"}
@@ -457,18 +453,21 @@ def unstack_pp_params(stacked: Dict[str, Any],
     return out
 
 
-def _pp_stage_specs(cfg: TransformerConfig, axis: str):
+def _pp_stage_specs(cfg: TransformerConfig, axis: str,
+                    chunked: bool = False):
     """PartitionSpecs for the stages subtree under pp x tp: weights split
     over ``cfg.tp_axis`` on the Megatron dims (qkv/w1 output-sharded,
-    wo/w2 input-sharded), norms pp-only."""
+    wo/w2 input-sharded), norms pp-only. ``chunked``: leaves carry the
+    interleaved schedule's extra [n_chunks] dim after the stage dim."""
     from jax.sharding import PartitionSpec as P
     t = cfg.tp_axis
+    c = (None,) if chunked else ()
     return {
-        "wqkv": P(axis, None, None, t),
-        "wo": P(axis, None, t, None),
+        "wqkv": P(axis, *c, None, None, t),
+        "wo": P(axis, *c, None, t, None),
         "ln1": P(axis), "ln2": P(axis),
-        "w1": P(axis, None, None, t),
-        "w2": P(axis, None, t, None),
+        "w1": P(axis, *c, None, None, t),
+        "w2": P(axis, *c, None, t, None),
     }
 
 
@@ -490,7 +489,11 @@ def shard_params_pp(stacked: Dict[str, Any], mesh=None,
         lambda p: jax.device_put(p, NamedSharding(mesh, P())), v)
         for k, v in stacked.items() if k != "stages"}
     if cfg is not None and cfg.tp_axis is not None:
-        specs = _pp_stage_specs(cfg, axis)
+        # derive the chunked layout from the actual leaf rank (a too-short
+        # spec against a [S, V, ...] leaf would silently shard the wrong
+        # dim over tp; rank is the ground truth, not cfg.pp_chunks)
+        chunked = stacked["stages"]["wqkv"].ndim == 5
+        specs = _pp_stage_specs(cfg, axis, chunked=chunked)
         out["stages"] = {
             k: jax.device_put(v, NamedSharding(mesh, specs[k]))
             for k, v in stacked["stages"].items()}
@@ -571,14 +574,10 @@ def make_pp_loss_fn(cfg: TransformerConfig, n_micro: int, axis: str = "pp",
     if cfg.num_layers % (n_stages * pp_chunks):
         raise ValueError(f"num_layers={cfg.num_layers} not divisible by "
                          f"pp={n_stages} x pp_chunks={pp_chunks}")
-    if pp_chunks > 1:
-        if cfg.tp_axis is not None:
-            raise ValueError("tp_axis with pp_chunks > 1 is not supported "
-                             "yet")
-        if n_micro != n_stages:
-            raise ValueError(f"the interleaved schedule runs a fixed "
-                             f"n_micro == pp ({n_stages}); got "
-                             f"n_micro={n_micro}")
+    if pp_chunks > 1 and n_micro != n_stages:
+        raise ValueError(f"the interleaved schedule runs a fixed "
+                         f"n_micro == pp ({n_stages}); got "
+                         f"n_micro={n_micro}")
     # inside the pipeline body activations are stage-local, so the layer is
     # built without global sharding hints (flash lowers to the direct
     # kernel call rather than its own shard_map)
@@ -592,7 +591,7 @@ def make_pp_loss_fn(cfg: TransformerConfig, n_micro: int, axis: str = "pp",
                 f"{cfg.mlp_ratio * cfg.dim} must both be divisible by "
                 f"tp={n_tp}")
         layer = _make_tp_layer_fn(pcfg, cfg.tp_axis, n_tp)
-        param_specs = _pp_stage_specs(cfg, axis)
+        param_specs = _pp_stage_specs(cfg, axis, chunked=pp_chunks > 1)
     else:
         layer = _make_layer_fn(pcfg, lambda t, spec: t, None, None, None)
     if cfg.remat:
@@ -608,7 +607,7 @@ def make_pp_loss_fn(cfg: TransformerConfig, n_micro: int, axis: str = "pp",
         if pp_chunks > 1:
             x = pp_lib.pipeline_apply_interleaved(
                 stage_fn, stacked["stages"], x, axis=axis, mesh=mesh,
-                batch_axis=cfg.batch_axis)
+                batch_axis=cfg.batch_axis, param_specs=param_specs)
         else:
             x = pp_lib.pipeline_apply(stage_fn, stacked["stages"], x,
                                       n_micro, axis=axis, mesh=mesh,

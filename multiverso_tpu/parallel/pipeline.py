@@ -38,6 +38,19 @@ def shard_stages(stacked_params: Any, axis: str = "pp",
     return jax.tree.map(put, stacked_params)
 
 
+def _check_param_specs(param_specs: Any, axis: str) -> None:
+    """Shared validation for the stage-weight spec override: every spec
+    must lead with the pipeline axis (the leading dim is the stage dim)."""
+    if param_specs is None:
+        return
+    for path, spec in jax.tree_util.tree_leaves_with_path(
+            param_specs, is_leaf=lambda s: isinstance(s, P)):
+        if not spec or spec[0] != axis:
+            raise ValueError(
+                f"param_specs leaf {jax.tree_util.keystr(path)} must "
+                f"lead with the pipeline axis {axis!r}, got {spec}")
+
+
 def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
                    stage_params: Any, x: jax.Array,
                    n_micro: int, axis: str = "pp",
@@ -68,13 +81,7 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
                 f"stage_params leaf {jax.tree_util.keystr(path)} has leading "
                 f"dim {leaf.shape[0]}, expected n_stages={n_stages} "
                 f"(mesh axis {axis!r}); fold extra layers into stage_fn")
-    if param_specs is not None:
-        for path, spec in jax.tree_util.tree_leaves_with_path(
-                param_specs, is_leaf=lambda s: isinstance(s, P)):
-            if not spec or spec[0] != axis:
-                raise ValueError(
-                    f"param_specs leaf {jax.tree_util.keystr(path)} must "
-                    f"lead with the pipeline axis {axis!r}, got {spec}")
+    _check_param_specs(param_specs, axis)
     b = x.shape[0]
     if b % n_micro:
         raise ValueError(f"batch {b} not divisible by {n_micro} microbatches")
@@ -144,7 +151,8 @@ def pipeline_apply_interleaved(stage_fn: Callable[[Any, jax.Array],
                                stage_params: Any, x: jax.Array,
                                axis: str = "pp",
                                mesh: Optional[Mesh] = None,
-                               batch_axis: Optional[str] = None) -> jax.Array:
+                               batch_axis: Optional[str] = None,
+                               param_specs: Any = None) -> jax.Array:
     """Interleaved (virtual-chunk) pipeline: each device holds ``n_chunks``
     NON-contiguous stages, Megatron's interleaved schedule adapted to the
     microbatch ring.
@@ -161,7 +169,9 @@ def pipeline_apply_interleaved(stage_fn: Callable[[Any, jax.Array],
     ``stage_params`` leaves are [n_stages, n_chunks, ...] (use
     :func:`shard_stages_interleaved`); batch must split into exactly
     ``n_stages`` microbatches; ``stage_fn(chunk_params, act) -> act``
-    applies one chunk.
+    applies one chunk. ``param_specs`` shards chunk weights over extra
+    mesh axes exactly as in :func:`pipeline_apply` (each spec must lead
+    with ``axis``).
     """
     mesh = mesh or Zoo.get().mesh()
     n_stages = mesh.shape[axis]
@@ -173,6 +183,7 @@ def pipeline_apply_interleaved(stage_fn: Callable[[Any, jax.Array],
                 f"stage_params leaf {jax.tree_util.keystr(path)} has "
                 f"leading dims {leaf.shape[:2]}, expected "
                 f"({n_stages}, {n_chunks})")
+    _check_param_specs(param_specs, axis)
     b = x.shape[0]
     if b % n_stages:
         raise ValueError(f"batch {b} not divisible by the interleaved "
@@ -212,7 +223,8 @@ def pipeline_apply_interleaved(stage_fn: Callable[[Any, jax.Array],
             tick, (act0, outs0), jnp.arange(S * V + S - 1))
         return jax.lax.psum(outs, axis)
 
-    pspec = jax.tree.map(lambda _: P(axis), stage_params)
+    pspec = (param_specs if param_specs is not None
+             else jax.tree.map(lambda _: P(axis), stage_params))
     xspec = P(None, batch_axis) if batch_axis else P()
     out = jax.shard_map(body, mesh=mesh,
                         in_specs=(pspec, xspec), out_specs=xspec,

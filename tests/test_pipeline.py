@@ -408,13 +408,40 @@ class TestInterleavedPipeline:
         from multiverso_tpu.models import transformer as tfm
         mesh = Mesh(np.asarray(jax.devices()), ("pp",))
         mv.init(mesh=mesh)
-        with pytest.raises(ValueError, match="pp_chunks"):
-            tfm.stack_pp_params(
-                tfm.init_params(_lm_cfg(tp_axis="tp", num_layers=16)),
-                _lm_cfg(tp_axis="tp", num_layers=16), 8, pp_chunks=2)
         with pytest.raises(ValueError, match="n_micro == pp"):
             tfm.make_pp_train_step(_lm_cfg(num_layers=16), n_micro=4,
                                    mesh=mesh, pp_chunks=2)
+
+    def test_interleaved_pp_tp_matches_single_program(self):
+        from multiverso_tpu.models import transformer as tfm
+        mesh = Mesh(np.asarray(jax.devices()).reshape(4, 2), ("pp", "tp"))
+        mv.init(mesh=mesh)
+        cfg = _lm_cfg(tp_axis="tp", num_layers=8, pp_chunks=2)
+        lr = 0.05
+        params = tfm.init_params(cfg, seed=17)
+        tok, tgt = _lm_batch(cfg, b=8, seed=19)
+
+        ref_cfg = cfg._replace(tp_axis=None, pp_chunks=1)
+        expect_loss = tfm.loss_fn(params, tok, tgt, ref_cfg)
+        grads = jax.grad(tfm.loss_fn)(params, tok, tgt, ref_cfg)
+        expect = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+
+        stacked = tfm.shard_params_pp(
+            tfm.stack_pp_params(params, cfg, 4), mesh=mesh, cfg=cfg)
+        step = jax.jit(tfm.make_pp_train_step(cfg, n_micro=4,
+                                              learning_rate=lr, mesh=mesh))
+        new, loss = step(stacked, tok, tgt)
+        np.testing.assert_allclose(float(loss), float(expect_loss),
+                                   rtol=1e-5)
+        got = tfm.unstack_pp_params(new, cfg=cfg)
+        for k, v in got["layers"].items():
+            np.testing.assert_allclose(np.asarray(v),
+                                       np.asarray(expect["layers"][k]),
+                                       rtol=5e-4, atol=2e-5,
+                                       err_msg=f"layers[{k}]")
+        np.testing.assert_allclose(np.asarray(got["embed"]),
+                                   np.asarray(expect["embed"]),
+                                   rtol=5e-4, atol=2e-5)
 
     def test_interleaved_dp_pp_matches_oracle(self):
         mesh = Mesh(np.asarray(jax.devices()).reshape(2, 4), ("dp", "pp"))
