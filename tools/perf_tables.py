@@ -20,11 +20,23 @@ from multiverso_tpu.utils.dashboard import Dashboard
 
 
 def timeit(fn, n=10):
+    """Differential (two-point slope) ms/op — single-shot timings are
+    meaningless over the tunneled chip (see bench.py docstring)."""
     fn()  # warmup/compile
-    t0 = time.perf_counter()
-    for _ in range(n):
-        fn()
-    return (time.perf_counter() - t0) / n * 1e3
+
+    def run(k):
+        t0 = time.perf_counter()
+        for _ in range(k):
+            fn()
+        return time.perf_counter() - t0
+
+    lo, hi = max(n // 4, 1), n
+    if hi == lo:
+        # stateful one-shot op (e.g. the sparse get consumes dirty bits):
+        # wall time incl. the fixed tunnel round-trip
+        return run(1) * 1e3
+    t_lo, t_hi = run(lo), run(hi)
+    return (t_hi - t_lo) / (hi - lo) * 1e3
 
 
 def main():
