@@ -19,10 +19,15 @@ import multiverso_tpu as mv
 from multiverso_tpu.utils.dashboard import Dashboard
 
 
-def timeit(fn, n=10):
-    """Differential (two-point slope) ms/op — single-shot timings are
-    meaningless over the tunneled chip (see bench.py docstring)."""
-    fn()  # warmup/compile
+def timeit(fn, n=10, warmup=True):
+    """Differential (two-point slope) ms/op via bench._differential —
+    single-shot timings are meaningless over the tunneled chip (see the
+    bench.py docstring). ``warmup=False`` + ``n=1``: stateful one-shot op
+    whose first call IS the measurement (wall time incl. the fixed tunnel
+    round-trip; a warmup would consume the state being measured)."""
+    from bench import _differential
+    if warmup:
+        fn()  # compile
 
     def run(k):
         t0 = time.perf_counter()
@@ -32,11 +37,8 @@ def timeit(fn, n=10):
 
     lo, hi = max(n // 4, 1), n
     if hi == lo:
-        # stateful one-shot op (e.g. the sparse get consumes dirty bits):
-        # wall time incl. the fixed tunnel round-trip
         return run(1) * 1e3
-    t_lo, t_hi = run(lo), run(hi)
-    return (t_hi - t_lo) / (hi - lo) * 1e3
+    return _differential(run, lo, hi)[0] * 1e3
 
 
 def main():
@@ -70,7 +72,9 @@ def main():
     print(f"sparse re-get of fresh 100k rows: {t:9.2f} ms "
           f"(stale fraction {s.stale_fraction(ids):.3f})")
     s.add_rows(ids[:1000], np.ones((1000, cols), np.float32))
-    t = timeit(lambda: s.get_rows_sparse(ids), n=1)
+    # no warmup: the dirty bits ARE the state being measured (the jit is
+    # already warm from the fresh re-get above)
+    t = timeit(lambda: s.get_rows_sparse(ids), n=1, warmup=False)
     print(f"sparse get after 1k-row dirty   : {t:9.2f} ms")
 
     Dashboard.display()
