@@ -537,7 +537,8 @@ def _make_tp_layer_fn(cfg: TransformerConfig, tp_axis: str, n_tp: int):
 
 def make_pp_loss_fn(cfg: TransformerConfig, n_micro: int, axis: str = "pp",
                     mesh=None, pp_chunks: Optional[int] = None):
-    """Pipelined LM loss ``loss(stacked, tokens, targets)`` over the
+    """Pipelined LM loss ``loss(stacked, tokens, targets, mask=None)``
+    (``mask`` weights positions like :func:`loss_fn`) over the
     ``axis`` mesh dimension (GPipe microbatch ring, parallel/pipeline.py).
 
     The reference's "pipeline" is communication/compute double-buffering
@@ -601,7 +602,7 @@ def make_pp_loss_fn(cfg: TransformerConfig, n_micro: int, axis: str = "pp",
         (x, _), _ = jax.lax.scan(layer, (x, jnp.zeros((), jnp.float32)), p)
         return x
 
-    def loss(stacked, tokens, targets):
+    def loss(stacked, tokens, targets, mask=None):
         s = tokens.shape[1]
         x = stacked["embed"][tokens] + stacked["pos"][:s][None]
         if pp_chunks > 1:
@@ -613,7 +614,8 @@ def make_pp_loss_fn(cfg: TransformerConfig, n_micro: int, axis: str = "pp",
                                       n_micro, axis=axis, mesh=mesh,
                                       batch_axis=cfg.batch_axis,
                                       param_specs=param_specs)
-        return _nll(_lm_head(x, stacked["ln_f"], stacked["embed"]), targets)
+        return _nll(_lm_head(x, stacked["ln_f"], stacked["embed"]),
+                    targets, mask)
 
     return loss
 
@@ -623,11 +625,13 @@ def make_pp_train_step(cfg: TransformerConfig, n_micro: int,
                        mesh=None, pp_chunks: Optional[int] = None):
     """Plain-SGD pipeline-parallel LM train step (see
     :func:`make_pp_loss_fn` for the pipelining semantics).
-    Returns ``step(stacked, tokens, targets) -> (stacked, loss)``."""
+    Returns ``step(stacked, tokens, targets, mask=None) ->
+    (stacked, loss)``; ``mask`` weights positions like :func:`loss_fn`."""
     loss = make_pp_loss_fn(cfg, n_micro, axis, mesh, pp_chunks)
 
-    def step(stacked, tokens, targets):
-        loss_v, grads = jax.value_and_grad(loss)(stacked, tokens, targets)
+    def step(stacked, tokens, targets, mask=None):
+        loss_v, grads = jax.value_and_grad(loss)(stacked, tokens, targets,
+                                                 mask)
         stacked = jax.tree.map(
             lambda p, g: p - jnp.asarray(learning_rate, p.dtype) * g,
             stacked, grads)
@@ -640,7 +644,8 @@ def make_pp_optax_train_step(cfg: TransformerConfig, n_micro: int,
                              optimizer, axis: str = "pp", mesh=None,
                              pp_chunks: Optional[int] = None):
     """Pipelined step for any optax GradientTransformation:
-    ``(stacked, opt_state, tokens, targets) -> (stacked, opt_state, loss)``.
+    ``(stacked, opt_state, tokens, targets, mask=None) ->
+    (stacked, opt_state, loss)``.
     Initialize with ``optimizer.init(stacked)`` — optimizer moments inherit
     each stage's placement, so Adam state for stage s lives only on device
     s of the ``pp`` axis (the reference pays per-shard updater state the
@@ -649,8 +654,9 @@ def make_pp_optax_train_step(cfg: TransformerConfig, n_micro: int,
 
     loss = make_pp_loss_fn(cfg, n_micro, axis, mesh, pp_chunks)
 
-    def step(stacked, opt_state, tokens, targets):
-        loss_v, grads = jax.value_and_grad(loss)(stacked, tokens, targets)
+    def step(stacked, opt_state, tokens, targets, mask=None):
+        loss_v, grads = jax.value_and_grad(loss)(stacked, tokens, targets,
+                                                 mask)
         updates, opt_state = optimizer.update(grads, opt_state, stacked)
         return optax.apply_updates(stacked, updates), opt_state, loss_v
 

@@ -469,3 +469,28 @@ class TestInterleavedPipeline:
                                                   mesh=mesh)
         np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
                                    rtol=2e-4, atol=2e-5)
+
+    def test_masked_loss_matches_oracle_both_schedules(self):
+        from multiverso_tpu.models import transformer as tfm
+        mesh = Mesh(np.asarray(jax.devices()), ("pp",))
+        mv.init(mesh=mesh)
+        cfg = _lm_cfg(num_layers=16)
+        params = tfm.init_params(cfg, seed=4)
+        tok, tgt = _lm_batch(cfg, seed=23)
+        rng = np.random.default_rng(29)
+        mask = jnp.asarray(
+            (rng.uniform(size=tok.shape) > 0.3).astype(np.float32))
+        expect = float(tfm.loss_fn(params, tok, tgt, cfg, mask))
+        # GPipe schedule
+        stacked = tfm.shard_params_pp(
+            tfm.stack_pp_params(params, cfg, 8), mesh=mesh)
+        step = jax.jit(tfm.make_pp_train_step(cfg, n_micro=4, mesh=mesh))
+        _, loss = step(stacked, tok, tgt, mask)
+        np.testing.assert_allclose(float(loss), expect, rtol=1e-5)
+        # interleaved schedule (2 chunks/device, fixed n_micro == pp)
+        icfg = cfg._replace(pp_chunks=2)
+        istacked = tfm.shard_params_pp(
+            tfm.stack_pp_params(params, icfg, 8), mesh=mesh, cfg=icfg)
+        istep = jax.jit(tfm.make_pp_train_step(icfg, n_micro=8, mesh=mesh))
+        _, iloss = istep(istacked, tok, tgt, mask)
+        np.testing.assert_allclose(float(iloss), expect, rtol=1e-5)
