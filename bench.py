@@ -153,7 +153,9 @@ def bench_array_table(size: int = 1_000_000, iters: int = 10):
     }
 
 
-def bench_transformer(steps: int = 40):
+def bench_transformer(steps: int = 40, b: int = 8, s: int = 512,
+                      dim: int = 256, layers: int = 4, vocab: int = 8192,
+                      heads: int = 8):
     """LM train-step throughput (tokens/sec) with the fused flash-attention
     kernel on TPU (reference_attention elsewhere — interpret-mode Pallas
     would measure the interpreter, not the chip)."""
@@ -163,10 +165,9 @@ def bench_transformer(steps: int = 40):
     from multiverso_tpu.models import transformer as tfm
 
     on_tpu = jax.devices()[0].platform == "tpu"
-    b, s = 8, 512
     cfg = tfm.TransformerConfig(
-        vocab_size=8192, dim=256, num_heads=8, num_layers=4, max_seq=s,
-        attn="flash" if on_tpu else "local",
+        vocab_size=vocab, dim=dim, num_heads=heads, num_layers=layers,
+        max_seq=s, attn="flash" if on_tpu else "local",
         dtype=jnp.bfloat16 if on_tpu else jnp.float32)
     params = tfm.init_params(cfg, seed=0)
     rng = np.random.default_rng(0)
@@ -327,6 +328,17 @@ def main() -> None:
     except Exception as e:  # secondary metric must never sink the bench
         lm_stats = {"error": f"{type(e).__name__}: {e}"[:200]}
     try:
+        import jax as _jax
+        if _jax.devices()[0].platform != "tpu":
+            raise RuntimeError("TPU-only config (472M params in f32 would "
+                               "take minutes/OOM on a CPU host)")
+        # MXU-saturating config: ~100 bf16 TFLOP/s on one chip (wider
+        # models hit the remote-compile size limit in this environment)
+        lm_large_stats = bench_transformer(steps=12, b=2, s=1024, dim=2048,
+                                           layers=8, vocab=32768, heads=16)
+    except Exception as e:
+        lm_large_stats = {"error": f"{type(e).__name__}: {e}"[:200]}
+    try:
         resnet_stats = bench_resnet()
     except Exception as e:
         resnet_stats = {"error": f"{type(e).__name__}: {e}"[:200]}
@@ -370,6 +382,7 @@ def main() -> None:
             "we_sec_per_epoch": round(we_stats["sec_per_epoch"], 4),
             "array_table_4M_float32": array_stats,
             "transformer_lm_bs8_seq512_d256_L4": lm_stats,
+            "transformer_lm_472M_bs2_seq1024_d2048_L8": lm_large_stats,
             "resnet32_cifar_50k": resnet_stats,
             "matrix_sparse_row_add": rows_stats,
             "lm_decode_b8_d256_L4": decode_stats,
