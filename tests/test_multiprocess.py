@@ -20,8 +20,8 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_cluster():
-    nprocs = 2
+@pytest.mark.parametrize("nprocs", [2, 4])
+def test_process_cluster(nprocs):
     coordinator = f"127.0.0.1:{_free_port()}"
     env = dict(os.environ)
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
@@ -53,20 +53,22 @@ def test_two_process_cluster():
                         + errors[0][:200])
         pytest.fail("\n".join(errors))
 
-    assert set(results) == {0, 1}
+    assert set(results) == set(range(nprocs))
+    tri = nprocs * (nprocs + 1) / 2  # sum of each rank's (rank+1)
     for pid, r in results.items():
         assert r["rank"] == pid
-        assert r["size"] == 2
-        assert r["num_workers"] == 2
-        assert r["devices"] == 4  # 2 procs x 2 local cpu devices
-        # aggregate: 1 + 2 = 3 on every process
-        assert r["aggregate"] == [3.0, 3.0, 3.0, 3.0]
-        # kv: key 0 added by both (10+10), key 1 only by rank 1
-        assert r["kv"] == {"0": 20, "1": 10}
-        # matrix collective row add: 1 + 2 = 3 in both rows
-        assert r["matrix_rows"] == [[3.0] * 4, [3.0] * 4]
-        # sharedvar: both workers pushed +1 -> merged value 2 everywhere
-        assert r["sharedvar"] == [2.0, 2.0, 2.0, 2.0]
+        assert r["size"] == nprocs
+        assert r["num_workers"] == nprocs
+        assert r["devices"] == 2 * nprocs  # nprocs x 2 local cpu devices
+        # aggregate of rank+1 over all ranks
+        assert r["aggregate"] == [tri] * 4
+        # kv: rank r adds keys 0..r, value 10 each -> key k has 10*(N-k)
+        assert r["kv"] == {str(k): 10.0 * (nprocs - k)
+                           for k in range(nprocs)}
+        # matrix collective row add of rank+1 in both rows
+        assert r["matrix_rows"] == [[tri] * 4, [tri] * 4]
+        # sharedvar: every worker pushed +1 -> merged value N everywhere
+        assert r["sharedvar"] == [float(nprocs)] * 4
 
 
 _SSP_WORKER = """
