@@ -12,7 +12,8 @@ from multiverso_tpu.api import (
     MV_Aggregate, MV_Barrier, MV_CreateTable, MV_Init, MV_NumServers,
     MV_NumWorkers, MV_Rank, MV_ServerId, MV_ShutDown, MV_Size, MV_WorkerId,
     aggregate, barrier, create_table, init, is_master_worker, mesh,
-    num_servers, num_workers, rank, server_id, shutdown, size, worker_id,
+    num_servers, num_workers, rank, server_id, servers_num, shutdown, size,
+    worker_id, workers_num,
 )
 from multiverso_tpu.table import Table
 from multiverso_tpu.tables import ArrayTable, KVTable, MatrixTable, SparseMatrixTable

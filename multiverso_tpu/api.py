@@ -141,6 +141,11 @@ def net_init(coordinator_address: Optional[str] = None,
         return -1
 
 
+# ---- reference Python-binding name parity (ref api.py:54 workers_num —
+# the TUTORIAL.md surface a binding user types verbatim) ------------------- #
+workers_num = num_workers
+servers_num = num_servers
+
 # ---- MV_* parity aliases -------------------------------------------------- #
 MV_Init = init
 MV_ShutDown = shutdown

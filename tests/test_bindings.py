@@ -253,3 +253,18 @@ def test_stream_save_finalizes_pending_async(tmp_path):
     checkpoint.save(str(tmp_path), tag="b", backend="stream")
     assert checkpoint.latest(str(tmp_path)) == "b"
     assert checkpoint.wait_pending() == 0  # already finalized
+
+
+def test_reference_binding_name_parity():
+    """The verbatim names a reference TUTORIAL.md user types (ref
+    binding/python/multiverso/api.py:12-68) all exist and agree."""
+    import multiverso_tpu as mv
+    mv.init()
+    try:
+        assert mv.workers_num() == mv.num_workers() == mv.MV_NumWorkers()
+        assert mv.servers_num() == mv.num_servers() == mv.MV_NumServers()
+        assert mv.worker_id() == mv.MV_WorkerId()
+        assert isinstance(mv.is_master_worker(), bool)
+        assert mv.MV_Rank() == mv.rank()
+    finally:
+        mv.shutdown()
