@@ -62,10 +62,32 @@ class MatrixTableHandler:
                 self._table.add(np.zeros_like(init_value))
             mv.barrier()
 
-    def get(self, out: Optional[np.ndarray] = None) -> np.ndarray:
-        return self._table.get(out=out)
+    @staticmethod
+    def _check_row_ids(row_ids):
+        arr = np.asarray(row_ids)
+        if not np.issubdtype(arr.dtype, np.integer):
+            raise TypeError(
+                f"row_ids must be integers, got dtype {arr.dtype} (out= and "
+                f"sync= are keyword-only to keep this surface unambiguous)")
 
-    def add(self, data, sync: bool = True) -> None:
+    def get(self, row_ids=None, *,
+            out: Optional[np.ndarray] = None) -> np.ndarray:
+        """Whole table, or just ``row_ids`` when given — the reference
+        binding's single-method surface (ref tables.py:108
+        ``get(row_ids=None)``). ``out`` is keyword-only so a legacy
+        positional buffer cannot be misread as row ids."""
+        if row_ids is None:
+            return self._table.get(out=out)
+        self._check_row_ids(row_ids)
+        return self._table.get_rows(row_ids, out=out)
+
+    def add(self, data, row_ids=None, *, sync: bool = True) -> None:
+        """Whole-table add, or a row-batch add when ``row_ids`` is given
+        (ref tables.py:132 ``add(data, row_ids=None, sync)``); ``sync``
+        is keyword-only for the same ambiguity reason as ``get``."""
+        if row_ids is not None:
+            self._check_row_ids(row_ids)
+            return self.add_rows(row_ids, data, sync=sync)
         data = np.asarray(data, dtype=np.float32).reshape(
             self.num_row, self.num_col)
         if sync:

@@ -268,3 +268,37 @@ def test_reference_binding_name_parity():
         assert mv.MV_Rank() == mv.rank()
     finally:
         mv.shutdown()
+
+
+def test_matrix_handler_row_ids_dispatch():
+    """Reference tables.py single-method surface: get(row_ids)/add(data,
+    row_ids) route to the row ops (ref tables.py:108,132)."""
+    import multiverso_tpu as mv
+    from multiverso_tpu.handlers import MatrixTableHandler
+    mv.init()
+    try:
+        h = MatrixTableHandler(8, 4, name="mth_rows")
+        h.add(np.ones((2, 4), np.float32), row_ids=[1, 5])
+        got = h.get(row_ids=[1, 5])
+        np.testing.assert_allclose(got, np.ones((2, 4)), rtol=1e-6)
+        whole = h.get()
+        assert whole.shape == (8, 4)
+        np.testing.assert_allclose(whole[[0, 2]], np.zeros((2, 4)))
+    finally:
+        mv.shutdown()
+
+
+def test_matrix_handler_rejects_ambiguous_positional():
+    import pytest
+
+    import multiverso_tpu as mv
+    from multiverso_tpu.handlers import MatrixTableHandler
+    mv.init()
+    try:
+        h = MatrixTableHandler(4, 4, name="mth_guard")
+        with pytest.raises(TypeError, match="row_ids must be integers"):
+            h.get(np.zeros((4, 4), np.float32))  # legacy positional out=
+        with pytest.raises(TypeError):
+            h.add(np.ones((4, 4), np.float32), False)  # legacy sync=
+    finally:
+        mv.shutdown()
