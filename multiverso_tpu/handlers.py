@@ -64,8 +64,12 @@ class MatrixTableHandler:
 
     @staticmethod
     def _check_row_ids(row_ids):
+        # the table's _prep_ids re-validates; checking here too makes the
+        # legacy-positional mistake (h.get(buf) / h.add(data, False)) fail
+        # before any table work, with empty batches deferred to the
+        # table's clearer "empty row_ids" error
         arr = np.asarray(row_ids)
-        if not np.issubdtype(arr.dtype, np.integer):
+        if arr.size and not np.issubdtype(arr.dtype, np.integer):
             raise TypeError(
                 f"row_ids must be integers, got dtype {arr.dtype} (out= and "
                 f"sync= are keyword-only to keep this surface unambiguous)")

@@ -151,9 +151,14 @@ class MatrixTable(Table):
         unique count <= num_row <= padded_rows, so the bucket cap can never
         underflow the pad.
         """
-        ids = np.asarray(row_ids, dtype=np.int32).reshape(-1)
-        if ids.size == 0:
+        raw = np.asarray(row_ids)
+        if raw.size == 0:
             raise ValueError("empty row_ids")
+        if not np.issubdtype(raw.dtype, np.integer):
+            raise TypeError(f"row_ids must be integers, got dtype "
+                            f"{raw.dtype} (silent float truncation would "
+                            f"hit arbitrary rows)")
+        ids = raw.astype(np.int32).reshape(-1)
         if np.any((ids < 0) | (ids >= self.num_row)):
             raise IndexError(f"row id out of range [0, {self.num_row})")
         uids, inv = np.unique(ids, return_inverse=True)

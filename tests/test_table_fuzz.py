@@ -89,3 +89,36 @@ def test_kv_table_matches_dict_model():
         else:
             for k, v in model.items():
                 assert t[k] == v, (k, t[k], v)
+
+
+@pytest.mark.parametrize("updater", ["sgd", "momentum_sgd", "adagrad"])
+def test_stateful_updaters_match_numpy_model(updater):
+    """Random add/get sequences through each server-side updater against
+    the updater's own recurrence replayed in numpy (the optimizer-state
+    analogue of the plain += fuzz above)."""
+    from multiverso_tpu.updaters import AddOption
+    rng = np.random.default_rng(7)
+    size = 331  # awkward size: padding + 8-way sharding
+    t = mv.ArrayTable(size, updater=updater, name=f"fuzz_{updater}")
+    model = np.zeros(size, np.float64)
+    smooth = np.zeros(size, np.float64)
+    g_sqr = np.zeros(size, np.float64)
+    lr, m, rho = 0.1, 0.9, 0.05
+    opt = AddOption(learning_rate=lr, momentum=m, rho=rho)
+    for step in range(40):
+        if rng.uniform() < 0.7:
+            d = rng.normal(size=size).astype(np.float32)
+            t.add(d, opt)
+            d64 = d.astype(np.float64)
+            if updater == "sgd":
+                model -= d64
+            elif updater == "momentum_sgd":
+                smooth = m * smooth + (1.0 - m) * d64
+                model -= smooth
+            else:  # adagrad (ref adagrad_updater.h sign/scale quirks)
+                g_sqr += np.square(d64) / lr ** 2
+                model -= d64 * rho / (np.sqrt(g_sqr) + 1e-10)
+        else:
+            np.testing.assert_allclose(t.get(), model, rtol=5e-4,
+                                       atol=5e-5, err_msg=f"step {step}")
+    np.testing.assert_allclose(t.get(), model, rtol=5e-4, atol=5e-5)
