@@ -84,6 +84,9 @@ class WEConfig:
         self.sample = float(kw.get("sample", 1e-4))
         self.batch_size = int(kw.get("batch_size", 1024))
         self.data_block_size = int(kw.get("data_block_size", 100_000))
+        # reference-shaped PS block pipeline (pull rows / train / push
+        # deltas, ref ps_model-style use_ps) instead of the fused path
+        self.use_ps = str(kw.get("use_ps", "0")) in ("1", "true", "True")
         self.max_vocab = kw.get("max_vocab")
         self.train_file = kw.get("train_file", "")
         self.output = kw.get("output", "")
@@ -540,7 +543,10 @@ def main(argv=None) -> int:
     log.info("vocab %d words, %d training tokens (native=%s)",
              len(dictionary), ids.size, native.available())
     we = WordEmbedding(cfg, dictionary)
-    stats = we.train_fused(ids)
+    if cfg.use_ps:
+        stats = we.train_ps_blocks(ids)
+    else:
+        stats = we.train_fused(ids)
     log.info("trained: %s", stats)
     we.save_embeddings()
     mv.shutdown()
