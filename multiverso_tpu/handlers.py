@@ -35,7 +35,10 @@ class ArrayTableHandler:
     def get(self, out: Optional[np.ndarray] = None) -> np.ndarray:
         return self._table.get(out=out)
 
-    def add(self, data, sync: bool = True) -> None:
+    def add(self, data, sync: bool = False) -> None:
+        """ref tables.py add(data, sync=False): async by default; a later
+        get always reflects this add regardless (the table chains state at
+        dispatch), sync=True additionally blocks until it completes."""
         data = np.asarray(data, dtype=np.float32).reshape(-1)
         if sync:
             self._table.add(data)
@@ -85,10 +88,12 @@ class MatrixTableHandler:
         self._check_row_ids(row_ids)
         return self._table.get_rows(row_ids, out=out)
 
-    def add(self, data, row_ids=None, *, sync: bool = True) -> None:
+    def add(self, data, row_ids=None, *, sync: bool = False) -> None:
         """Whole-table add, or a row-batch add when ``row_ids`` is given
-        (ref tables.py:132 ``add(data, row_ids=None, sync)``); ``sync``
-        is keyword-only for the same ambiguity reason as ``get``."""
+        (ref tables.py:132 ``add(data, row_ids=None, sync=False)``);
+        ``sync`` is keyword-only for the same ambiguity reason as ``get``
+        and async by default like the reference (later gets still see the
+        add — the table chains state at dispatch)."""
         if row_ids is not None:
             self._check_row_ids(row_ids)
             return self.add_rows(row_ids, data, sync=sync)
@@ -102,7 +107,7 @@ class MatrixTableHandler:
     def get_rows(self, row_ids, out: Optional[np.ndarray] = None) -> np.ndarray:
         return self._table.get_rows(row_ids, out=out)
 
-    def add_rows(self, row_ids, values, sync: bool = True) -> None:
+    def add_rows(self, row_ids, values, sync: bool = False) -> None:
         if sync:
             self._table.add_rows(row_ids, values)
         else:

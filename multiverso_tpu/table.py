@@ -143,6 +143,18 @@ class Table:
     # ------------------------------------------------------------------ #
     def _track(self, arrays: Any, finalize=None) -> int:
         with self._lock:
+            # opportunistic sweep of completed fire-and-forget adds: an
+            # add whose msg id is never wait()ed (finalize is None and the
+            # completion token is already ready) would otherwise pin its
+            # device buffer in _pending forever. Swept ids behave exactly
+            # like already-waited ones (wait returns None).
+            done = [mid for mid, (arrs, fin) in self._pending.items()
+                    if fin is None and all(
+                        hasattr(a, "is_ready") and a.is_ready()
+                        for a in jax.tree.leaves(arrs)
+                        if isinstance(a, jax.Array))]
+            for mid in done:
+                del self._pending[mid]
             msg_id = self._next_msg_id
             self._next_msg_id += 1
             self._pending[msg_id] = (arrays, finalize)

@@ -302,3 +302,26 @@ def test_matrix_handler_rejects_ambiguous_positional():
             h.add(np.ones((4, 4), np.float32), False)  # legacy sync=
     finally:
         mv.shutdown()
+
+
+def test_async_handler_adds_do_not_leak_pending():
+    """Fire-and-forget handler adds (sync=False default, ref semantics)
+    must not grow Table._pending unboundedly — completed add tokens are
+    swept opportunistically."""
+    import multiverso_tpu as mv
+    from multiverso_tpu.handlers import ArrayTableHandler
+    mv.init()
+    try:
+        h = ArrayTableHandler(64, name="leak_check")
+        for i in range(50):
+            h.add(np.ones(64, np.float32))
+        # drain the device queue, then one more tracked op triggers a sweep
+        np.asarray(h.get())
+        h.add(np.ones(64, np.float32))
+        assert len(h._table._pending) < 10, len(h._table._pending)
+        # gets are never swept: their results stay claimable
+        mid = h._table.get_async()
+        h.add(np.ones(64, np.float32))
+        assert h._table.wait(mid) is not None
+    finally:
+        mv.shutdown()
