@@ -1,0 +1,134 @@
+"""lightLDA-style topic model on the sparse parameter-server table.
+
+BASELINE config 4's workload class ("lightLDA-style sparse topic table
+(SparseMatrixTable) — sparse push/pull path"): the word-topic count matrix
+lives in a :class:`SparseMatrixTable` (lightLDA shards exactly this table
+across Multiverso servers; ref README's related-projects list and the
+sparse dirty-row protocol of src/table/matrix.cpp:432-572). Workers
+process document batches: PULL only the batch's active vocabulary rows
+(the per-chunk key-set pull, ref SparseBlock<bool>), run a few on-device
+EM steps, and PUSH expected-count deltas for those rows — the sparse
+push/pull loop that is the parameter server's reason to exist for topic
+models (V x K is huge; a batch touches a sliver of V).
+
+TPU-first math: instead of per-token collapsed Gibbs (word2vec.c-era
+scalar sampling — latency-bound on a TPU), batches run **online EM** on
+dense [B, K] responsibilities: two MXU matmuls per iteration, duplicate
+word counts accumulated by scatter-add. The planted-topic recovery test
+(tests/test_lda.py) pins that the statistics this computes are the right
+ones.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class LDAConfig(NamedTuple):
+    vocab_size: int = 1000
+    num_topics: int = 8
+    doc_len: int = 64        # tokens per document (static shape; pad/trim)
+    em_iters: int = 5        # per-batch EM iterations on the pulled shard
+    alpha: float = 0.1       # document-topic prior
+    beta: float = 0.01       # topic-word prior
+
+
+def make_batch_step(cfg: LDAConfig):
+    """Jittable per-batch EM: ``(phi_rows, docs_local) ->
+    (delta_rows, theta, ll)``.
+
+    ``phi_rows`` [U, K]: pulled word-topic counts for the batch's U unique
+    words; ``docs_local`` [D, L] int32 indices INTO those U rows (the
+    caller maps global word ids -> local row slots, exactly the worker's
+    local-cache indirection in the reference sparse protocol).
+    Returns the expected-count delta for the same U rows, the per-doc
+    topic mixtures, and the batch mean log-likelihood.
+    """
+    K, a, b = cfg.num_topics, cfg.alpha, cfg.beta
+
+    def step(phi_rows, docs_local):
+        # topic-word distribution from counts (beta-smoothed); the
+        # normalizer over the FULL vocab is approximated by the pulled
+        # shard plus the prior mass — adequate for EM ascent and keeps the
+        # step independent of unpulled rows
+        phi = phi_rows + b
+        phi = phi / jnp.sum(phi, axis=0, keepdims=True)        # [U, K]
+        d, l = docs_local.shape
+        theta = jnp.full((d, K), 1.0 / K, jnp.float32)
+
+        def em(theta, _):
+            pw = jnp.take(phi, docs_local.reshape(-1), axis=0)  # [D*L, K]
+            pw = pw.reshape(d, l, K)
+            r = pw * theta[:, None, :]                          # [D, L, K]
+            norm = jnp.sum(r, axis=-1, keepdims=True)
+            r = r / jnp.maximum(norm, 1e-30)
+            theta = (jnp.sum(r, axis=1) + a)
+            theta = theta / jnp.sum(theta, axis=-1, keepdims=True)
+            return theta, jnp.mean(jnp.log(jnp.maximum(norm[..., 0],
+                                                       1e-30)))
+
+        theta, lls = jax.lax.scan(em, theta, None, length=cfg.em_iters)
+        # final responsibilities -> expected word-topic counts, scattered
+        # back onto the pulled rows (duplicates accumulate)
+        pw = jnp.take(phi, docs_local.reshape(-1), axis=0).reshape(d, l, K)
+        r = pw * theta[:, None, :]
+        r = r / jnp.maximum(jnp.sum(r, axis=-1, keepdims=True), 1e-30)
+        delta = jnp.zeros_like(phi_rows).at[docs_local.reshape(-1)].add(
+            r.reshape(d * l, K))
+        return delta, theta, lls[-1]
+
+    return jax.jit(step)
+
+
+class LDATrainer:
+    """Sparse push/pull training loop over a SparseMatrixTable.
+
+    Per batch: unique word ids -> ``get_rows_sparse`` (stale rows only
+    travel) -> on-device EM (:func:`make_batch_step`) -> ``add_rows`` of
+    the expected-count delta. The table's default ``+=`` updater is the
+    count accumulator, like lightLDA's servers.
+    """
+
+    def __init__(self, cfg: LDAConfig, table, worker_id: int = 0):
+        self.cfg = cfg
+        self.table = table
+        self.worker_id = worker_id
+        self._step = make_batch_step(cfg)
+
+    def train_batch(self, docs: np.ndarray) -> float:
+        """docs [D, L] int32 global word ids; returns batch mean ll."""
+        uids, local = np.unique(docs.reshape(-1), return_inverse=True)
+        rows = self.table.get_rows_sparse(uids, worker_id=self.worker_id)
+        delta, _, ll = self._step(jnp.asarray(rows),
+                                  jnp.asarray(local.reshape(docs.shape)
+                                              .astype(np.int32)))
+        self.table.add_rows(uids, np.asarray(delta))
+        return float(ll)
+
+    def word_topics(self) -> np.ndarray:
+        """argmax topic per word from the (pulled) full table."""
+        counts = self.table.get()
+        return np.argmax(counts + self.cfg.beta, axis=1)
+
+
+def synthetic_corpus(cfg: LDAConfig, n_docs: int, seed: int = 0
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+    """Planted-topic corpus: topic k owns vocab block k; each doc mixes 1-2
+    topics. Returns (docs [n_docs, doc_len], true word->topic labels)."""
+    rng = np.random.default_rng(seed)
+    K, V, L = cfg.num_topics, cfg.vocab_size, cfg.doc_len
+    block = V // K
+    labels = np.repeat(np.arange(K), block)
+    labels = np.pad(labels, (0, V - labels.size), constant_values=K - 1)
+    docs = np.empty((n_docs, L), np.int32)
+    for d in range(n_docs):
+        ks = rng.choice(K, size=2, replace=False)
+        mix = rng.dirichlet([1.0, 1.0])
+        topic_of_tok = ks[(rng.uniform(size=L) > mix[0]).astype(int)]
+        offs = rng.integers(0, block, L)
+        docs[d] = topic_of_tok * block + offs
+    return docs, labels

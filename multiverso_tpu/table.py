@@ -165,7 +165,9 @@ class Table:
 
         For get-style ops the result is the materialized host array (the ref's
         Wait(GetAsync) leaves the data in the user buffer, src/table.cpp:27-97);
-        for adds it is the completion token.
+        for adds it is the completion token — or ``None`` when the add already
+        completed (its token may have been swept by :meth:`_track`, which is
+        indistinguishable from waiting on an already-waited id).
         """
         with self._lock:
             entry = self._pending.pop(msg_id, None)
