@@ -334,19 +334,18 @@ class WordEmbedding:
         blocks = [ids[lo: lo + cfg.data_block_size]
                   for lo in range(0, ids.size, cfg.data_block_size)]
         blocks = [b for b in blocks if b.size >= 2]
-        prepared = self._prepare_block(blocks[0], rng) if blocks else None
-        for i, block in enumerate(blocks):
-            nxt = (self._prepare_block(blocks[i + 1], rng)
-                   if i + 1 < len(blocks) else None)
+        # one flat schedule across all epochs so the pull of the next block
+        # overlaps training of the current one at every step, including
+        # across epoch boundaries (ref :202-223 keeps its overlap thread
+        # alive for the whole multi-epoch run)
+        schedule = [b for _ in range(epochs) for b in blocks]
+        prepared = self._prepare_block(schedule[0], rng) if schedule else None
+        for i, block in enumerate(schedule):
+            nxt = (self._prepare_block(schedule[i + 1], rng)
+                   if i + 1 < len(schedule) else None)
             losses.append(self._train_prepared(prepared, nw))
             words += block.size
             prepared = nxt
-        # epochs > 1: simple repetition without cross-epoch prefetch
-        for _ in range(epochs - 1):
-            for block in blocks:
-                losses.append(self._train_prepared(
-                    self._prepare_block(block, rng), nw))
-                words += block.size
         dt = time.perf_counter() - t0
         self._trained_words += words
         self.word_count.add([0], [words])
@@ -469,6 +468,13 @@ class WordEmbedding:
                 else:
                     self.table_out.add_rows(prep["vocab"], d_sec)
             return loss_sum / max(nb, 1)
+
+    def total_word_count(self) -> int:
+        """Global trained-word count across all workers — the reference reads
+        the server-aggregated KV value (ref communicator.cpp:17-31 +
+        kv_table.h:44-99), so this uses the aggregated Get, not the local
+        view. Multi-process this is a collective (all processes call it)."""
+        return int(self.word_count.get([0], global_=True)[0])
 
     # ------------------------------------------------------------------ #
     def embeddings(self) -> np.ndarray:

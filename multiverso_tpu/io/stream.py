@@ -2,10 +2,13 @@
 
 TPU-native equivalent of the reference IO layer
 (ref: include/multiverso/io/io.h:24-132 — Stream/StreamFactory/TextReader with
-``file://`` vs ``hdfs://`` URI dispatch). The cloud-storage scheme of the TPU
-era is ``gs://``; it is gated on an optional dependency (gcsfs/tf.io) and
-raises a clear error when unavailable in this zero-egress environment. Local
-paths (bare or ``file://``) are first-class.
+``file://`` vs ``hdfs://`` URI dispatch; the working remote backend was
+src/io/hdfs_stream.cpp:1-157). The cloud-storage scheme of the TPU era is
+``gs://``; any non-local scheme is dispatched through fsspec, so ``gs://``
+(via gcsfs), ``s3://``, ``memory://`` (the fake-FS test backend), etc. all
+work through the same factory — the analogue of the reference's pluggable
+StreamFactory per URI scheme. Local paths (bare or ``file://``) are
+first-class and never touch fsspec.
 """
 
 from __future__ import annotations
@@ -57,18 +60,34 @@ class Stream:
         return self._f.flush()
 
 
+def _open_fsspec(uri: str, mode: str) -> IO[bytes]:
+    """Remote stream via fsspec (ref src/io/hdfs_stream.cpp — the reference's
+    one remote backend; fsspec gives us gs/s3/memory/... through one seam)."""
+    try:
+        import fsspec
+    except ImportError as e:  # pragma: no cover - fsspec is in the image
+        raise NotImplementedError(
+            f"{uri!r} needs fsspec for remote schemes (reference analogue: "
+            "hdfs:// needed libhdfs)") from e
+    fs, path = fsspec.core.url_to_fs(uri)
+    if "w" in mode or "a" in mode:
+        parent = path.rsplit("/", 1)[0]
+        if parent and parent != path:
+            try:
+                fs.makedirs(parent, exist_ok=True)
+            except Exception:
+                pass  # flat namespaces (gs buckets) have no real dirs
+    return fs.open(path, mode)
+
+
 def open_stream(uri: str, mode: str = "rb") -> Stream:
     """ref StreamFactory::GetStream (io.h) — dispatch on URI scheme."""
     if "b" not in mode:
         mode += "b"
     if uri.startswith("file://"):
         path = uri[len("file://"):]
-    elif uri.startswith("gs://"):
-        raise NotImplementedError(
-            "gs:// streams need gcsfs/tensorflow-io; not available in this "
-            "environment (reference analogue: hdfs:// needed libhdfs)")
     elif "://" in uri:
-        raise ValueError(f"unsupported stream scheme in {uri!r}")
+        return Stream(_open_fsspec(uri, mode), uri)
     else:
         path = uri
     if "w" in mode or "a" in mode:

@@ -37,9 +37,24 @@ class KVTable:
             for k, v in zip(keys, values):
                 self._store[int(k)] = self._store.get(int(k), 0) + v
 
-    def get(self, keys: Optional[Iterable[int]] = None) -> Dict[int, float]:
-        """ref kv_table.h Get: pull requested keys (None = whole table) into
-        the worker-side cache; here it simply returns a dict."""
+    def get(self, keys: Optional[Iterable[int]] = None,
+            global_: bool = False) -> Dict[int, float]:
+        """ref kv_table.h Get (:44-99): the reference pulls the
+        *server-aggregated* value — every worker's Adds are summed on the
+        hash-sharded servers before a Get sees them. ``global_=True``
+        reproduces that: it returns cross-process aggregated values
+        (a host allgather; every process must call it — a collective,
+        like every host-plane multi-controller op here). The default
+        ``global_=False`` is the process-local view (single-process the
+        two are identical). Unlike :meth:`allreduce` this does NOT
+        overwrite the local store, so it is safe to call repeatedly
+        between Adds."""
+        if global_ and self._zoo.size() > 1:
+            with monitor(f"table[{self.name}].get"):
+                merged = self._merged()
+                if keys is None:
+                    return merged
+                return {int(k): merged.get(int(k), 0) for k in keys}
         with monitor(f"table[{self.name}].get"), self._lock:
             if keys is None:
                 return dict(self._store)
@@ -53,14 +68,25 @@ class KVTable:
         return self._store.get(int(key), 0)
 
     def allreduce(self) -> Dict[int, float]:
-        """Aggregate counts across processes (multi-host path). With one
-        process this is a no-op view. Uses a host-side allgather over the JAX
-        distributed client rather than device collectives: KV payloads are
-        ragged and tiny."""
+        """Aggregate counts across processes and COMMIT the merged view as
+        the new local store (model-average style; idempotence hazard: calling
+        it twice without intervening Adds multiplies by the process count —
+        use ``get(global_=True)`` for a repeatable aggregated read). With one
+        process this is a no-op view."""
         if self._zoo.size() == 1:
             return self.get()
+        merged = self._merged()
+        with self._lock:
+            self._store = dict(merged)
+        return merged
+
+    def _merged(self) -> Dict[int, float]:
+        """Non-destructive cross-process sum of every process's store.
+        Host allgather over the JAX distributed client rather than device
+        collectives: KV payloads are ragged and tiny."""
         from jax.experimental import multihost_utils
-        items = sorted(self._store.items())
+        with self._lock:
+            items = sorted(self._store.items())
         keys = np.array([k for k, _ in items], dtype=np.int64)
         vals = np.array([v for _, v in items], dtype=np.float64)
         # Host allgather needs identical shapes per process; key sets are
@@ -79,9 +105,7 @@ class KVTable:
             for k, v in zip(krow, vrow):
                 if k >= 0:
                     merged[int(k)] = merged.get(int(k), 0) + v
-        with self._lock:
-            self._store = merged
-        return dict(merged)
+        return merged
 
     # ------------------------------------------------------------------ #
     # checkpoint — implemented, unlike the reference stub

@@ -62,19 +62,26 @@ class SparseMatrixTable(MatrixTable):
             np.ones((self._n_workers, self._padded_rows), dtype=bool),
             dirty_spec)
         # Worker-side row caches (the reference worker's local buffer the
-        # sparse Get merges into), allocated lazily per worker: most processes
-        # only ever act as one worker, so eager (W, R, C) host allocation
-        # would waste W-1 dense copies.
+        # sparse Get merges into), allocated lazily per worker AND keyed by
+        # row: the workload class this table exists for (21M vocab x 300 dim,
+        # ref Applications/WordEmbedding/README.md) makes a dense
+        # (num_row, num_col) host mirror ~25 GB per worker — the cache must
+        # cost O(rows actually pulled), not O(table).
         self._cache: dict = {}
 
-    def _worker_cache(self, worker_id: int) -> np.ndarray:
+    def _worker_cache(self, worker_id: int) -> "_RowCache":
         if not (0 <= worker_id < self._n_workers):
             raise IndexError(
                 f"worker_id {worker_id} out of range [0, {self._n_workers})")
         cache = self._cache.get(worker_id)
         if cache is None:
-            cache = self._cache[worker_id] = np.zeros(self.shape, self.dtype)
+            cache = self._cache[worker_id] = _RowCache(self.num_col,
+                                                       self.dtype)
         return cache
+
+    def cache_nbytes(self, worker_id: int) -> int:
+        """Host bytes held by ``worker_id``'s row cache (diagnostic)."""
+        return self._worker_cache(worker_id).nbytes
 
     # ------------------------------------------------------------------ #
     # jitted helpers
@@ -142,8 +149,8 @@ class SparseMatrixTable(MatrixTable):
             stale = uids[:k][mask_host]
             if stale.size:
                 rows = super().get_rows(stale)
-                cache[stale] = rows
-            return cache[ids]
+                cache.put(stale, rows)
+            return cache.take(ids)
 
     def stale_fraction(self, row_ids, worker_id: int = 0) -> float:
         """Diagnostic: fraction of the requested rows that would transfer."""
@@ -160,6 +167,79 @@ class SparseMatrixTable(MatrixTable):
                                 jax.device_put(uids, self._replicated),
                                 worker_id))[:k]
         return float(mask.mean()) if k else 0.0
+
+
+class _RowCache:
+    """Row-keyed worker cache: a sorted-key index (row_id -> slot, resolved
+    with ``np.searchsorted`` so lookups stay vectorized) over a growable
+    (slots, num_col) buffer. Memory is O(distinct rows pulled) with amortized
+    doubling — the sparse analogue of the reference worker's local row buffer
+    (ref src/table/matrix.cpp worker side), sized for 21M-vocab tables."""
+
+    def __init__(self, num_col: int, dtype):
+        self._num_col = int(num_col)
+        self._dtype = dtype
+        self._keys = np.empty(0, np.int64)    # sorted distinct row ids
+        self._slots = np.empty(0, np.int64)   # buffer slot per sorted key
+        self._buf = np.empty((0, self._num_col), dtype)
+        self._n = 0                           # slots in use
+
+    @property
+    def nbytes(self) -> int:
+        return self._buf.nbytes + self._keys.nbytes + self._slots.nbytes
+
+    def _ensure(self, extra: int) -> None:
+        need = self._n + extra
+        if need <= self._buf.shape[0]:
+            return
+        cap = max(8, self._buf.shape[0])
+        while cap < need:
+            cap *= 2
+        buf = np.empty((cap, self._num_col), self._dtype)
+        buf[: self._buf.shape[0]] = self._buf
+        self._buf = buf
+
+    def _locate(self, ids: np.ndarray):
+        """(insertion positions, found mask) of ``ids`` in the key index."""
+        pos = np.searchsorted(self._keys, ids)
+        if self._keys.size == 0:
+            return pos, np.zeros(ids.size, bool)
+        clip = np.minimum(pos, self._keys.size - 1)
+        return clip, self._keys[clip] == ids
+
+    def put(self, ids: np.ndarray, rows: np.ndarray) -> None:
+        """Insert/overwrite rows; ``ids`` must be distinct (callers pass the
+        unique stale subset of an already-deduped batch)."""
+        ids = np.asarray(ids, np.int64)
+        clip, found = self._locate(ids)
+        n_new = int(ids.size - found.sum())
+        self._ensure(n_new)
+        slots = np.empty(ids.size, np.int64)
+        slots[found] = self._slots[clip[found]]
+        if n_new:
+            new_slots = np.arange(self._n, self._n + n_new)
+            slots[~found] = new_slots
+            # insert at their searchsorted positions: O(K + n log n), not a
+            # full re-sort of the K cached keys per pull
+            order = np.argsort(ids[~found], kind="stable")
+            nk, ns = ids[~found][order], new_slots[order]
+            at = np.searchsorted(self._keys, nk)
+            self._keys = np.insert(self._keys, at, nk)
+            self._slots = np.insert(self._slots, at, ns)
+            self._n += n_new
+        self._buf[slots] = rows
+
+    def take(self, ids: np.ndarray) -> np.ndarray:
+        """Rows for ``ids``; every id must be cached (fresh rows were pulled
+        by an earlier sparse Get — dirty bits start all-True, so a never-
+        pulled row is always stale and lands in the cache first)."""
+        ids = np.asarray(ids, np.int64)
+        clip, found = self._locate(ids)
+        if not found.all():
+            raise KeyError(
+                f"rows {ids[~found][:5].tolist()}... not cached (stale "
+                "protocol invariant violated)")
+        return self._buf[self._slots[clip]]
 
 
 class SparseMatrixTableOption:

@@ -36,9 +36,14 @@ def main():
     agg = mv.aggregate(data)
     out["aggregate"] = agg.tolist()
 
-    # KV allreduce with ragged per-process key sets
+    # KV aggregated Get (ref kv_table.h:44-99 server-summed read): repeatable
+    # and non-destructive, so two calls must agree and must not perturb the
+    # local store that allreduce() then commits
     kv = mv.KVTable(name="mp_kv")
     kv.add(list(range(pid + 1)), [10] * (pid + 1))  # rank r adds r+1 keys
+    gview = kv.get(global_=True)
+    assert kv.get(global_=True) == gview
+    out["kv_global"] = {str(k): float(v) for k, v in sorted(gview.items())}
     merged = kv.allreduce()
     out["kv"] = {str(k): float(v) for k, v in sorted(merged.items())}
 

@@ -1,0 +1,87 @@
+"""URI-dispatched stream layer: local + remote (fsspec) backends.
+
+The reference's remote backend is HDFS (ref src/io/hdfs_stream.cpp:1-157,
+exercised only in the Docker battery against a live namenode); here the
+remote seam is fsspec, and the fake-FS tier uses its ``memory://`` backend —
+the same code path gs:// takes, minus the network.
+"""
+
+import numpy as np
+import pytest
+
+import multiverso_tpu as mv
+from multiverso_tpu import checkpoint
+from multiverso_tpu.io.stream import TextReader, open_stream
+
+
+def _clear_memfs():
+    import fsspec
+    fs = fsspec.filesystem("memory")
+    fs.store.clear()
+
+
+class TestLocalStream:
+    def test_roundtrip(self, tmp_path):
+        p = str(tmp_path / "sub" / "blob.bin")  # parent dir auto-created
+        with open_stream(p, "wb") as s:
+            s.write(b"hello multiverso")
+        with open_stream("file://" + p, "rb") as s:
+            assert s.read() == b"hello multiverso"
+
+    def test_bad_scheme_raises(self):
+        with pytest.raises(Exception):
+            open_stream("no-such-scheme-xyz://bucket/obj", "rb")
+
+
+class TestMemoryStream:
+    """memory:// is the fake-FS stand-in for gs:// (same fsspec dispatch)."""
+
+    def setup_method(self):
+        _clear_memfs()
+
+    def test_roundtrip(self):
+        with open_stream("memory://bucket/dir/blob.bin", "wb") as s:
+            s.write(b"\x00\x01remote")
+        with open_stream("memory://bucket/dir/blob.bin", "rb") as s:
+            assert s.read() == b"\x00\x01remote"
+
+    def test_numpy_save_load(self):
+        arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+        with open_stream("memory://bucket/arr.npy", "wb") as s:
+            np.save(s, arr, allow_pickle=False)
+        with open_stream("memory://bucket/arr.npy", "rb") as s:
+            np.testing.assert_array_equal(np.load(s), arr)
+
+    def test_text_reader(self):
+        with open_stream("memory://bucket/corpus.txt", "wb") as s:
+            s.write("line one\nline two\nline three\n".encode())
+        lines = list(TextReader("memory://bucket/corpus.txt"))
+        assert lines == ["line one", "line two", "line three"]
+
+
+class TestRemoteCheckpoint:
+    """Checkpoint save/restore through the remote stream layer — the
+    capability the reference used HDFS for (ref io.h URI dispatch +
+    hdfs_stream.cpp), proven here over the same fsspec seam gs:// rides."""
+
+    def setup_method(self):
+        _clear_memfs()
+
+    def test_save_restore_memory_uri(self):
+        mv.init()
+        try:
+            t = mv.ArrayTable(16, name="ckpt_arr")
+            t.add(np.arange(16, dtype=np.float32))
+            kv = mv.KVTable(name="ckpt_kv")
+            kv.add([3, 5], [1.0, 2.0])
+            path = checkpoint.save("memory://ckpt-bucket/run1", tag="step10")
+            assert path.startswith("memory://")
+            t.add(np.ones(16, np.float32))          # diverge
+            kv.add([3], [9.0])
+            n = checkpoint.restore("memory://ckpt-bucket/run1", tag="step10")
+            assert n >= 2
+            np.testing.assert_allclose(t.get(),
+                                       np.arange(16, dtype=np.float32))
+            assert kv.get([3, 5]) == {3: 1.0, 5: 2.0}
+        finally:
+            mv.shutdown()

@@ -65,6 +65,8 @@ def test_process_cluster(nprocs):
         # kv: rank r adds keys 0..r, value 10 each -> key k has 10*(N-k)
         assert r["kv"] == {str(k): 10.0 * (nprocs - k)
                            for k in range(nprocs)}
+        # aggregated Get sees the same server-summed view
+        assert r["kv_global"] == r["kv"]
         # matrix collective row add of rank+1 in both rows
         assert r["matrix_rows"] == [[tri] * 4, [tri] * 4]
         # sharedvar: every worker pushed +1 -> merged value N everywhere

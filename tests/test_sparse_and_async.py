@@ -54,6 +54,20 @@ class TestSparseMatrixTable:
         assert t.stale_fraction(range(10)) == 1.0
         np.testing.assert_allclose(t.get_rows_sparse(range(10)), 1.0)
 
+    def test_worker_cache_is_sparse(self):
+        """The worker cache must cost O(rows pulled), not O(table): the
+        reference's workload class is 21M vocab x 300 dim (ref
+        Applications/WordEmbedding/README.md) — a dense host mirror per
+        worker would be ~25 GB. 1M x 128 here, pulling a few hundred rows."""
+        t = mv.SparseMatrixTable(1_000_000, 128, num_workers=4)
+        ids = np.arange(0, 1_000_000, 4096)   # 245 rows
+        rows = t.get_rows_sparse(ids, worker_id=0)
+        assert rows.shape == (ids.size, 128)
+        dense_bytes = 1_000_000 * 128 * 4
+        assert t.cache_nbytes(0) < dense_bytes // 100   # ~512 KB vs 512 MB
+        # repeat pull: served from the sparse cache, values stable
+        np.testing.assert_allclose(t.get_rows_sparse(ids, worker_id=0), rows)
+
     def test_duplicate_ids(self):
         t = mv.SparseMatrixTable(10, 4, num_workers=1)
         t.add_rows([2], np.ones((1, 4), np.float32))
