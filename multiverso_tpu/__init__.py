@@ -15,6 +15,8 @@ from multiverso_tpu.api import (
     num_servers, num_workers, rank, server_id, servers_num, shutdown, size,
     worker_id, workers_num,
 )
+from multiverso_tpu.ps import (AsyncArrayTable, AsyncKVTable,
+                               AsyncMatrixTable)
 from multiverso_tpu.table import Table
 from multiverso_tpu.tables import ArrayTable, KVTable, MatrixTable, SparseMatrixTable
 from multiverso_tpu.tables.array_table import ArrayTableOption
@@ -37,6 +39,6 @@ def __getattr__(name):
     # native import multiverso_tpu themselves, so eager import would cycle).
     import importlib
     if name in ("checkpoint", "parallel", "handlers", "sharedvar", "native",
-                "models", "apps", "io", "data", "ssp", "elastic"):
+                "models", "apps", "io", "data", "ssp", "elastic", "ps"):
         return importlib.import_module(f"multiverso_tpu.{name}")
     raise AttributeError(f"module 'multiverso_tpu' has no attribute {name!r}")

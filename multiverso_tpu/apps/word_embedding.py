@@ -87,6 +87,9 @@ class WEConfig:
         # reference-shaped PS block pipeline (pull rows / train / push
         # deltas, ref ps_model-style use_ps) instead of the fused path
         self.use_ps = str(kw.get("use_ps", "0")) in ("1", "true", "True")
+        # uncoordinated async tables (multiverso_tpu.ps): workers trade
+        # rows at independent rates — the reference's default Server mode
+        self.async_ps = str(kw.get("async_ps", "0")) in ("1", "true", "True")
         self.max_vocab = kw.get("max_vocab")
         self.train_file = kw.get("train_file", "")
         self.output = kw.get("output", "")
@@ -116,22 +119,26 @@ class WordEmbedding:
         if v < 2:
             raise ValueError("vocabulary too small; lower min_count")
         # input/output embedding tables (ref communicator.cpp:17-31: two
-        # MatrixTables; input randomly initialized server-side)
-        self.table_in = mv.MatrixTable(v, d, name="embed_in", updater="default",
-                                       seed=cfg.seed + 17,
-                                       init_scale=0.5 / d)
-        self.table_out = mv.MatrixTable(v, d, name="embed_out",
-                                        updater="default")
-        self.word_count = mv.KVTable(name="word_count")
+        # MatrixTables; input randomly initialized server-side). async_ps
+        # swaps in the uncoordinated tables — same client API, no lockstep.
+        if cfg.async_ps:
+            matrix, kv = mv.AsyncMatrixTable, mv.AsyncKVTable
+        else:
+            matrix, kv = mv.MatrixTable, mv.KVTable
+        self.table_in = matrix(v, d, name="embed_in", updater="default",
+                               seed=cfg.seed + 17, init_scale=0.5 / d)
+        self.table_out = matrix(v, d, name="embed_out", updater="default")
+        self.word_count = kv(name="word_count")
         self.unigram = dictionary.unigram_table()
         self._trained_words = 0
+        self._data_presplit = False   # caller already sharded the corpus
         self._fused_cache: Dict[str, object] = {}
         self._pair_cache: Dict[object, object] = {}
         if cfg.hs:
             codes, points, lengths = build_huffman(dictionary.counts)
             self._hs = (codes, points, lengths)
-            self.table_hs = mv.MatrixTable(max(v - 1, 1), d, name="embed_hs",
-                                           updater="default")
+            self.table_hs = matrix(max(v - 1, 1), d, name="embed_hs",
+                                   updater="default")
         else:
             self._hs = None
 
@@ -329,11 +336,17 @@ class WordEmbedding:
         cfg = self.cfg
         epochs = epochs or cfg.epoch
         rng = np.random.default_rng(cfg.seed)
-        nw = max(mv.num_workers(), 1)
+        nw, wid = self._ps_topology()
         t0, losses, words = time.perf_counter(), [], 0
         blocks = [ids[lo: lo + cfg.data_block_size]
                   for lo in range(0, ids.size, cfg.data_block_size)]
         blocks = [b for b in blocks if b.size >= 2]
+        if nw > 1 and cfg.async_ps and not self._data_presplit:
+            # data split evenly per worker (ref BENCHMARK.md common
+            # settings). ONLY on the uncoordinated plane: sync-table
+            # add_rows is a collective, so unequal per-worker block counts
+            # would leave the worker with more blocks waiting forever.
+            blocks = blocks[wid::nw]
         # one flat schedule across all epochs so the pull of the next block
         # overlaps training of the current one at every step, including
         # across epoch boundaries (ref :202-223 keeps its overlap thread
@@ -469,11 +482,21 @@ class WordEmbedding:
                     self.table_out.add_rows(prep["vocab"], d_sec)
             return loss_sum / max(nb, 1)
 
+    def _ps_topology(self) -> Tuple[int, int]:
+        """(num_workers, worker_id) of the PS plane in use: the async
+        context's world for uncoordinated tables, the collective runtime's
+        otherwise."""
+        if self.cfg.async_ps:
+            ctx = self.table_in.ctx
+            return max(ctx.world, 1), ctx.rank
+        return max(mv.num_workers(), 1), mv.rank()
+
     def total_word_count(self) -> int:
         """Global trained-word count across all workers — the reference reads
         the server-aggregated KV value (ref communicator.cpp:17-31 +
         kv_table.h:44-99), so this uses the aggregated Get, not the local
-        view. Multi-process this is a collective (all processes call it)."""
+        view. Async tables aggregate on every get (uncoordinated); the sync
+        KVTable needs the collective global_=True read."""
         return int(self.word_count.get([0], global_=True)[0])
 
     # ------------------------------------------------------------------ #

@@ -1,0 +1,42 @@
+"""Uncoordinated cross-process parameter-server plane.
+
+This package is the TPU-native rebuild of the reference's *defining*
+capability: workers that push (`Add`) and pull (`Get`) against sharded
+parameter storage **at independent rates, with no peer coordination**
+(ref: src/worker.cpp:30-76 partitions a request per server;
+src/server.cpp:36-58 applies whatever arrives, whenever it arrives;
+Applications/WordEmbedding/src/communicator.cpp:104-236 pulls *this
+worker's* block vocabulary).
+
+The synchronous table plane (multiverso_tpu.table) maps Add/Get onto XLA
+collectives — correct BSP, but every multi-process op is lockstep. Here the
+wire is a host-side RPC service instead:
+
+* every process runs a :class:`~multiverso_tpu.ps.service.PSService` —
+  a listener thread + per-connection handler threads (the reference's
+  Communicator recv thread + Server actor, collapsed);
+* every process *owns* a contiguous row range of each async table as a
+  device-resident shard (:class:`~multiverso_tpu.ps.shard.RowShard`); the
+  shard's updater runs as a jitted program on the owner's local TPU device
+  — the compute stays on the accelerator, only the row payloads ride TCP
+  (the DCN-analogue wire; ICI collectives are the *sync* plane's wire);
+* clients partition each Add/Get by owner rank and talk directly to the
+  owners (ref Worker::Partition), local shards short-circuiting the socket
+  (ref Communicator LocalForward, src/communicator.cpp:69-75).
+
+No barrier, no allgather: a straggler or dead worker never blocks peers —
+requests to its shard fail with :class:`PSPeerError` after a timeout while
+traffic to live shards proceeds (the elastic story the reference lacked).
+"""
+
+from multiverso_tpu.ps.service import (PSContext, PSError, PSPeerError,
+                                       PSService, default_context,
+                                       reset_default_context)
+from multiverso_tpu.ps.tables import (AsyncArrayTable, AsyncKVTable,
+                                      AsyncMatrixTable)
+
+__all__ = [
+    "AsyncArrayTable", "AsyncKVTable", "AsyncMatrixTable",
+    "PSContext", "PSError", "PSPeerError", "PSService",
+    "default_context", "reset_default_context",
+]

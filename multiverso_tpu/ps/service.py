@@ -1,0 +1,435 @@
+"""PSService: the per-process async parameter-server runtime.
+
+TPU-native re-design of the reference's actor/net runtime for the *async*
+plane (ref: src/communicator.cpp — recv thread bridging net and actors;
+src/server.cpp:36-58 — Server actor applying Adds/answering Gets as they
+arrive; src/zoo.cpp:117-146 — Controller rendezvous assigning ranks).
+
+One PSService per process:
+
+* a listener thread accepts peer connections; each connection gets a
+  handler thread that reads requests, dispatches to the owning table
+  shard, and writes the reply (the reference's THREAD_MULTIPLE mode,
+  communicator.cpp:39-48 — one recv loop per peer instead of one global);
+* a client side (:class:`_Peer`) keeps one persistent connection per
+  remote rank with a receiver thread completing per-``msg_id`` futures —
+  the reference's msg_id -> Waiter bookkeeping (src/table.cpp:27-97) as
+  ``concurrent.futures``;
+* rendezvous: ranks find each other through a shared directory (flag
+  ``ps_rendezvous``) or the JAX distributed coordinator's KV store when
+  ``jax.distributed`` is live — the Controller's Register handshake with
+  the coordinator already provided by the TPU runtime.
+
+Local shards short-circuit the socket (ref LocalForward,
+src/communicator.cpp:69-75) but still run on the service executor so
+``add_async`` keeps fire-and-forget semantics.
+
+Failure semantics: requests to a dead/unreachable rank raise
+:class:`PSPeerError` (after ``ps_connect_timeout``/``ps_timeout``); the
+service itself keeps serving live peers — no collective, so nobody hangs.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import os
+import socket
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from multiverso_tpu.ps import wire
+from multiverso_tpu.utils import config, log
+
+# message types (request side; replies reuse the id space below 0x100)
+MSG_REPLY_OK = 1
+MSG_REPLY_ERR = 2
+MSG_PING = 0x10
+MSG_ADD_ROWS = 0x11
+MSG_GET_ROWS = 0x12
+MSG_SET_ROWS = 0x13
+MSG_ADD_FULL = 0x14
+MSG_GET_FULL = 0x15
+MSG_KV_ADD = 0x16
+MSG_KV_GET = 0x17
+
+config.define_string("ps_rendezvous", "",
+                     "directory for async-PS rank rendezvous (empty = use "
+                     "the jax.distributed KV store when available)")
+config.define_int("ps_rank", -1,
+                  "async-PS rank override (-1 = jax.process_index); lets "
+                  "the async plane run without a JAX coordinator, like the "
+                  "reference PS needed only its own transport")
+config.define_int("ps_world", 0,
+                  "async-PS world-size override (0 = jax.process_count)")
+config.define_int("ps_port", 0, "async-PS listen port (0 = ephemeral)")
+config.define_float("ps_timeout", 300.0,
+                    "async-PS request timeout seconds (generous default: "
+                    "a shard's FIRST add/get of each bucket size jit-"
+                    "compiles on the owner, which can take tens of seconds "
+                    "per program on a cold TPU)")
+config.define_float("ps_connect_timeout", 30.0,
+                    "async-PS peer connect timeout seconds")
+
+
+class PSError(RuntimeError):
+    pass
+
+
+class PSPeerError(PSError):
+    """A specific peer is unreachable/dead; traffic to others is unaffected."""
+
+
+# ---------------------------------------------------------------------- #
+# rendezvous backends
+# ---------------------------------------------------------------------- #
+class FileRendezvous:
+    """Shared-directory rendezvous (the test/multi-process-on-one-host path;
+    the reference's machine_file, include/multiverso/net/zmq_net.h:20-61)."""
+
+    def __init__(self, directory: str):
+        self._dir = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def publish(self, rank: int, addr: str) -> None:
+        tmp = os.path.join(self._dir, f".{rank}.addr.tmp")
+        with open(tmp, "w") as f:
+            f.write(addr)
+        os.replace(tmp, os.path.join(self._dir, f"{rank}.addr"))
+
+    def lookup(self, rank: int, timeout: float) -> str:
+        path = os.path.join(self._dir, f"{rank}.addr")
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                with open(path) as f:
+                    addr = f.read().strip()
+                if addr:
+                    return addr
+            except FileNotFoundError:
+                pass
+            time.sleep(0.02)
+        raise PSPeerError(f"rank {rank} never published an address "
+                          f"({path} missing after {timeout}s)")
+
+
+class JaxRendezvous:
+    """Rendezvous over the jax.distributed coordinator's KV store — the
+    multi-host path; topology discovery the reference needed a Controller
+    for (src/controller.cpp:38-80) comes from the TPU runtime."""
+
+    def __init__(self, namespace: str = "mv_ps"):
+        from jax._src import distributed  # jax's coordinator KV client
+        client = distributed.global_state.client
+        if client is None:
+            raise PSError("jax.distributed is not initialized")
+        self._client = client
+        self._ns = namespace
+
+    def publish(self, rank: int, addr: str) -> None:
+        self._client.key_value_set(f"{self._ns}/{rank}", addr)
+
+    def lookup(self, rank: int, timeout: float) -> str:
+        try:
+            return self._client.blocking_key_value_get(
+                f"{self._ns}/{rank}", int(timeout * 1000))
+        except Exception as e:
+            raise PSPeerError(f"rank {rank} not in coordinator KV store: "
+                              f"{e}") from e
+
+
+# ---------------------------------------------------------------------- #
+# client side: one persistent connection per remote rank
+# ---------------------------------------------------------------------- #
+class _Peer:
+    def __init__(self, rank: int, addr: str, connect_timeout: float,
+                 io_timeout: float):
+        self.rank = rank
+        host, port = addr.rsplit(":", 1)
+        deadline = time.monotonic() + connect_timeout
+        last: Optional[Exception] = None
+        while True:
+            try:
+                self._sock = socket.create_connection(
+                    (host, int(port)), timeout=connect_timeout)
+                break
+            except OSError as e:
+                last = e
+                if time.monotonic() >= deadline:
+                    raise PSPeerError(
+                        f"cannot connect to rank {rank} at {addr}: {e}"
+                    ) from e
+                time.sleep(0.05)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock.settimeout(io_timeout)
+        self._send_lock = threading.Lock()
+        self._pending: Dict[int, cf.Future] = {}
+        self._pending_lock = threading.Lock()
+        self._next_id = 0
+        self._dead: Optional[Exception] = None
+        self._recv_thread = threading.Thread(
+            target=self._recv_loop, name=f"ps-peer-{rank}", daemon=True)
+        self._recv_thread.start()
+
+    def _recv_loop(self) -> None:
+        try:
+            while True:
+                try:
+                    msg_type, msg_id, meta, arrays = wire.recv(self._sock)
+                except TimeoutError:
+                    # idle socket, nothing in flight is harmed: the io
+                    # timeout bounds BLOCKED REPLIES via each waiter's
+                    # fut.result(timeout), not connection lifetime — a
+                    # healthy-but-quiet peer must not be declared dead
+                    continue
+                with self._pending_lock:
+                    fut = self._pending.pop(msg_id, None)
+                if fut is None:
+                    continue
+                if msg_type == MSG_REPLY_ERR:
+                    fut.set_exception(PSError(
+                        f"rank {self.rank}: {meta.get('error', '?')}"))
+                else:
+                    fut.set_result((meta, arrays))
+        except Exception as e:  # socket death: fail everything in flight
+            err = PSPeerError(f"rank {self.rank} connection lost: {e}")
+            self._dead = err
+            with self._pending_lock:
+                pending, self._pending = self._pending, {}
+            for fut in pending.values():
+                if not fut.done():
+                    fut.set_exception(err)
+
+    def request(self, msg_type: int, meta: Dict,
+                arrays: Sequence[np.ndarray]) -> cf.Future:
+        fut: cf.Future = cf.Future()
+        if self._dead is not None:
+            fut.set_exception(self._dead)
+            return fut
+        with self._send_lock:
+            msg_id = self._next_id
+            self._next_id += 1
+            with self._pending_lock:
+                self._pending[msg_id] = fut
+            try:
+                wire.send(self._sock, msg_type, msg_id, meta, arrays)
+            except OSError as e:
+                err = PSPeerError(f"rank {self.rank} send failed: {e}")
+                self._dead = err
+                with self._pending_lock:
+                    self._pending.pop(msg_id, None)
+                fut.set_exception(err)
+        return fut
+
+    def close(self) -> None:
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+
+
+# ---------------------------------------------------------------------- #
+# the service
+# ---------------------------------------------------------------------- #
+class PSService:
+    """Listener + shard registry + peer pool for one process."""
+
+    def __init__(self, rank: int, world: int, rendezvous=None,
+                 host: str = "127.0.0.1", port: Optional[int] = None):
+        self.rank, self.world = rank, world
+        self._rendezvous = rendezvous
+        self._handlers: Dict[str, Callable] = {}
+        self._handlers_cv = threading.Condition()
+        self._peers: Dict[int, _Peer] = {}
+        self._peers_lock = threading.Lock()
+        self._conns: List[socket.socket] = []
+        self._conns_lock = threading.Lock()
+        self._closed = False
+        # fire-and-forget local dispatch (ref: ops on the local shard still
+        # hop through the Server actor thread, zoo.cpp SendTo)
+        self._local_exec = cf.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="ps-local")
+        self._listener = socket.create_server(
+            (host, port if port is not None else config.get_flag("ps_port")))
+        self.addr = "%s:%d" % (host, self._listener.getsockname()[1])
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="ps-accept", daemon=True)
+        self._accept_thread.start()
+        if rendezvous is not None:
+            rendezvous.publish(rank, self.addr)
+        log.debug("PSService rank %d/%d listening on %s", rank, world,
+                  self.addr)
+
+    # ----------------------------- server side ----------------------- #
+    def register_handler(self, table: str, handler: Callable) -> None:
+        """``handler(msg_type, meta, arrays) -> (meta, arrays)``, called on
+        a connection thread; the shard serializes internally."""
+        with self._handlers_cv:
+            self._handlers[table] = handler
+            self._handlers_cv.notify_all()
+
+    def _wait_handler(self, table: str, timeout: float = 20.0) -> Callable:
+        # a worker can race ahead of a peer still constructing its tables
+        # (the reference serialized this through MV_CreateTable's barrier;
+        # the async plane just waits at the server)
+        with self._handlers_cv:
+            deadline = time.monotonic() + timeout
+            while table not in self._handlers:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._handlers_cv.wait(remaining):
+                    raise PSError(f"no such table {table!r} on rank "
+                                  f"{self.rank} (after {timeout}s)")
+            return self._handlers[table]
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._conns_lock:
+                self._conns.append(conn)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             name="ps-conn", daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        send_lock = threading.Lock()
+        try:
+            while not self._closed:
+                msg_type, msg_id, meta, arrays = wire.recv(conn)
+                if msg_type == MSG_PING:
+                    with send_lock:
+                        wire.send(conn, MSG_REPLY_OK, msg_id,
+                                  {"rank": self.rank})
+                    continue
+                try:
+                    handler = self._wait_handler(meta["table"])
+                    rmeta, rarrays = handler(msg_type, meta, arrays)
+                    with send_lock:
+                        wire.send(conn, MSG_REPLY_OK, msg_id, rmeta, rarrays)
+                except Exception as e:  # reply errors, don't kill the conn
+                    log.debug("ps handler error: %s", e)
+                    with send_lock:
+                        wire.send(conn, MSG_REPLY_ERR, msg_id,
+                                  {"error": f"{type(e).__name__}: {e}"})
+        except (wire.WireError, OSError):
+            pass  # client went away; its shard traffic simply stops
+        finally:
+            conn.close()
+
+    # ----------------------------- client side ----------------------- #
+    def _peer(self, rank: int) -> _Peer:
+        with self._peers_lock:
+            peer = self._peers.get(rank)
+            if peer is None:
+                if self._rendezvous is None:
+                    raise PSError("no rendezvous configured for remote ranks")
+                addr = self._rendezvous.lookup(
+                    rank, config.get_flag("ps_connect_timeout"))
+                peer = _Peer(rank, addr,
+                             config.get_flag("ps_connect_timeout"),
+                             config.get_flag("ps_timeout"))
+                self._peers[rank] = peer
+            return peer
+
+    def request(self, rank: int, msg_type: int, meta: Dict,
+                arrays: Sequence[np.ndarray] = ()) -> cf.Future:
+        """Uncoordinated request to ``rank``; local rank short-circuits the
+        socket but keeps async dispatch order via the local executor."""
+        if rank == self.rank:
+            fut: cf.Future = cf.Future()
+
+            def _run():
+                try:
+                    handler = self._wait_handler(meta["table"])
+                    fut.set_result(handler(msg_type, meta, arrays))
+                except Exception as e:
+                    fut.set_exception(e)
+
+            self._local_exec.submit(_run)
+            return fut
+        return self._peer(rank).request(msg_type, meta, arrays)
+
+    def ping(self, rank: int, timeout: Optional[float] = None) -> bool:
+        if rank == self.rank:
+            return True
+        try:
+            self._peer(rank).request(MSG_PING, {}, ()).result(
+                timeout or config.get_flag("ps_timeout"))
+            return True
+        except (PSError, cf.TimeoutError):
+            return False
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        # drop accepted connections too, so an in-process "killed" service
+        # actually goes silent (a killed OS process gets this for free)
+        with self._conns_lock:
+            for conn in self._conns:
+                try:
+                    conn.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                conn.close()
+            self._conns.clear()
+        with self._peers_lock:
+            for peer in self._peers.values():
+                peer.close()
+            self._peers.clear()
+        self._local_exec.shutdown(wait=True)
+
+
+# ---------------------------------------------------------------------- #
+# default per-process context
+# ---------------------------------------------------------------------- #
+class PSContext:
+    """Bundle of (rank, world, service) used by the async tables. Built
+    from the JAX multi-controller topology by default; tests construct
+    standalone contexts to simulate N ranks in-process."""
+
+    def __init__(self, rank: int, world: int, service: PSService):
+        self.rank, self.world, self.service = rank, world, service
+
+    def close(self) -> None:
+        self.service.close()
+
+
+_default_ctx: Optional[PSContext] = None
+_default_lock = threading.Lock()
+
+
+def default_context() -> PSContext:
+    global _default_ctx
+    with _default_lock:
+        if _default_ctx is None:
+            world = config.get_flag("ps_world")
+            rank = config.get_flag("ps_rank")
+            if world <= 0:
+                import jax
+                rank, world = jax.process_index(), jax.process_count()
+            elif rank < 0:
+                raise PSError("ps_world set but ps_rank is not")
+            rdv = None
+            if world > 1:
+                rdv_dir = config.get_flag("ps_rendezvous")
+                rdv = (FileRendezvous(rdv_dir) if rdv_dir
+                       else JaxRendezvous())
+            _default_ctx = PSContext(
+                rank, world, PSService(rank, world, rdv))
+        return _default_ctx
+
+
+def reset_default_context() -> None:
+    global _default_ctx
+    with _default_lock:
+        if _default_ctx is not None:
+            _default_ctx.close()
+            _default_ctx = None

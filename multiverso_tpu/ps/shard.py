@@ -1,0 +1,233 @@
+"""RowShard: the owner-side storage of an async table's row range.
+
+TPU-native equivalent of the reference ServerTable shard
+(ref: src/server.cpp:36-58 ProcessAdd/ProcessGet dispatching into the
+table's shard; src/table/matrix_table.cpp:98-141 server-side row storage +
+Updater::Update over the received rows). The shard lives as a device array
+on the owner process's local accelerator; Adds run the table's updater as a
+jitted, donated program (gather touched rows -> updater -> scatter), so the
+optimizer math happens on the TPU even though requests arrive over TCP.
+
+Shape discipline: row batches are bucketed to the next power of two and
+padded with a scratch row (same trick as the sync MatrixTable,
+tables/matrix_table.py) so there is one compiled program per bucket size.
+
+Thread-safety: requests arrive on per-connection service threads; a lock
+serializes state transitions (JAX arrays are immutable, so readers always
+see a consistent snapshot; the lock orders the donated updates).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from multiverso_tpu.ps import service as svc
+from multiverso_tpu.updaters import AddOption, Updater
+
+
+def _bucket(k: int, cap: int) -> int:
+    b = 8
+    while b < k:
+        b *= 2
+    return min(b, cap)
+
+
+class RowShard:
+    """Rows ``[lo, hi)`` of a logical ``(num_row, num_col)`` table."""
+
+    def __init__(self, lo: int, hi: int, num_col: int, dtype,
+                 updater: Updater, name: str,
+                 init: Optional[np.ndarray] = None,
+                 seed: Optional[int] = None, init_scale: float = 0.0):
+        self.lo, self.hi = int(lo), int(hi)
+        self.n = self.hi - self.lo
+        self.num_col = int(num_col)
+        self.name = name
+        self.dtype = jnp.dtype(dtype)
+        self.updater = updater
+        self._padded = (self.n + 1, self.num_col)   # +1 scratch row
+        host = np.zeros(self._padded, self.dtype)
+        if init is not None:
+            host[: self.n] = np.asarray(init, self.dtype)
+        elif seed is not None and init_scale != 0.0:
+            # random init of exactly this shard's rows, seeded by (seed, lo)
+            # so the global init is deterministic for a given partition
+            # (ref src/table/matrix_table.cpp:372-384 server-side init)
+            rng = np.random.default_rng([seed, self.lo])
+            host[: self.n] = rng.uniform(
+                -init_scale, init_scale, (self.n, self.num_col)
+            ).astype(self.dtype)
+        self._data = jnp.asarray(host)
+        self._ustate = updater.init_state(self._padded, self.dtype)
+        self._lock = threading.Lock()
+        self._jit: Dict[Any, Any] = {}
+
+    # ------------------------------------------------------------------ #
+    @property
+    def scratch(self) -> int:
+        return self.n
+
+    def _state_row_axis(self, leaf) -> int:
+        """Axis of ``leaf`` matching the table row axis; -1 = row-free leaf
+        (-1, not None: None is not a pytree leaf, so it would corrupt the
+        row_axes tree structure)."""
+        nd, pd = np.ndim(leaf), len(self._padded)
+        if nd >= pd and tuple(np.shape(leaf)[nd - pd:]) == self._padded:
+            return nd - pd
+        return -1
+
+    def _row_update_fn(self, bucket: int):
+        key = ("row_update", bucket)
+        fn = self._jit.get(key)
+        if fn is not None:
+            return fn
+        updater = self.updater
+
+        def _update(data, ustate, ids, vals, opt):
+            row_axes = jax.tree.map(self._state_row_axis, ustate)
+            rows = jnp.take(data, ids, axis=0)
+
+            def gather(leaf, axis):
+                return jnp.take(leaf, ids, axis=axis) if axis >= 0 else leaf
+
+            gstate = jax.tree.map(gather, ustate, row_axes)
+            new_rows, new_gstate = updater.apply(rows, gstate, vals, opt)
+            data = data.at[ids].set(new_rows)
+
+            def scatter(leaf, new_leaf, axis):
+                if axis < 0:
+                    return new_leaf
+                idx = (slice(None),) * axis + (ids,)
+                return leaf.at[idx].set(new_leaf)
+
+            ustate = jax.tree.map(scatter, ustate, new_gstate, row_axes)
+            return data, ustate
+
+        fn = jax.jit(_update, donate_argnums=(0, 1))
+        self._jit[key] = fn
+        return fn
+
+    def _full_update_fn(self):
+        fn = self._jit.get("full")
+        if fn is None:
+            updater = self.updater
+
+            def _update(data, ustate, delta, opt):
+                return updater.apply(data, ustate, delta, opt)
+
+            fn = self._jit["full"] = jax.jit(_update, donate_argnums=(0, 1))
+        return fn
+
+    def _get_fn(self, bucket: int):
+        key = ("get", bucket)
+        fn = self._jit.get(key)
+        if fn is None:
+            fn = self._jit[key] = jax.jit(
+                lambda data, ids: jnp.take(data, ids, axis=0))
+        return fn
+
+    def _localize(self, ids: np.ndarray) -> Tuple[np.ndarray, int]:
+        """Global ids -> bucket-padded local ids (+ true count)."""
+        local = np.asarray(ids, np.int64) - self.lo
+        if local.size == 0 or np.any((local < 0) | (local >= self.n)):
+            raise IndexError(
+                f"row ids outside shard [{self.lo}, {self.hi}) of "
+                f"{self.name}")
+        k = local.size
+        b = _bucket(k, self.n + 1)
+        if b > k:
+            local = np.concatenate(
+                [local, np.full(b - k, self.scratch, np.int64)])
+        return local.astype(np.int32), k
+
+    # ------------------------------------------------------------------ #
+    # request handler (runs on service connection threads)
+    # ------------------------------------------------------------------ #
+    def handle(self, msg_type: int, meta: Dict,
+               arrays: Sequence[np.ndarray]
+               ) -> Tuple[Dict, List[np.ndarray]]:
+        if msg_type == svc.MSG_ADD_ROWS:
+            opt = AddOption(**meta.get("opt", {}))
+            ids, k = self._localize(arrays[0])
+            vals = np.asarray(arrays[1], self.dtype)
+            if vals.shape[0] < ids.size:   # zero-pad to the bucket
+                vals = np.concatenate(
+                    [vals, np.zeros((ids.size - vals.shape[0], self.num_col),
+                                    self.dtype)])
+            with self._lock:
+                self._data, self._ustate = self._row_update_fn(ids.size)(
+                    self._data, self._ustate, ids, vals, opt)
+            return {}, []
+        if msg_type == svc.MSG_GET_ROWS:
+            ids, k = self._localize(arrays[0])
+            # gather + host transfer stay under the lock: adds donate (and
+            # delete) the data buffer, so a get computing on a snapshot
+            # outside the lock would race a concurrent add into "Array has
+            # been deleted" on TPU. Per-shard serialization is the
+            # reference's semantics anyway (one Server actor thread).
+            with self._lock:
+                rows = np.asarray(
+                    self._get_fn(ids.size)(self._data, ids))[:k]
+            return {}, [rows]
+        if msg_type == svc.MSG_SET_ROWS:
+            ids, k = self._localize(arrays[0])
+            vals = np.asarray(arrays[1], self.dtype)[:k]
+            with self._lock:
+                self._data = self._data.at[ids[:k]].set(jnp.asarray(vals))
+            return {}, []
+        if msg_type == svc.MSG_ADD_FULL:
+            opt = AddOption(**meta.get("opt", {}))
+            delta = np.asarray(arrays[0], self.dtype).reshape(
+                self.n, self.num_col)
+            padded = np.zeros(self._padded, self.dtype)
+            padded[: self.n] = delta
+            with self._lock:
+                self._data, self._ustate = self._full_update_fn()(
+                    self._data, self._ustate, jnp.asarray(padded),
+                    opt)
+            return {}, []
+        if msg_type == svc.MSG_GET_FULL:
+            with self._lock:   # same donation race as MSG_GET_ROWS
+                full = np.asarray(self._data)
+            return {}, [full[: self.n]]
+        raise svc.PSError(f"unknown message type {msg_type}")
+
+
+class KVShard:
+    """Hash-sharded key-value shard (ref include/multiverso/table/
+    kv_table.h:44-54 — ``key % num_servers`` routing; the server-side map
+    holds the global aggregate for its keys). Host-side dict: scalar KV
+    traffic has no business on the MXU."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._store: Dict[int, float] = {}
+        self._lock = threading.Lock()
+
+    def handle(self, msg_type: int, meta: Dict,
+               arrays: Sequence[np.ndarray]
+               ) -> Tuple[Dict, List[np.ndarray]]:
+        if msg_type == svc.MSG_KV_ADD:
+            keys, vals = arrays
+            with self._lock:
+                for k, v in zip(keys.tolist(), vals.tolist()):
+                    self._store[int(k)] = self._store.get(int(k), 0) + v
+            return {}, []
+        if msg_type == svc.MSG_KV_GET:
+            with self._lock:
+                if meta.get("all"):
+                    items = sorted(self._store.items())
+                    keys = np.array([k for k, _ in items], np.int64)
+                    vals = np.array([v for _, v in items], np.float64)
+                else:
+                    keys = np.asarray(arrays[0], np.int64)
+                    vals = np.array(
+                        [self._store.get(int(k), 0) for k in keys],
+                        np.float64)
+            return {}, [keys, vals]
+        raise svc.PSError(f"unknown message type {msg_type}")

@@ -1,0 +1,428 @@
+"""Async tables: the uncoordinated cross-process Add/Get client plane.
+
+TPU-native equivalent of the reference WorkerTable family in *async* mode
+(ref: src/worker.cpp:30-76 — Partition a request into per-server messages,
+track expected replies; src/table/matrix_table.cpp:266-313 — route row ids
+by ``row_id / rows_per_server``; include/multiverso/table_interface.h:24-46
+— Get/Add/GetAsync/AddAsync/Wait). Every process owns a contiguous row
+block of each table (its :class:`~multiverso_tpu.ps.shard.RowShard`, on its
+local device); a client partitions each op by owner rank and sends
+uncoordinated requests — workers at different rates, with different row
+sets, never waiting on each other. This is the plane the sync tables
+(lockstep XLA collectives) cannot provide; see multiverso_tpu/ps/__init__.
+
+msg-id bookkeeping matches the sync tables: every async op returns a msg
+id; ``wait(id)`` blocks on the underlying request futures (the reference's
+Waiter, src/table.cpp:27-97).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from multiverso_tpu import updaters as updaters_lib
+from multiverso_tpu.ps import service as svc
+from multiverso_tpu.ps.shard import KVShard, RowShard
+from multiverso_tpu.updaters import AddOption
+from multiverso_tpu.utils import config, log
+from multiverso_tpu.utils.dashboard import monitor
+
+
+def _resolve_updater(updater, num_workers: int, dtype):
+    if updater is None:
+        updater = config.get_flag("updater_type")
+    if isinstance(updater, str):
+        updater = updaters_lib.get_updater(updater, num_workers=num_workers,
+                                           dtype=dtype)
+    return updater
+
+
+def _maybe_register_in_zoo(table) -> Optional[int]:
+    """Async tables join the Zoo registry (checkpoint walk, C ABI) when the
+    runtime is up; standalone PSContext tests run without a Zoo."""
+    from multiverso_tpu.zoo import Zoo
+    zoo = Zoo.get()
+    if zoo.started:
+        return zoo.register_table(table)
+    return None
+
+
+class _AsyncBase:
+    """msg-id -> futures bookkeeping shared by the async tables."""
+
+    def __init__(self, ctx: Optional[svc.PSContext], name: str):
+        self.ctx = ctx if ctx is not None else svc.default_context()
+        self.name = name
+        self._pending: Dict[int, Tuple[List[cf.Future], Any]] = {}
+        self._next_msg_id = 0
+        self._lock = threading.Lock()
+
+    def _track(self, futures: List[cf.Future], finalize=None) -> int:
+        with self._lock:
+            # sweep fire-and-forget adds whose futures are all done; their
+            # failures are LOGGED, not raised — raising here would poison
+            # every later op on the table with a dead peer's stale error,
+            # breaking the "live-shard traffic unaffected" contract (a
+            # caller who cares about an add's outcome calls wait())
+            done = [mid for mid, (futs, fin) in self._pending.items()
+                    if fin is None and all(f.done() for f in futs)]
+            for mid in done:
+                futs, _ = self._pending.pop(mid)
+                for f in futs:
+                    exc = f.exception()
+                    if exc is not None:
+                        log.error("table[%s]: fire-and-forget op %d "
+                                  "failed: %s", self.name, mid, exc)
+            msg_id = self._next_msg_id
+            self._next_msg_id += 1
+            self._pending[msg_id] = (futures, finalize)
+            return msg_id
+
+    def wait(self, msg_id: int) -> Any:
+        """Block until the op behind ``msg_id`` completes (ref Wait). For
+        gets, returns the assembled host array; for adds, None. Raises
+        :class:`~multiverso_tpu.ps.service.PSPeerError` if an owning rank
+        died — other tables/ops remain usable."""
+        with self._lock:
+            entry = self._pending.pop(msg_id, None)
+        if entry is None:
+            return None
+        futures, finalize = entry
+        timeout = config.get_flag("ps_timeout")
+        results = [f.result(timeout=timeout) for f in futures]
+        return finalize(results) if finalize is not None else None
+
+    def flush(self) -> None:
+        """Wait for every outstanding op on this table (this worker only —
+        NOT a barrier; peers are unaffected)."""
+        with self._lock:
+            ids = list(self._pending)
+        for mid in ids:
+            self.wait(mid)
+
+
+class AsyncMatrixTable(_AsyncBase):
+    """Row-partitioned 2-D async table (ref MatrixTable in async mode)."""
+
+    def __init__(self, num_row: int, num_col: int, dtype=np.float32,
+                 updater: Union[str, updaters_lib.Updater, None] = None,
+                 name: str = "async_matrix",
+                 init: Optional[np.ndarray] = None,
+                 seed: Optional[int] = None, init_scale: float = 0.0,
+                 ctx: Optional[svc.PSContext] = None):
+        super().__init__(ctx, name)
+        self.num_row, self.num_col = int(num_row), int(num_col)
+        self.shape = (self.num_row, self.num_col)
+        self.dtype = np.dtype(dtype)
+        world = self.ctx.world
+        self._rows_per = -(-self.num_row // world)   # ceil
+        self.updater = _resolve_updater(updater, world, self.dtype)
+        lo = min(self.ctx.rank * self._rows_per, self.num_row)
+        hi = min(lo + self._rows_per, self.num_row)
+        self.lo, self.hi = lo, hi
+        if hi > lo:
+            shard_init = (np.asarray(init, self.dtype)[lo:hi]
+                          if init is not None else None)
+            self._shard = RowShard(lo, hi, self.num_col, self.dtype,
+                                   self.updater, name, init=shard_init,
+                                   seed=seed, init_scale=init_scale)
+            self.ctx.service.register_handler(name, self._shard.handle)
+        else:
+            self._shard = None
+        # identical on every rank: (rank, lo, hi) of each non-empty shard
+        self._ranges = [(r, min(r * self._rows_per, self.num_row),
+                         min((r + 1) * self._rows_per, self.num_row))
+                        for r in range(world)]
+        self._ranges = [(r, a, b) for r, a, b in self._ranges if b > a]
+        self.table_id = _maybe_register_in_zoo(self)
+
+    # ------------------------------------------------------------------ #
+    def raw(self):
+        """Local shard's device array (diagnostics / Zoo barrier fencing)."""
+        return self._shard._data if self._shard is not None else None
+
+    def _prep(self, row_ids, values: Optional[np.ndarray] = None):
+        raw = np.asarray(row_ids)
+        if raw.size == 0:
+            raise ValueError("empty row_ids")
+        if not np.issubdtype(raw.dtype, np.integer):
+            raise TypeError(f"row_ids must be integers, got {raw.dtype}")
+        ids = raw.astype(np.int64).reshape(-1)
+        if np.any((ids < 0) | (ids >= self.num_row)):
+            raise IndexError(f"row id out of range [0, {self.num_row})")
+        uids, inv = np.unique(ids, return_inverse=True)
+        if values is not None:
+            vals = np.asarray(values, self.dtype).reshape(ids.size,
+                                                          self.num_col)
+            acc = np.zeros((uids.size, self.num_col), np.float64)
+            np.add.at(acc, inv, vals.astype(np.float64))
+            return uids, acc.astype(self.dtype), inv
+        return uids, None, inv
+
+    def _by_owner(self, uids: np.ndarray):
+        owners = uids // self._rows_per
+        for r in np.unique(owners):
+            yield int(r), owners == r
+
+    # ------------------------------------------------------------------ #
+    # row ops (ref matrix_table.h:26-75)
+    # ------------------------------------------------------------------ #
+    def add_rows_async(self, row_ids, values,
+                       opt: Optional[AddOption] = None) -> int:
+        opt = opt or AddOption(worker_id=self.ctx.rank)
+        with monitor(f"table[{self.name}].add_rows"):
+            uids, vals, _ = self._prep(row_ids, values)
+            meta = {"table": self.name, "opt": opt._asdict()}
+            futs = [self.ctx.service.request(
+                        r, svc.MSG_ADD_ROWS, meta, [uids[m], vals[m]])
+                    for r, m in self._by_owner(uids)]
+        return self._track(futs)
+
+    def add_rows(self, row_ids, values,
+                 opt: Optional[AddOption] = None) -> None:
+        self.wait(self.add_rows_async(row_ids, values, opt))
+
+    def get_rows_async(self, row_ids) -> int:
+        with monitor(f"table[{self.name}].get_rows"):
+            uids, _, inv = self._prep(row_ids)
+            parts = list(self._by_owner(uids))
+            meta = {"table": self.name}
+            futs = [self.ctx.service.request(r, svc.MSG_GET_ROWS, meta,
+                                             [uids[m]])
+                    for r, m in parts]
+
+            def _assemble(results):
+                out = np.empty((uids.size, self.num_col), self.dtype)
+                for (r, m), (_, arrays) in zip(parts, results):
+                    out[m] = arrays[0]
+                return out[inv]   # re-expand duplicates, original order
+
+        return self._track(futs, _assemble)
+
+    def get_rows(self, row_ids, out: Optional[np.ndarray] = None
+                 ) -> np.ndarray:
+        host = self.wait(self.get_rows_async(row_ids))
+        if out is not None:
+            np.copyto(out.reshape(host.shape), host)
+            return out
+        return host
+
+    def get_row(self, row_id: int) -> np.ndarray:
+        return self.get_rows([row_id])[0]
+
+    def add_row(self, row_id: int, values,
+                opt: Optional[AddOption] = None) -> None:
+        self.add_rows([row_id], np.asarray(values).reshape(1, -1), opt)
+
+    def set_rows(self, row_ids, values) -> None:
+        """Overwrite rows (load/master-init plumbing; no updater).
+        Duplicate ids are ill-defined for an overwrite, so ids must be
+        unique (checkpoint load passes ranges)."""
+        ids = np.asarray(row_ids, np.int64).reshape(-1)
+        vals = np.asarray(values, self.dtype).reshape(-1, self.num_col)
+        if vals.shape[0] != ids.size:
+            raise ValueError("set_rows: one value row per id required")
+        order = np.argsort(ids, kind="stable")
+        uids, vals = ids[order], vals[order]   # sorted, vals kept aligned
+        if uids.size > 1 and np.any(uids[1:] == uids[:-1]):
+            raise ValueError("set_rows requires unique row ids")
+        if np.any((uids < 0) | (uids >= self.num_row)):
+            raise IndexError(f"row id out of range [0, {self.num_row})")
+        meta = {"table": self.name}
+        futs = [self.ctx.service.request(r, svc.MSG_SET_ROWS, meta,
+                                         [uids[m], vals[m]])
+                for r, m in self._by_owner(uids)]
+        self.wait(self._track(futs, lambda rs: None))
+
+    # ------------------------------------------------------------------ #
+    # whole-table ops
+    # ------------------------------------------------------------------ #
+    def add_async(self, delta, opt: Optional[AddOption] = None) -> int:
+        opt = opt or AddOption(worker_id=self.ctx.rank)
+        with monitor(f"table[{self.name}].add"):
+            delta = np.asarray(delta, self.dtype).reshape(self.shape)
+            meta = {"table": self.name, "opt": opt._asdict()}
+            futs = [self.ctx.service.request(r, svc.MSG_ADD_FULL, meta,
+                                             [delta[a:b]])
+                    for r, a, b in self._ranges]
+        return self._track(futs)
+
+    def add(self, delta, opt: Optional[AddOption] = None) -> None:
+        self.wait(self.add_async(delta, opt))
+
+    def get_async(self) -> int:
+        with monitor(f"table[{self.name}].get"):
+            meta = {"table": self.name}
+            ranges = list(self._ranges)
+            futs = [self.ctx.service.request(r, svc.MSG_GET_FULL, meta)
+                    for r, _, _ in ranges]
+
+            def _assemble(results):
+                out = np.empty(self.shape, self.dtype)
+                for (r, a, b), (_, arrays) in zip(ranges, results):
+                    out[a:b] = arrays[0]
+                return out
+
+        return self._track(futs, _assemble)
+
+    def get(self, out: Optional[np.ndarray] = None) -> np.ndarray:
+        host = self.wait(self.get_async())
+        if out is not None:
+            np.copyto(out.reshape(self.shape), host)
+            return out
+        return host
+
+    # ------------------------------------------------------------------ #
+    # checkpoint (whole-table via the service; every rank may call, only
+    # rank 0's stream is real under checkpoint.save)
+    # ------------------------------------------------------------------ #
+    def store(self, stream) -> None:
+        np.save(stream, self.get(), allow_pickle=False)
+
+    def load(self, stream) -> None:
+        data = np.load(stream)
+        if data.shape != self.shape:
+            raise ValueError(f"checkpoint shape {data.shape} != {self.shape}")
+        for r, a, b in self._ranges:
+            self.set_rows(np.arange(a, b), data[a:b])
+
+
+class AsyncArrayTable(_AsyncBase):
+    """1-D async table: contiguous-range sharding of a vector
+    (ref src/table/array_table.cpp:11-21 worker offsets). Implemented as a
+    single-column matrix — ranges ARE row blocks."""
+
+    def __init__(self, size: int, dtype=np.float32,
+                 updater=None, name: str = "async_array",
+                 init: Optional[np.ndarray] = None,
+                 ctx: Optional[svc.PSContext] = None):
+        super().__init__(ctx, name)
+        self.size = int(size)
+        self.dtype = np.dtype(dtype)
+        init2d = (np.asarray(init, self.dtype).reshape(self.size, 1)
+                  if init is not None else None)
+        self._m = AsyncMatrixTable(self.size, 1, dtype=dtype,
+                                   updater=updater, name=name,
+                                   init=init2d, ctx=self.ctx)
+        self.table_id = self._m.table_id
+
+    def raw(self):
+        return self._m.raw()
+
+    def add_async(self, values, opt: Optional[AddOption] = None) -> int:
+        return self._m.add_async(
+            np.asarray(values, self.dtype).reshape(self.size, 1), opt)
+
+    def add(self, values, opt: Optional[AddOption] = None) -> None:
+        self._m.wait(self.add_async(values, opt))
+
+    def get_async(self) -> int:
+        return self._m.get_async()
+
+    def get(self, out: Optional[np.ndarray] = None) -> np.ndarray:
+        host = self._m.get().reshape(self.size)
+        if out is not None:
+            np.copyto(out.reshape(self.size), host)
+            return out
+        return host
+
+    def wait(self, msg_id: int) -> Any:
+        res = self._m.wait(msg_id)
+        return res.reshape(self.size) if isinstance(res, np.ndarray) else res
+
+    def flush(self) -> None:
+        self._m.flush()
+
+    def store(self, stream) -> None:
+        np.save(stream, self.get(), allow_pickle=False)
+
+    def load(self, stream) -> None:
+        data = np.load(stream).reshape(self.size, 1)
+        for r, a, b in self._m._ranges:
+            self._m.set_rows(np.arange(a, b), data[a:b])
+
+
+class AsyncKVTable(_AsyncBase):
+    """Hash-sharded async KV table (ref include/multiverso/table/
+    kv_table.h:44-54 ``key % num_servers``). ``get`` reads the
+    server-aggregated value directly — uncoordinated, exactly the
+    reference's Get semantics (no collective involved)."""
+
+    def __init__(self, name: str = "async_kv",
+                 ctx: Optional[svc.PSContext] = None):
+        super().__init__(ctx, name)
+        self._shard = KVShard(name)
+        self.ctx.service.register_handler(name, self._shard.handle)
+        self.table_id = _maybe_register_in_zoo(self)
+
+    def _owner(self, key: int) -> int:
+        return int(key) % self.ctx.world
+
+    def add(self, keys: Iterable[int], values: Iterable) -> None:
+        keys = np.asarray(list(keys), np.int64)
+        vals = np.asarray(list(values), np.float64)
+        meta = {"table": self.name}
+        futs = []
+        for r in range(self.ctx.world):
+            m = (keys % self.ctx.world) == r
+            if m.any():
+                futs.append(self.ctx.service.request(
+                    r, svc.MSG_KV_ADD, meta, [keys[m], vals[m]]))
+        self.wait(self._track(futs, lambda rs: None))
+
+    def get(self, keys: Optional[Iterable[int]] = None,
+            global_: bool = True) -> Dict[int, float]:
+        """Aggregated read off the hash shards. ``global_`` is accepted for
+        sync-KVTable API compatibility and ignored: an async Get is always
+        the server-aggregated value (ref kv_table.h:44-99)."""
+        meta = {"table": self.name}
+        out: Dict[int, float] = {}
+        if keys is None:
+            futs = [self.ctx.service.request(
+                        r, svc.MSG_KV_GET, dict(meta, all=True), [])
+                    for r in range(self.ctx.world)]
+        else:
+            karr = np.asarray(list(keys), np.int64)
+            uk = np.unique(karr)   # dedupe: a key lives on exactly ONE shard
+            futs = []
+            for r in range(self.ctx.world):
+                m = (uk % self.ctx.world) == r
+                if m.any():
+                    futs.append(self.ctx.service.request(
+                        r, svc.MSG_KV_GET, meta, [uk[m]]))
+        timeout = config.get_flag("ps_timeout")
+        for f in futs:
+            _, arrays = f.result(timeout=timeout)
+            for k, v in zip(arrays[0].tolist(), arrays[1].tolist()):
+                out[int(k)] = v   # assignment: shards are disjoint by hash
+        if keys is not None:
+            return {int(k): out.get(int(k), 0) for k in karr}
+        return out
+
+    def __getitem__(self, key: int):
+        return self.get([key])[int(key)]
+
+    def store(self, stream) -> None:
+        items = sorted(self.get().items())
+        np.save(stream, np.array([k for k, _ in items], np.int64),
+                allow_pickle=False)
+        np.save(stream, np.array([v for _, v in items], np.float64),
+                allow_pickle=False)
+
+    def load(self, stream) -> None:
+        keys = np.load(stream)
+        vals = np.load(stream)
+        with self._shard._lock:
+            self._shard._store = {}
+        # re-add only this rank's hash shard so the global view is restored
+        # exactly once
+        m = (keys % self.ctx.world) == self.ctx.rank
+        if m.any():
+            meta = {"table": self.name}
+            self.wait(self._track([self.ctx.service.request(
+                self.ctx.rank, svc.MSG_KV_ADD, meta,
+                [keys[m], vals[m]])], lambda rs: None))

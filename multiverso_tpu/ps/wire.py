@@ -1,0 +1,118 @@
+"""PS wire format: framed messages of JSON meta + raw numpy blobs.
+
+TPU-native equivalent of the reference's message framing
+(ref: include/multiverso/message.h:26-69 — 8-int header + vector<Blob>;
+serialized into one buffer per send, mpi_net.h:195-216). Here the header is
+a fixed struct and each blob is a length-prefixed numpy array (dtype/shape
+header + raw bytes, no pickling), so a message deserializes with zero
+copies beyond the socket reads. The framing is deliberately simple enough
+that a native (C++) transport can speak it; the Python implementation
+releases the GIL inside ``recv_into``/``sendall`` so handler threads and
+device dispatch overlap.
+
+Frame layout (little-endian)::
+
+    magic   4s   b"MVPS"
+    type    u16  message type (service.py MSG_*)
+    flags   u16  reserved
+    msg_id  i64  request/reply correlation id
+    metalen u32  length of the UTF-8 JSON meta dict
+    narr    u32  number of numpy blobs
+    meta    bytes[metalen]
+    narr x: dlen u8, dtype bytes[dlen], ndim u8, shape i64[ndim], raw bytes
+
+Safety: reads are bounded (MAX_META, MAX_BLOB) so a garbage or malicious
+peer can't OOM the process with one header.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+MAGIC = b"MVPS"
+_HEADER = struct.Struct("<4sHHqII")
+MAX_META = 64 << 20
+MAX_BLOB = 4 << 30
+
+
+class WireError(RuntimeError):
+    pass
+
+
+def _recv_exact(sock: socket.socket, n: int, *, sof: bool = False
+                ) -> memoryview:
+    """Read exactly ``n`` bytes. ``sof`` (start-of-frame): a timeout with
+    ZERO bytes consumed is an idle socket and re-raises as TimeoutError so
+    callers may keep the connection; any timeout after bytes were consumed
+    desyncs the framing and is fatal (WireError)."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        try:
+            r = sock.recv_into(view[got:], n - got)
+        except TimeoutError:
+            if sof and got == 0:
+                raise
+            raise WireError("timeout mid-message (framing lost)") from None
+        if r == 0:
+            raise WireError("peer closed connection mid-message")
+        got += r
+    return memoryview(buf)
+
+
+def encode(msg_type: int, msg_id: int, meta: Dict,
+           arrays: Sequence[np.ndarray] = ()) -> bytes:
+    meta_b = json.dumps(meta).encode()
+    parts: List[bytes] = [
+        _HEADER.pack(MAGIC, msg_type, 0, msg_id, len(meta_b), len(arrays)),
+        meta_b,
+    ]
+    for a in arrays:
+        # asarray, not ascontiguousarray: the latter promotes 0-d to 1-d,
+        # and tobytes() already linearizes non-contiguous layouts
+        a = np.asarray(a)
+        dt = a.dtype.str.encode()
+        parts.append(struct.pack("<B", len(dt)))
+        parts.append(dt)
+        parts.append(struct.pack("<B", a.ndim))
+        parts.append(struct.pack(f"<{a.ndim}q", *a.shape))
+        parts.append(a.tobytes())
+    return b"".join(parts)
+
+
+def send(sock: socket.socket, msg_type: int, msg_id: int, meta: Dict,
+         arrays: Sequence[np.ndarray] = ()) -> None:
+    sock.sendall(encode(msg_type, msg_id, meta, arrays))
+
+
+def recv(sock: socket.socket) -> Tuple[int, int, Dict, List[np.ndarray]]:
+    """Read one message; returns (msg_type, msg_id, meta, arrays).
+    Raises TimeoutError (connection still usable) only when the socket was
+    idle — i.e. the timeout hit before any byte of a frame arrived."""
+    head = _recv_exact(sock, _HEADER.size, sof=True)
+    magic, msg_type, _flags, msg_id, metalen, narr = _HEADER.unpack(head)
+    if magic != MAGIC:
+        raise WireError(f"bad magic {bytes(magic)!r}")
+    if metalen > MAX_META:
+        raise WireError(f"meta too large ({metalen} bytes)")
+    meta = json.loads(bytes(_recv_exact(sock, metalen)) or b"{}")
+    arrays: List[np.ndarray] = []
+    for _ in range(narr):
+        (dlen,) = struct.unpack("<B", _recv_exact(sock, 1))
+        dtype = np.dtype(bytes(_recv_exact(sock, dlen)).decode())
+        (ndim,) = struct.unpack("<B", _recv_exact(sock, 1))
+        shape = struct.unpack(f"<{ndim}q",
+                              _recv_exact(sock, 8 * ndim)) if ndim else ()
+        nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize if ndim \
+            else dtype.itemsize
+        if nbytes > MAX_BLOB:
+            raise WireError(f"blob too large ({nbytes} bytes)")
+        raw = _recv_exact(sock, nbytes)
+        arrays.append(np.frombuffer(raw, dtype=dtype).reshape(shape).copy())
+    return msg_type, msg_id, meta, arrays

@@ -182,6 +182,41 @@ class MatrixTable(Table):
                     [vals, np.zeros((pad, self.num_col), self.dtype)])
         return ids, vals, k, inv
 
+    def _union_across_processes(self, ids: np.ndarray, vals: np.ndarray
+                                ) -> Tuple[np.ndarray, np.ndarray]:
+        """Merge per-process (ids, vals) into the deduped union with summed
+        values, identically on every process. Ids arrive bucket-padded with
+        the scratch row; sizes differ per process, so the allgather pads to
+        the global max bucket with scratch/zero first."""
+        from jax.experimental import multihost_utils
+        n = np.array([ids.size], np.int64)
+        max_n = int(np.max(multihost_utils.process_allgather(n, tiled=False)))
+        if ids.size < max_n:
+            pad = max_n - ids.size
+            ids = np.concatenate(
+                [ids, np.full(pad, self._scratch_row, np.int32)])
+            vals = np.concatenate(
+                [vals, np.zeros((pad, self.num_col), self.dtype)])
+        gids = np.asarray(multihost_utils.process_allgather(ids, tiled=False))
+        gvals = np.asarray(multihost_utils.process_allgather(vals,
+                                                             tiled=False))
+        flat_ids = gids.reshape(-1)
+        flat_vals = gvals.reshape(-1, self.num_col)
+        keep = flat_ids != self._scratch_row
+        uids, inv = np.unique(flat_ids[keep], return_inverse=True)
+        acc = np.zeros((uids.size, self.num_col), np.float64)
+        np.add.at(acc, inv, flat_vals[keep].astype(np.float64))
+        ids = uids.astype(np.int32)
+        vals = acc.astype(self.dtype)
+        bucket = _bucket_size(ids.size, self._padded_rows)
+        if bucket > ids.size:
+            pad = bucket - ids.size
+            ids = np.concatenate(
+                [ids, np.full(pad, self._scratch_row, np.int32)])
+            vals = np.concatenate(
+                [vals, np.zeros((pad, self.num_col), self.dtype)])
+        return ids, vals
+
     # ------------------------------------------------------------------ #
     # public row ops (ref matrix_table.h:26-75 overload family)
     # ------------------------------------------------------------------ #
@@ -191,21 +226,12 @@ class MatrixTable(Table):
         with monitor(f"table[{self.name}].add_rows"), self._dispatch_lock:
             ids, vals, _, _ = self._prep_ids(row_ids, values)
             if self._zoo.size() > 1:
-                # collective row add: every process must push the same id
-                # set; vals are summed across processes (reference: each
-                # worker's Add lands on the server shard).
-                from jax.experimental import multihost_utils
-                gids = np.asarray(multihost_utils.process_allgather(
-                    ids, tiled=False))
-                if not np.all(gids == gids[0]):
-                    raise NotImplementedError(
-                        "multi-process add_rows requires identical row-id "
-                        "sets on every process (collective semantics); for "
-                        "per-worker row traffic use process-local tables + "
-                        "aggregate, or the fused plane")
-                gvals = np.asarray(multihost_utils.process_allgather(
-                    vals, tiled=False))
-                vals = gvals.sum(axis=0).astype(self.dtype)
+                # collective row add; per-process id sets may DIFFER (the
+                # WordEmbedding traffic pattern, ref communicator.cpp:
+                # 104-142): processes agree on the union of their ids and
+                # sum the contributions. Still lockstep (every process must
+                # call) — the uncoordinated path is multiverso_tpu.ps.
+                ids, vals = self._union_across_processes(ids, vals)
             fn = self._row_update_fn(ids.size)
             self._data, self._ustate, token = fn(
                 self._data, self._ustate,

@@ -81,12 +81,18 @@ class Zoo:
         return jax.sharding.Mesh(devices, (axis,))
 
     def stop(self, finalize: bool = True) -> None:
-        """ref Zoo::Stop (src/zoo.cpp:103): drain, display dashboard, stop."""
+        """ref Zoo::Stop (src/zoo.cpp:103): drain, display dashboard, stop
+        (including the async-PS service, ref StopPS stopping the actors)."""
         if not self._started:
             return
         self.barrier()
         if config.get_flag("dashboard"):
             Dashboard.display(log.info)
+        try:
+            from multiverso_tpu.ps import service as _ps_service
+            _ps_service.reset_default_context()
+        except ImportError:  # pragma: no cover
+            pass
         self._tables.clear()
         self._next_table_id = 0
         self._mesh = None

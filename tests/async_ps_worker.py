@@ -1,0 +1,133 @@
+"""Subprocess body for the multi-process async-PS integration tests.
+
+Unlike tests/multiprocess_worker.py (which exercises the *collective* host
+plane under jax.distributed), this runs the uncoordinated PS plane with NO
+JAX coordinator at all — rank/world come from argv and peers meet through a
+file rendezvous, proving the async plane stands alone (the reference's PS
+likewise needed only its own transport, src/zoo.cpp).
+
+Invoked as:  python async_ps_worker.py <rdv_dir> <world> <rank> <mode>
+Modes:
+  rates — every rank pushes a DIFFERENT row set at a DIFFERENT rate
+          (ref WordEmbedding traffic, communicator.cpp:104-142); asserts
+          the converged global state.
+  kill  — the last rank dies abruptly mid-run; survivors keep trading
+          rows on live shards and see a typed PSPeerError (bounded time)
+          for the dead shard.
+Prints "RESULT <json>" on success.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _sync_point(rdv_dir, world, rank, tag):
+    """Test-harness sync via files (NOT a framework barrier — the plane
+    under test has none): rank writes its marker, then polls for all."""
+    open(os.path.join(rdv_dir, f"{tag}.{rank}"), "w").close()
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if all(os.path.exists(os.path.join(rdv_dir, f"{tag}.{r}"))
+               for r in range(world)):
+            return
+        time.sleep(0.01)
+    raise TimeoutError(f"sync point {tag} timed out")
+
+
+def main():
+    rdv_dir, world, rank, mode = (sys.argv[1], int(sys.argv[2]),
+                                  int(sys.argv[3]), sys.argv[4])
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from multiverso_tpu.ps.service import (FileRendezvous, PSContext,
+                                           PSPeerError, PSService)
+    from multiverso_tpu.ps.tables import AsyncKVTable, AsyncMatrixTable
+    from multiverso_tpu.utils import config
+
+    config.set_flag("ps_timeout", 20.0)
+    config.set_flag("ps_connect_timeout", 10.0)
+    ctx = PSContext(rank, world,
+                    PSService(rank, world, FileRendezvous(rdv_dir)))
+    out = {"rank": rank}
+
+    if mode == "rates":
+        num_row = 8 * world
+        t = AsyncMatrixTable(num_row, 4, name="mp_async", ctx=ctx)
+        kv = AsyncKVTable(name="mp_kv", ctx=ctx)
+        _sync_point(rdv_dir, world, rank, "tables")
+        # rank r pushes rows {r, world + r, ..., 7*world + r} — pairwise
+        # DISJOINT sets — (r+1)*5 times at rank-dependent pace, with a mix
+        # of fire-and-forget and waited adds
+        my_rows = np.arange(8) * world + rank
+        n_pushes = (rank + 1) * 5
+        mids = []
+        for i in range(n_pushes):
+            mids.append(t.add_rows_async(
+                my_rows, np.full((8, 4), 1.0, np.float32)))
+            kv.add([rank], [1.0])
+            time.sleep(0.002 * (world - rank))
+        for m in mids:
+            t.wait(m)
+        _sync_point(rdv_dir, world, rank, "pushed")
+        got = t.get_rows(np.arange(num_row))
+        expect = np.zeros(num_row)
+        for r in range(world):
+            expect[np.arange(8) * world + r] = (r + 1) * 5
+        assert np.allclose(got, expect[:, None]), (got[:, 0], expect)
+        counts = kv.get()
+        assert counts == {r: (r + 1) * 5.0 for r in range(world)}, counts
+        out["row_sum"] = float(got.sum())
+        out["kv"] = {str(k): v for k, v in sorted(counts.items())}
+        # hold the service up until every rank has finished reading (the
+        # reference's MV_ShutDown barriers for the same reason)
+        _sync_point(rdv_dir, world, rank, "done")
+
+    elif mode == "kill":
+        num_row = 5 * world
+        t = AsyncMatrixTable(num_row, 2, name="kill_async", ctx=ctx)
+        _sync_point(rdv_dir, world, rank, "tables")
+        if rank == world - 1:
+            # die abruptly, mid-conversation (no cleanup, like a real crash)
+            os._exit(17)
+        config.set_flag("ps_timeout", 6.0)
+        config.set_flag("ps_connect_timeout", 6.0)
+        # wait until the victim is certainly gone
+        time.sleep(0.5)
+        # live shards keep working at full function
+        live_rows = [rank * 5, rank * 5 + 1, 0]
+        for _ in range(10):
+            t.add_rows(live_rows, np.ones((3, 2), np.float32))
+        got = t.get_rows([0])
+        assert got[0, 0] >= 10.0, got
+        # dead shard: typed error within the timeout bound, no hang
+        start = time.monotonic()
+        try:
+            t.get_rows([num_row - 1])
+            raise AssertionError("expected PSPeerError for dead shard")
+        except PSPeerError:
+            pass
+        elapsed = time.monotonic() - start
+        assert elapsed < 15.0, elapsed
+        out["dead_shard_error_s"] = round(elapsed, 2)
+        out["live_row0"] = float(got[0, 0])
+        # survivors sync among themselves before teardown
+        open(os.path.join(rdv_dir, f"alive.{rank}"), "w").close()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not all(
+                os.path.exists(os.path.join(rdv_dir, f"alive.{r}"))
+                for r in range(world - 1)):
+            time.sleep(0.01)
+    else:
+        raise ValueError(mode)
+
+    ctx.close()
+    print("RESULT " + json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

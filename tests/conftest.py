@@ -25,6 +25,8 @@ import pytest  # noqa: E402
 # ---------------------------------------------------------------------- #
 SLOW_MODULES = {
     "test_multiprocess",      # spawns N JAX subprocesses
+    "test_multiprocess_async",  # spawns N async-PS subprocesses
+    "test_we_async",          # WE PS-block training across 4 processes
     "test_transformer",       # full model family incl. ring/zigzag/beam
     "test_pipeline",          # GPipe + interleaved PP training runs
     "test_moe",               # expert-parallel training runs

@@ -52,6 +52,24 @@ def main():
     mt.add_rows([1, 3], np.full((2, 4), float(pid + 1), np.float32))
     out["matrix_rows"] = mt.get_rows([1, 3]).tolist()
 
+    # collective row add with DIFFERENT id sets per process (the
+    # WordEmbedding pattern): union semantics
+    mt2 = mv.MatrixTable(16, 4, name="mp_matrix_union")
+    mt2.add_rows([pid, pid + 1], np.full((2, 4), float(pid + 1), np.float32))
+    out["matrix_union"] = mt2.get_rows(list(range(nprocs + 1)))[:, 0].tolist()
+
+    # uncoordinated async plane over the jax.distributed coordinator's KV
+    # store: each rank pushes its OWN disjoint rows at its own pace
+    from multiverso_tpu.ps import AsyncMatrixTable
+    at = AsyncMatrixTable(8 * nprocs, 4, name="mp_async_jx")
+    my_rows = np.arange(8) * nprocs + pid
+    for _ in range(pid + 1):   # per-rank rate
+        at.add_rows(my_rows, np.ones((8, 4), np.float32))
+    at.flush()
+    mv.barrier()               # test determinism only: all pushes landed
+    got = at.get_rows(np.arange(8 * nprocs))
+    out["async_row_sum"] = float(got.sum())
+
     # sharedvar delta-sync across processes: every worker adds +1 to its
     # local copy; after sync the shared value reflects all workers' deltas
     shared = mv_shared({"w": np.zeros(4, np.float32)}, name="mp_shared")

@@ -1,0 +1,274 @@
+"""Uncoordinated async PS: wire, shards, client tables, failure semantics.
+
+Single-process tier: two standalone PSService instances stand in for two
+ranks, talking over real localhost sockets (the reference exercised its
+Worker/Server actors the same way before mpirun, Test/main.cpp). The
+multi-process tier lives in test_multiprocess_async.py.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.ps import wire
+from multiverso_tpu.ps.service import (FileRendezvous, PSContext, PSPeerError,
+                                       PSService)
+from multiverso_tpu.ps.tables import (AsyncArrayTable, AsyncKVTable,
+                                      AsyncMatrixTable)
+from multiverso_tpu.updaters import AdaGradUpdater, AddOption
+
+
+@pytest.fixture
+def two_ranks(tmp_path):
+    """Two PSContexts sharing a file rendezvous — a 2-rank world in one
+    process; every remote op crosses a real socket."""
+    rdv = FileRendezvous(str(tmp_path / "rdv"))
+    ctxs = [PSContext(r, 2, PSService(r, 2, rdv)) for r in range(2)]
+    yield ctxs
+    for c in ctxs:
+        c.close()
+
+
+class TestWire:
+    def test_roundtrip_via_socket(self):
+        import socket
+        a, b = socket.socketpair()
+        meta = {"table": "t", "opt": {"worker_id": 3}}
+        arrays = [np.arange(6, dtype=np.float32).reshape(2, 3),
+                  np.array(7, dtype=np.int64),
+                  np.zeros(0, dtype=np.float64)]
+        wire.send(a, 0x11, 42, meta, arrays)
+        msg_type, msg_id, meta2, arrays2 = wire.recv(b)
+        assert (msg_type, msg_id, meta2) == (0x11, 42, meta)
+        for x, y in zip(arrays, arrays2):
+            assert x.dtype == y.dtype and x.shape == y.shape
+            np.testing.assert_array_equal(x, y)
+        a.close(), b.close()
+
+    def test_bad_magic_raises(self):
+        import socket
+        a, b = socket.socketpair()
+        a.sendall(b"XXXX" + bytes(20))
+        with pytest.raises(wire.WireError):
+            wire.recv(b)
+        a.close(), b.close()
+
+
+class TestAsyncMatrixTable:
+    def test_different_row_sets_per_worker(self, two_ranks):
+        """THE capability the sync plane lacks (ref worker.cpp:30-76 +
+        server.cpp:36-58): each worker pushes its OWN row set, no
+        coordination, and the global state converges to the sum."""
+        t0 = AsyncMatrixTable(10, 4, name="m", ctx=two_ranks[0])
+        t1 = AsyncMatrixTable(10, 4, name="m", ctx=two_ranks[1])
+        # rows 0-4 owned by rank 0, rows 5-9 by rank 1
+        t0.add_rows([0, 7], np.full((2, 4), 1.0, np.float32))
+        t1.add_rows([3, 7, 9], np.full((3, 4), 2.0, np.float32))
+        t1.add_rows([7], np.full((1, 4), 0.5, np.float32))
+        got = t0.get_rows([0, 3, 7, 9])
+        np.testing.assert_allclose(got[0], 1.0)
+        np.testing.assert_allclose(got[1], 2.0)
+        np.testing.assert_allclose(got[2], 3.5)   # 1 + 2 + 0.5
+        np.testing.assert_allclose(got[3], 2.0)
+        # the other worker sees the same state (server truth, not caches)
+        np.testing.assert_allclose(t1.get_rows([7])[0], 3.5)
+
+    def test_uncoordinated_rates(self, two_ranks):
+        """Workers at wildly different rates; nobody waits for anybody
+        (no collective): total = sum of all pushes."""
+        t0 = AsyncMatrixTable(8, 2, name="r", ctx=two_ranks[0])
+        t1 = AsyncMatrixTable(8, 2, name="r", ctx=two_ranks[1])
+
+        def fast():
+            for _ in range(50):
+                t0.add_rows([1, 6], np.ones((2, 2), np.float32))
+
+        def slow():
+            for _ in range(5):
+                t1.add_rows([1], np.ones((1, 2), np.float32))
+                time.sleep(0.01)
+
+        th = [threading.Thread(target=fast), threading.Thread(target=slow)]
+        [x.start() for x in th]
+        [x.join() for x in th]
+        t0.flush(), t1.flush()
+        got = t0.get_rows([1, 6])
+        np.testing.assert_allclose(got[0], 55.0)   # 50 + 5
+        np.testing.assert_allclose(got[1], 50.0)
+
+    def test_async_msg_ids_and_wait(self, two_ranks):
+        t0 = AsyncMatrixTable(6, 3, name="w", ctx=two_ranks[0])
+        AsyncMatrixTable(6, 3, name="w", ctx=two_ranks[1])
+        mids = [t0.add_rows_async([i % 6], np.ones((1, 3), np.float32))
+                for i in range(7)]
+        gid = t0.get_rows_async([0, 1, 2, 3, 4, 5])
+        for m in mids:
+            t0.wait(m)
+        rows = t0.wait(gid)
+        assert rows.shape == (6, 3)
+        # re-waiting a consumed id returns None (ref Waiter semantics)
+        assert t0.wait(mids[0]) is None
+
+    def test_duplicates_and_order(self, two_ranks):
+        t0 = AsyncMatrixTable(10, 2, name="d", ctx=two_ranks[0])
+        AsyncMatrixTable(10, 2, name="d", ctx=two_ranks[1])
+        # duplicate ids in one add accumulate (ref per-row accumulation)
+        t0.add_rows([8, 2, 8], np.ones((3, 2), np.float32))
+        got = t0.get_rows([8, 2, 8, 2])
+        np.testing.assert_allclose(got[0], 2.0)
+        np.testing.assert_allclose(got[1], 1.0)
+        np.testing.assert_allclose(got[2], 2.0)   # original order preserved
+
+    def test_whole_table_and_array(self, two_ranks):
+        t0 = AsyncMatrixTable(7, 3, name="f", ctx=two_ranks[0])
+        t1 = AsyncMatrixTable(7, 3, name="f", ctx=two_ranks[1])
+        t0.add(np.ones((7, 3), np.float32))
+        t1.add(2 * np.ones((7, 3), np.float32))
+        np.testing.assert_allclose(t1.get(), 3.0)
+
+        a0 = AsyncArrayTable(9, name="arr", ctx=two_ranks[0])
+        a1 = AsyncArrayTable(9, name="arr", ctx=two_ranks[1])
+        a0.add(np.arange(9, dtype=np.float32))
+        a1.add(np.arange(9, dtype=np.float32))
+        np.testing.assert_allclose(a0.get(), 2 * np.arange(9))
+
+    def test_per_worker_adagrad_state(self, two_ranks):
+        """ref adagrad_updater.h:19 — per-worker historic g² on the server,
+        keyed by the AddOption worker_id each worker sends."""
+        ts = [AsyncMatrixTable(
+                  4, 2, name="ag",
+                  updater=AdaGradUpdater(num_workers=2, per_worker=True),
+                  ctx=two_ranks[r]) for r in range(2)]
+        opt = dict(learning_rate=1.0, rho=1.0)
+        ts[0].add_rows([0], np.ones((1, 2), np.float32),
+                       AddOption(worker_id=0, **opt))
+        before = ts[0].get_rows([0])[0].copy()
+        # worker 1's first add must use ITS OWN fresh g² (not worker 0's)
+        ts[1].add_rows([0], np.ones((1, 2), np.float32),
+                       AddOption(worker_id=1, **opt))
+        after = ts[1].get_rows([0])[0]
+        # both first-adds step by the same magnitude (fresh g² each):
+        # w0: 0 - 1*1/(sqrt(1)+eps) = -1 ; w1: -1 - 1 = -2
+        np.testing.assert_allclose(before, -1.0, rtol=1e-5)
+        np.testing.assert_allclose(after, -2.0, rtol=1e-5)
+
+    def test_random_init_consistent_across_clients(self, two_ranks):
+        t0 = AsyncMatrixTable(10, 4, name="ri", seed=3, init_scale=0.5,
+                              ctx=two_ranks[0])
+        t1 = AsyncMatrixTable(10, 4, name="ri", seed=3, init_scale=0.5,
+                              ctx=two_ranks[1])
+        a, b = t0.get(), t1.get()
+        np.testing.assert_array_equal(a, b)
+        assert np.abs(a).max() <= 0.5 and np.abs(a).std() > 0
+
+    def test_set_rows_and_store_load(self, two_ranks, tmp_path):
+        t0 = AsyncMatrixTable(6, 2, name="ck", ctx=two_ranks[0])
+        AsyncMatrixTable(6, 2, name="ck", ctx=two_ranks[1])
+        t0.set_rows([5, 1], np.array([[5, 5], [1, 1]], np.float32))
+        np.testing.assert_allclose(t0.get_row(5), 5.0)
+        np.testing.assert_allclose(t0.get_row(1), 1.0)
+        with open(tmp_path / "ck.npy", "wb") as f:
+            t0.store(f)
+        t0.add(np.ones((6, 2), np.float32))
+        with open(tmp_path / "ck.npy", "rb") as f:
+            t0.load(f)
+        np.testing.assert_allclose(t0.get_row(5), 5.0)
+
+    def test_errors_are_typed(self, two_ranks):
+        t0 = AsyncMatrixTable(5, 2, name="e", ctx=two_ranks[0])
+        with pytest.raises(IndexError):
+            t0.add_rows([5], np.ones((1, 2), np.float32))
+        with pytest.raises(TypeError):
+            t0.get_rows([0.5])
+        with pytest.raises(ValueError):
+            t0.get_rows([])
+
+
+class TestAsyncKV:
+    def test_hash_sharded_aggregated_get(self, two_ranks):
+        k0 = AsyncKVTable(name="kv", ctx=two_ranks[0])
+        k1 = AsyncKVTable(name="kv", ctx=two_ranks[1])
+        k0.add([0, 1, 2], [1.0, 1.0, 1.0])
+        k1.add([1, 2, 3], [2.0, 2.0, 2.0])
+        # uncoordinated aggregated read — no collective, either side
+        assert k0.get() == {0: 1.0, 1: 3.0, 2: 3.0, 3: 2.0}
+        assert k1.get([1, 9]) == {1: 3.0, 9: 0}
+        assert k0[2] == 3.0
+
+    def test_duplicate_request_keys_not_double_counted(self, two_ranks):
+        k0 = AsyncKVTable(name="kvd", ctx=two_ranks[0])
+        AsyncKVTable(name="kvd", ctx=two_ranks[1])
+        k0.add([5], [2.0])
+        assert k0.get([5, 5, 5]) == {5: 2.0}
+
+
+class TestFailureSemantics:
+    def test_idle_connection_survives_timeout(self, tmp_path):
+        """A healthy-but-quiet peer must not be declared dead: the io
+        timeout bounds blocked replies, not connection lifetime."""
+        from multiverso_tpu.utils import config
+        config.set_flag("ps_timeout", 1.0)
+        rdv = FileRendezvous(str(tmp_path / "rdv"))
+        ctxs = [PSContext(r, 2, PSService(r, 2, rdv)) for r in range(2)]
+        try:
+            t0 = AsyncMatrixTable(10, 2, name="idle", ctx=ctxs[0])
+            AsyncMatrixTable(10, 2, name="idle", ctx=ctxs[1])
+            t0.add_rows([9], np.ones((1, 2), np.float32))  # open the conn
+            time.sleep(2.5)                                # > ps_timeout idle
+            np.testing.assert_allclose(t0.get_rows([9])[0], 1.0)
+        finally:
+            for c in ctxs:
+                c.close()
+
+    def test_failed_fire_and_forget_does_not_poison_table(self, tmp_path):
+        """A dead shard's unawaited add is logged, not re-raised: later ops
+        on live shards keep working (the elasticity contract)."""
+        from multiverso_tpu.utils import config
+        config.set_flag("ps_timeout", 5.0)
+        config.set_flag("ps_connect_timeout", 5.0)
+        rdv = FileRendezvous(str(tmp_path / "rdv"))
+        ctxs = [PSContext(r, 2, PSService(r, 2, rdv)) for r in range(2)]
+        try:
+            t0 = AsyncMatrixTable(10, 2, name="poison", ctx=ctxs[0])
+            AsyncMatrixTable(10, 2, name="poison", ctx=ctxs[1])
+            t0.add_rows([9], np.ones((1, 2), np.float32))
+            ctxs[1].close()                      # rank 1 dies
+            time.sleep(0.1)
+            t0.add_rows_async([8], np.ones((1, 2), np.float32))  # never waited
+            time.sleep(0.3)                      # let the failure land
+            for _ in range(3):                   # sweeps must not raise
+                t0.add_rows([1], np.ones((1, 2), np.float32))
+            np.testing.assert_allclose(t0.get_rows([1])[0], 3.0)
+        finally:
+            for c in ctxs:
+                c.close()
+
+    def test_dead_peer_does_not_hang_live_traffic(self, tmp_path):
+        """A killed worker/server must not block peers: ops on live shards
+        proceed, ops on the dead shard raise PSPeerError quickly (the
+        elastic behavior the reference lacked — its MPI world just hung)."""
+        from multiverso_tpu.utils import config
+        config.set_flag("ps_timeout", 5.0)
+        config.set_flag("ps_connect_timeout", 5.0)
+        rdv = FileRendezvous(str(tmp_path / "rdv"))
+        ctxs = [PSContext(r, 2, PSService(r, 2, rdv)) for r in range(2)]
+        try:
+            t0 = AsyncMatrixTable(10, 2, name="dp", ctx=ctxs[0])
+            AsyncMatrixTable(10, 2, name="dp", ctx=ctxs[1])
+            t0.add_rows([0, 9], np.ones((2, 2), np.float32))
+            t0.flush()
+            ctxs[1].close()           # rank 1 dies
+            time.sleep(0.1)
+            # rows 0-4 live on rank 0: still fully functional
+            t0.add_rows([1], np.ones((1, 2), np.float32))
+            np.testing.assert_allclose(t0.get_rows([1])[0], 1.0)
+            # rows 5-9 lived on rank 1: typed error, bounded time
+            start = time.monotonic()
+            with pytest.raises(PSPeerError):
+                t0.get_rows([9])
+            assert time.monotonic() - start < 10.0
+        finally:
+            for c in ctxs:
+                c.close()

@@ -69,6 +69,13 @@ def test_process_cluster(nprocs):
         assert r["kv_global"] == r["kv"]
         # matrix collective row add of rank+1 in both rows
         assert r["matrix_rows"] == [[tri] * 4, [tri] * 4]
+        # union-of-ids collective: rank p adds rows {p, p+1} with value p+1
+        expect_union = [(k + 1 if k < nprocs else 0) + (k if k >= 1 else 0)
+                        for k in range(nprocs + 1)]
+        assert r["matrix_union"] == [float(v) for v in expect_union]
+        # async plane over the coordinator KV store: rank p pushed its 8
+        # disjoint rows (value 1) p+1 times -> sum = 8*4*tri
+        assert r["async_row_sum"] == 8 * 4 * tri
         # sharedvar: every worker pushed +1 -> merged value N everywhere
         assert r["sharedvar"] == [float(nprocs)] * 4
 

@@ -1,0 +1,76 @@
+"""Multi-process async PS: N real OS processes, uncoordinated Add/Get.
+
+The tier-2 fixture for the capability that defines the reference (ref
+src/worker.cpp / src/server.cpp): per-worker row sets, per-worker rates,
+no collectives — plus the crash case its MPI world couldn't survive."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_DIR)
+
+
+def _spawn(tmp_path, nprocs, mode, expect_fail_rank=None):
+    rdv = str(tmp_path / "rdv")
+    os.makedirs(rdv, exist_ok=True)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join(_DIR, "async_ps_worker.py"),
+             rdv, str(nprocs), str(pid), mode],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+            text=True)
+        for pid in range(nprocs)
+    ]
+    results, errors = {}, []
+    for pid, p in enumerate(procs):
+        try:
+            stdout, stderr = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            pytest.fail(f"process {pid} timed out (async plane hung?)")
+        if pid == expect_fail_rank:
+            assert p.returncode == 17, f"victim exited rc={p.returncode}"
+            continue
+        if p.returncode != 0:
+            errors.append(f"pid {pid} rc={p.returncode}\n{stderr[-2000:]}")
+            continue
+        for line in stdout.splitlines():
+            if line.startswith("RESULT "):
+                results[pid] = json.loads(line[len("RESULT "):])
+    if errors:
+        pytest.fail("\n".join(errors))
+    return results
+
+
+@pytest.mark.parametrize("nprocs", [2, 4])
+def test_uncoordinated_rates(tmp_path, nprocs):
+    """Every worker pushes a different row set at a different rate; all
+    workers read back the identical converged state."""
+    results = _spawn(tmp_path, nprocs, "rates")
+    assert set(results) == set(range(nprocs))
+    # total pushed mass: sum_r (r+1)*5 pushes x 8 rows x 4 cols
+    expect_sum = sum((r + 1) * 5 for r in range(nprocs)) * 8 * 4
+    for r in results.values():
+        assert r["row_sum"] == expect_sum
+        assert r["kv"] == {str(k): (k + 1) * 5.0 for k in range(nprocs)}
+
+
+@pytest.mark.parametrize("nprocs", [3])
+def test_killed_worker_does_not_hang_peers(tmp_path, nprocs):
+    """The last rank crashes mid-run (os._exit, no cleanup). Survivors keep
+    full function on live shards and get a typed, time-bounded error for
+    the dead shard — the elastic behavior the reference's MPI world lacked
+    (SURVEY §5: 'no heartbeats, no re-registration')."""
+    results = _spawn(tmp_path, nprocs, "kill",
+                     expect_fail_rank=nprocs - 1)
+    assert set(results) == set(range(nprocs - 1))
+    for r in results.values():
+        assert r["live_row0"] >= 10.0
+        assert r["dead_shard_error_s"] < 15.0

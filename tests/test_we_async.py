@@ -1,0 +1,53 @@
+"""WordEmbedding on the uncoordinated async plane, np=4 — the VERDICT
+round-1 'done when': train_ps_blocks runs multi-process with per-worker
+data blocks over per-worker row sets, no collectives."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_DIR)
+
+
+def test_we_ps_blocks_np4(tmp_path):
+    nprocs = 4
+    rdv = str(tmp_path / "rdv")
+    os.makedirs(rdv, exist_ok=True)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join(_DIR, "we_async_worker.py"),
+             rdv, str(nprocs), str(pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+            text=True)
+        for pid in range(nprocs)
+    ]
+    results, errors = {}, []
+    for pid, p in enumerate(procs):
+        try:
+            stdout, stderr = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            pytest.fail(f"WE worker {pid} timed out")
+        if p.returncode != 0:
+            errors.append(f"pid {pid} rc={p.returncode}\n{stderr[-2000:]}")
+            continue
+        for line in stdout.splitlines():
+            if line.startswith("RESULT "):
+                results[pid] = json.loads(line[len("RESULT "):])
+    if errors:
+        pytest.fail("\n".join(errors))
+    assert set(results) == set(range(nprocs))
+    total_trained = sum(r["words"] for r in results.values())
+    assert total_trained == 40_000            # blocks partitioned, disjoint
+    for r in results.values():
+        # every worker reads the same aggregated word count off the shards
+        assert r["total_words"] == total_trained
+        assert np.isfinite(r["loss"]) and r["loss"] > 0
+        assert r["emb_norm"] > 0

@@ -1,0 +1,72 @@
+"""Subprocess body: WordEmbedding PS-block training on the async plane.
+
+Four independent OS processes (no JAX coordinator), each training its own
+subset of the data blocks against uncoordinated async tables — the full
+reference workflow (ref distributed_wordembedding.cpp:147-252 block
+pipeline + communicator.cpp row pulls/pushes + server.cpp async applies).
+
+Invoked as: python we_async_worker.py <rdv_dir> <world> <rank>
+Prints "RESULT <json>" on success.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _sync(rdv_dir, world, rank, tag, timeout=120):
+    open(os.path.join(rdv_dir, f"{tag}.{rank}"), "w").close()
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if all(os.path.exists(os.path.join(rdv_dir, f"{tag}.{r}"))
+               for r in range(world)):
+            return
+        time.sleep(0.02)
+    raise TimeoutError(tag)
+
+
+def main():
+    rdv_dir, world, rank = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from multiverso_tpu.utils import config
+    import multiverso_tpu as mv
+    from multiverso_tpu.apps.word_embedding import (WEConfig, WordEmbedding,
+                                                    synthetic_corpus)
+    from multiverso_tpu.data.dictionary import Dictionary
+
+    config.set_flag("ps_rank", rank)
+    config.set_flag("ps_world", world)
+    config.set_flag("ps_rendezvous", rdv_dir)
+    config.set_flag("ps_timeout", 120.0)
+    mv.init()
+
+    cfg = WEConfig(size=16, epoch=1, min_count=1, batch_size=128,
+                   data_block_size=5000, negative=2, sample=0,
+                   async_ps="1", use_ps="1", seed=7)
+    tokens = synthetic_corpus(40_000, vocab=300, seed=7)  # shared corpus
+    dictionary = Dictionary.build(tokens, cfg.min_count, None)
+    we = WordEmbedding(cfg, dictionary)
+    ids = we.prepare_ids(tokens)
+    _sync(rdv_dir, world, rank, "tables")
+    stats = we.train_ps_blocks(ids)          # trains blocks[rank::world]
+    _sync(rdv_dir, world, rank, "trained")
+    total = we.total_word_count()
+    emb = we.embeddings()                    # pulled off the async shards
+    _sync(rdv_dir, world, rank, "read")
+    mv.shutdown()
+    print("RESULT " + json.dumps({
+        "rank": rank,
+        "words": int(stats["words_per_sec"] * stats["seconds"] + 0.5),
+        "loss": stats["loss"],
+        "total_words": total,
+        "emb_norm": float(np.linalg.norm(emb)),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
