@@ -89,6 +89,119 @@ def bench_wordembedding(n_lo: int = 2, n_hi: int = 10):
     return words_per_sec / n_chips, stats
 
 
+def bench_wordembedding_ps(num_tokens: int = 120_000):
+    """The PS-parity path (train_ps_blocks: pull rows / train / push
+    deltas, ref distributed_wordembedding.cpp) — benchmarked alongside the
+    fused path so the Add/Get plane can't silently regress. The reference's
+    words/sec was inherently a number of THIS shape."""
+    from multiverso_tpu.apps.word_embedding import (WEConfig, WordEmbedding,
+                                                    synthetic_corpus)
+    from multiverso_tpu.data.dictionary import Dictionary
+
+    tokens = synthetic_corpus(num_tokens, vocab=5_000, seed=11)
+    cfg = WEConfig(size=128, min_count=5, batch_size=8192, negative=5,
+                   window=5, epoch=1, data_block_size=50_000, use_ps="1")
+    d = Dictionary.build(tokens, cfg.min_count)
+    we = WordEmbedding(cfg, d)
+    ids = we.prepare_ids(tokens)
+    we.train_ps_blocks(ids, epochs=1)   # compile all block programs
+    stats = we.train_ps_blocks(ids, epochs=1)
+    return {"ps_words_per_sec": stats["words_per_sec"],
+            "loss": stats["loss"], "seconds": stats["seconds"],
+            "tokens": int(ids.size)}
+
+
+def bench_lr_real():
+    """Tier-4 convergence on REAL data (BASELINE config 1): LR test
+    accuracy on MNIST idx files when present, else sklearn's bundled UCI
+    handwritten digits (real data; MNIST is not downloadable here —
+    provenance is recorded)."""
+    from multiverso_tpu.apps.logistic_regression import LogReg, LogRegConfig
+    from multiverso_tpu.io import mnist
+
+    data = mnist.load_real()
+    cfg = LogRegConfig({
+        "input_size": str(data["x_train"].shape[1]), "output_size": "10",
+        "minibatch_size": "64", "learning_rate": "0.05",
+        "train_epoch": "30", "objective_type": "softmax",
+    })
+    lr = LogReg(cfg)
+    stats = lr.train_arrays(data["x_train"], data["y_train"])
+    acc = lr.test_arrays(data["x_test"], data["y_test"])
+    return {"test_accuracy": round(acc, 4),
+            "train_loss": round(stats["loss"], 4),
+            "n_train": int(len(data["y_train"])),
+            "n_test": int(len(data["y_test"])),
+            "provenance": data["provenance"]}
+
+
+def bench_we_real(n_lo: int = 1, n_hi: int = 5):
+    """Tier-4 WE on REAL text (BASELINE config 2): the committed
+    text8-normalized real-prose shard (or an actual text8 file when
+    present — io/realtext.py). Reports words/sec + loss, and a nearest-
+    neighbor probe as qualitative convergence evidence."""
+    from multiverso_tpu.apps.word_embedding import WEConfig, WordEmbedding
+    from multiverso_tpu.data.dictionary import Dictionary
+    from multiverso_tpu.io import realtext
+
+    tokens = realtext.load_tokens()
+    cfg = WEConfig(size=128, min_count=5, batch_size=16384, negative=5,
+                   window=5, shared_negatives=256)
+    d = Dictionary.build(tokens, cfg.min_count)
+    we = WordEmbedding(cfg, d)
+    ids = we.prepare_ids(tokens)
+    we.train_fused(ids, epochs=2)   # warm both compile layouts
+    last = {}
+
+    def run(n):
+        last.update(we.train_fused(ids, epochs=n))
+        return last["seconds"]
+
+    sec_per_epoch, _ = _differential(run, n_lo, n_hi)
+    probe = next((w for w in ("array", "matrix", "value", "data")
+                  if w in d.word2id), None)
+    neighbors = we.nearest(probe, 6)[1:] if probe else []
+    return {"words_per_sec": ids.size / sec_per_epoch,
+            "loss": round(last["loss"], 4),
+            "tokens": int(ids.size), "vocab": len(d),
+            "neighbors_of_" + (probe or "none"): neighbors,
+            "provenance": realtext.provenance()}
+
+
+def bench_host_wire():
+    """Measure the host<->device wire itself (BASELINE breakdown evidence):
+    per-dispatch round-trip (RTT) and upload bandwidth via a two-size
+    differential — every host-plane p50 decomposes against these."""
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda a: a + 1)
+    x = jnp.zeros(())
+    float(f(x))
+
+    def rtt_once():
+        t0 = time.perf_counter()
+        float(f(x))
+        return time.perf_counter() - t0
+
+    rtts = [rtt_once() for _ in range(12)]
+
+    def upload(nfloats):
+        h = np.ones(nfloats, np.float32)
+        jax.device_put(h).block_until_ready()
+        t0 = time.perf_counter()
+        jax.device_put(h).block_until_ready()
+        return time.perf_counter() - t0
+
+    t_small = np.median([upload(1 << 20) for _ in range(4)])
+    t_big = np.median([upload(1 << 23) for _ in range(4)])
+    bw = ((1 << 23) - (1 << 20)) * 4 / max(t_big - t_small, 1e-9)
+    return {"rtt_ms": _percentile_ms(rtts),
+            "upload_gbps": bw / 1e9,
+            "upload_4mb_ms": t_small * 1e3,
+            "upload_32mb_ms": t_big * 1e3}
+
+
 def bench_array_table(size: int = 1_000_000, iters: int = 10):
     import multiverso_tpu as mv
     from multiverso_tpu.updaters import AddOption
@@ -106,6 +219,41 @@ def bench_array_table(size: int = 1_000_000, iters: int = 10):
         t0 = time.perf_counter()
         t.get()
         gets.append(time.perf_counter() - t0)
+
+    # pipelined plane: the app-realistic shape — N in-flight async adds,
+    # one wait (ref LR pipeline AddAsync; amortizes the dispatch RTT, so
+    # the steady rate is wire-bandwidth-bound, not latency-bound)
+    def pipelined(n):
+        mids = [t.add_async(delta, opt) for _ in range(n)]
+        t.wait(mids[-1])
+        return None
+
+    pipelined(4)
+    pipe = []
+    for _ in range(4):
+        t0 = time.perf_counter()
+        pipelined(8)
+        pipe.append((time.perf_counter() - t0) / 8)
+
+    # wire-compressed plane (ref quantization_util.h filters on the MPI
+    # wire; here the tunnel/PCIe wire): bf16 halves the payload, 1bit
+    # sends sign bits + block scales with error feedback
+    wf = {}
+    for mode in ("bf16", "1bit"):
+        tw = mv.ArrayTable(size, updater="sgd", name=f"bench_array_{mode}",
+                           wire_filter=mode)
+        tw.add(delta, opt)
+        tw.get()
+        wadds, wgets = [], []
+        for _ in range(max(iters // 2, 4)):
+            t0 = time.perf_counter()
+            tw.add(delta, opt)
+            wadds.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            tw.get()
+            wgets.append(time.perf_counter() - t0)
+        wf[mode] = {"add_p50_ms": _percentile_ms(wadds),
+                    "get_p50_ms": _percentile_ms(wgets)}
     # device plane: delta already resident (the real TPU deployment shape —
     # grads are produced on device; host numbers above are tunnel-bound)
     import jax
@@ -146,6 +294,9 @@ def bench_array_table(size: int = 1_000_000, iters: int = 10):
         "get_p50_ms": _percentile_ms(gets),
         "add_gbps": nbytes / np.percentile(adds, 50) / 1e9,
         "get_gbps": nbytes / np.percentile(gets, 50) / 1e9,
+        "pipelined_add_ms": _percentile_ms(pipe),
+        "pipelined_add_gbps": nbytes / np.percentile(pipe, 50) / 1e9,
+        "wire_filtered": wf,
         "device_add_ms": dev_add_s * 1e3,
         "device_add_gbps": nbytes / dev_add_s / 1e9,
         "fixed_overhead_ms": dev_intercept * 1e3,
@@ -322,6 +473,22 @@ def main() -> None:
 
     mv.init()
     words_per_sec_chip, we_stats = bench_wordembedding()
+    try:
+        we_ps_stats = bench_wordembedding_ps()
+    except Exception as e:
+        we_ps_stats = {"error": f"{type(e).__name__}: {e}"[:200]}
+    try:
+        we_real_stats = bench_we_real()
+    except Exception as e:
+        we_real_stats = {"error": f"{type(e).__name__}: {e}"[:200]}
+    try:
+        lr_real_stats = bench_lr_real()
+    except Exception as e:
+        lr_real_stats = {"error": f"{type(e).__name__}: {e}"[:200]}
+    try:
+        wire_stats = bench_host_wire()
+    except Exception as e:
+        wire_stats = {"error": f"{type(e).__name__}: {e}"[:200]}
     array_stats = bench_array_table()
     try:
         lm_stats = bench_transformer()
@@ -382,6 +549,10 @@ def main() -> None:
         "extra": {
             "we_loss": round(we_stats["loss"], 4),
             "we_sec_per_epoch": round(we_stats["sec_per_epoch"], 4),
+            "we_ps_block_path": we_ps_stats,
+            "we_realtext": we_real_stats,
+            "lr_real_digits": lr_real_stats,
+            "host_wire": wire_stats,
             "array_table_4M_float32": array_stats,
             "transformer_lm_bs8_seq512_d256_L4": lm_stats,
             "transformer_lm_472M_bs2_seq1024_d2048_L8": lm_large_stats,

@@ -5,7 +5,11 @@
 -- ffi.load('multiverso')). The C ABI here bridges into the JAX/TPU runtime
 -- (see multiverso_tpu/native/mv_capi.cpp); build it with
 --   make -C multiverso_tpu/native capi
--- This file ships as an untested example: the build image has no LuaJIT.
+-- The build image has no LuaJIT, so this shim cannot run in CI — but the
+-- ABI itself is exercised end-to-end by the C driver
+-- (multiverso_tpu/native/mv_capi_test.c, `make capi_test`), which calls
+-- every symbol in the cdef below with assertions; this file is a thin
+-- mirror of that proven surface.
 
 local ffi = require('ffi')
 
@@ -24,10 +28,13 @@ void MV_AddAsyncArrayTable(TableHandler handler, float* data, int size);
 void MV_NewMatrixTable(int num_row, int num_col, TableHandler* out);
 void MV_GetMatrixTableAll(TableHandler handler, float* data, int size);
 void MV_AddMatrixTableAll(TableHandler handler, float* data, int size);
+void MV_AddAsyncMatrixTableAll(TableHandler handler, float* data, int size);
 void MV_GetMatrixTableByRows(TableHandler handler, float* data, int size,
                              int row_ids[], int row_ids_n);
 void MV_AddMatrixTableByRows(TableHandler handler, float* data, int size,
                              int row_ids[], int row_ids_n);
+void MV_AddAsyncMatrixTableByRows(TableHandler handler, float* data,
+                                  int size, int row_ids[], int row_ids_n);
 ]]
 
 local lib = ffi.load('multiverso')
@@ -57,6 +64,51 @@ end
 
 function ArrayTable:add(buf)
   lib.MV_AddArrayTable(self.handler, buf, self.size)
+end
+
+function ArrayTable:add_async(buf)
+  lib.MV_AddAsyncArrayTable(self.handler, buf, self.size)
+end
+
+local MatrixTable = {}
+MatrixTable.__index = MatrixTable
+
+function M.new_matrix_table(num_row, num_col)
+  local h = ffi.new('TableHandler[1]')
+  lib.MV_NewMatrixTable(num_row, num_col, h)
+  return setmetatable({ handler = h[0], num_row = num_row,
+                        num_col = num_col, size = num_row * num_col },
+                      MatrixTable)
+end
+
+function MatrixTable:get(buf)
+  buf = buf or ffi.new('float[?]', self.size)
+  lib.MV_GetMatrixTableAll(self.handler, buf, self.size)
+  return buf
+end
+
+function MatrixTable:add(buf)
+  lib.MV_AddMatrixTableAll(self.handler, buf, self.size)
+end
+
+function MatrixTable:add_async(buf)
+  lib.MV_AddAsyncMatrixTableAll(self.handler, buf, self.size)
+end
+
+-- row batch ops: `rows` is a 0-based int array (ref MatrixTableHandler)
+function MatrixTable:get_rows(rows, n, buf)
+  buf = buf or ffi.new('float[?]', n * self.num_col)
+  lib.MV_GetMatrixTableByRows(self.handler, buf, n * self.num_col, rows, n)
+  return buf
+end
+
+function MatrixTable:add_rows(buf, rows, n)
+  lib.MV_AddMatrixTableByRows(self.handler, buf, n * self.num_col, rows, n)
+end
+
+function MatrixTable:add_rows_async(buf, rows, n)
+  lib.MV_AddAsyncMatrixTableByRows(self.handler, buf, n * self.num_col,
+                                   rows, n)
 end
 
 return M

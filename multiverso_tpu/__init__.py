@@ -39,6 +39,6 @@ def __getattr__(name):
     # native import multiverso_tpu themselves, so eager import would cycle).
     import importlib
     if name in ("checkpoint", "parallel", "handlers", "sharedvar", "native",
-                "models", "apps", "io", "data", "ssp", "elastic", "ps"):
+                "models", "apps", "io", "data", "ssp", "elastic"):
         return importlib.import_module(f"multiverso_tpu.{name}")
     raise AttributeError(f"module 'multiverso_tpu' has no attribute {name!r}")

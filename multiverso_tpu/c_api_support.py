@@ -9,9 +9,22 @@ ref include/multiverso/c_api.h:14).
 from __future__ import annotations
 
 import ctypes
+import os
 from typing import Dict
 
 import numpy as np
+
+if os.environ.get("MV_CAPI_PLATFORM"):
+    # Embedded-interpreter platform pin (the C test driver runs on the CPU
+    # mesh so it can't fight another process for the one TPU chip). Env
+    # JAX_PLATFORMS is overridden by the site hook here, so this must go
+    # through jax.config before any backend use — same trick as
+    # utils/platform.force_cpu_mesh.
+    import jax
+    jax.config.update("jax_platforms", os.environ["MV_CAPI_PLATFORM"])
+    if os.environ.get("MV_CAPI_CPU_DEVICES"):
+        jax.config.update("jax_num_cpu_devices",
+                          int(os.environ["MV_CAPI_CPU_DEVICES"]))
 
 import multiverso_tpu as mv
 

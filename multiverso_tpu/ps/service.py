@@ -245,6 +245,7 @@ class PSService:
         self._handlers_cv = threading.Condition()
         self._peers: Dict[int, _Peer] = {}
         self._peers_lock = threading.Lock()
+        self._peer_locks: Dict[int, threading.Lock] = {}
         self._conns: List[socket.socket] = []
         self._conns_lock = threading.Lock()
         self._closed = False
@@ -323,23 +324,37 @@ class PSService:
 
     # ----------------------------- client side ----------------------- #
     def _peer(self, rank: int) -> _Peer:
+        # two-phase: the global lock only guards the dict; the (slow)
+        # rendezvous lookup + connect runs under a PER-RANK lock, so a dead
+        # rank's connect_timeout cannot stall requests to healthy ranks
         with self._peers_lock:
             peer = self._peers.get(rank)
-            if peer is None:
-                if self._rendezvous is None:
-                    raise PSError("no rendezvous configured for remote ranks")
-                addr = self._rendezvous.lookup(
-                    rank, config.get_flag("ps_connect_timeout"))
-                peer = _Peer(rank, addr,
-                             config.get_flag("ps_connect_timeout"),
-                             config.get_flag("ps_timeout"))
+            if peer is not None:
+                return peer
+            lock = self._peer_locks.setdefault(rank, threading.Lock())
+        with lock:
+            with self._peers_lock:
+                peer = self._peers.get(rank)
+                if peer is not None:
+                    return peer
+            if self._rendezvous is None:
+                raise PSError("no rendezvous configured for remote ranks")
+            addr = self._rendezvous.lookup(
+                rank, config.get_flag("ps_connect_timeout"))
+            peer = _Peer(rank, addr,
+                         config.get_flag("ps_connect_timeout"),
+                         config.get_flag("ps_timeout"))
+            with self._peers_lock:
                 self._peers[rank] = peer
             return peer
 
     def request(self, rank: int, msg_type: int, meta: Dict,
                 arrays: Sequence[np.ndarray] = ()) -> cf.Future:
         """Uncoordinated request to ``rank``; local rank short-circuits the
-        socket but keeps async dispatch order via the local executor."""
+        socket but keeps async dispatch order via the local executor.
+        NEVER raises: a dead/unreachable rank yields a future carrying
+        PSPeerError, so fire-and-forget callers stay fire-and-forget and
+        multi-owner ops keep their live-shard futures."""
         if rank == self.rank:
             fut: cf.Future = cf.Future()
 
@@ -352,7 +367,13 @@ class PSService:
 
             self._local_exec.submit(_run)
             return fut
-        return self._peer(rank).request(msg_type, meta, arrays)
+        try:
+            return self._peer(rank).request(msg_type, meta, arrays)
+        except PSError as e:
+            fut = cf.Future()
+            fut.set_exception(e if isinstance(e, PSPeerError)
+                              else PSPeerError(str(e)))
+            return fut
 
     def ping(self, rank: int, timeout: Optional[float] = None) -> bool:
         if rank == self.rank:

@@ -27,14 +27,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from multiverso_tpu.ps import service as svc
+from multiverso_tpu.tables.matrix_table import _bucket_size
 from multiverso_tpu.updaters import AddOption, Updater
-
-
-def _bucket(k: int, cap: int) -> int:
-    b = 8
-    while b < k:
-        b *= 2
-    return min(b, cap)
 
 
 class RowShard:
@@ -139,7 +133,7 @@ class RowShard:
                 f"row ids outside shard [{self.lo}, {self.hi}) of "
                 f"{self.name}")
         k = local.size
-        b = _bucket(k, self.n + 1)
+        b = _bucket_size(k, self.n + 1)
         if b > k:
             local = np.concatenate(
                 [local, np.full(b - k, self.scratch, np.int64)])

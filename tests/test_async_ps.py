@@ -222,6 +222,33 @@ class TestFailureSemantics:
             for c in ctxs:
                 c.close()
 
+    def test_first_contact_dead_peer_yields_failed_future(self, tmp_path):
+        """A rank that died before we EVER connected to it: async ops must
+        not raise (failed future instead), the wait is typed and bounded,
+        and live-shard traffic keeps working (regression: the first-contact
+        path used to raise synchronously out of fire-and-forget calls)."""
+        from multiverso_tpu.utils import config
+        config.set_flag("ps_timeout", 4.0)
+        config.set_flag("ps_connect_timeout", 3.0)
+        rdv = FileRendezvous(str(tmp_path / "rdv"))
+        ctxs = [PSContext(r, 2, PSService(r, 2, rdv)) for r in range(2)]
+        try:
+            t0 = AsyncMatrixTable(10, 2, name="fc", ctx=ctxs[0])
+            AsyncMatrixTable(10, 2, name="fc", ctx=ctxs[1])
+            ctxs[1].close()   # dies before rank 0 ever dials it
+            time.sleep(0.1)
+            start = time.monotonic()
+            mid = t0.add_rows_async([1, 9],           # spans live + dead
+                                    np.ones((2, 2), np.float32))
+            with pytest.raises(PSPeerError):
+                t0.wait(mid)
+            assert time.monotonic() - start < 12.0
+            # the live half landed; later live traffic unaffected
+            np.testing.assert_allclose(t0.get_rows([1])[0], 1.0)
+        finally:
+            for c in ctxs:
+                c.close()
+
     def test_failed_fire_and_forget_does_not_poison_table(self, tmp_path):
         """A dead shard's unawaited add is logged, not re-raised: later ops
         on live shards keep working (the elasticity contract)."""

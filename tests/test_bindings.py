@@ -325,3 +325,29 @@ def test_async_handler_adds_do_not_leak_pending():
         assert h._table.wait(mid) is not None
     finally:
         mv.shutdown()
+
+
+@pytest.mark.slow
+def test_c_abi_driver_end_to_end():
+    """Build and run the plain-C driver over EVERY exported MV_* symbol
+    (ref binding/lua/test.lua:1-79 had this role; ours asserts). Covers the
+    ABI with no Python on the caller side — the embedded interpreter is the
+    implementation detail under test."""
+    import shutil
+    import subprocess
+
+    if shutil.which("g++") is None or shutil.which("cc") is None:
+        pytest.skip("no C toolchain")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    native = os.path.join(repo, "multiverso_tpu", "native")
+    build = subprocess.run(["make", "-C", native, "mv_capi_test"],
+                           capture_output=True, text=True, timeout=300)
+    assert build.returncode == 0, build.stderr[-2000:]
+    env = dict(os.environ)
+    env["MV_CAPI_PLATFORM"] = "cpu"   # keep off the single TPU chip
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    run = subprocess.run([os.path.join(native, "mv_capi_test")],
+                         capture_output=True, text=True, timeout=300,
+                         env=env, cwd=native)
+    assert run.returncode == 0, (run.stdout[-1000:], run.stderr[-2000:])
+    assert "MV_CAPI_TEST PASS" in run.stdout

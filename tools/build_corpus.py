@@ -1,0 +1,126 @@
+"""Build a text8-style REAL-text corpus from English prose in this image.
+
+The tier-4 convergence configs call for text8 (BASELINE.md), which cannot
+be downloaded in a zero-egress environment. text8 is Wikipedia text piped
+through Matt Mahoney's wikifil normalization: lowercase, a-z only,
+everything else collapsed to single spaces. This tool applies the same
+normalization to the real English documentation shipped inside the image
+(package .rst/.md docs — numpy, jax, scipy, etc.), yielding a genuinely
+real natural-language corpus with Zipfian vocabulary and topical
+co-occurrence structure — the properties word2vec training exercises.
+
+Usage: python tools/build_corpus.py [out_path] [max_mb]
+Default: data/realtext.txt, 8 MB.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+SKIP_NAMES = re.compile(
+    r"(license|copying|notice|authors|top_level|record|entry_points|"
+    r"sources|installed-files|dependency_links)", re.I)
+_AZ = re.compile(r"[^a-z]+")
+
+
+def text8_normalize(raw: str) -> str:
+    """wikifil-style: lowercase, a-z and single spaces only."""
+    return _AZ.sub(" ", raw.lower()).strip()
+
+
+def iter_doc_files(roots):
+    for root in roots:
+        for dirpath, _dirnames, filenames in os.walk(root):
+            for fn in sorted(filenames):
+                if not fn.endswith((".rst", ".md")):
+                    continue
+                if SKIP_NAMES.search(fn):
+                    continue
+                yield os.path.join(dirpath, fn)
+
+
+def iter_docstrings(roots):
+    """Docstrings of installed packages, extracted statically (ast) — the
+    largest body of real English prose in the image (numpy/scipy/sklearn/
+    torch document every function in full sentences)."""
+    import ast
+    for root in roots:
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("test", "tests", "__pycache__")]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                try:
+                    with open(path, encoding="utf-8",
+                              errors="ignore") as f:
+                        tree = ast.parse(f.read(1 << 20))
+                except (OSError, SyntaxError, ValueError):
+                    continue
+                parts = []
+                for node in ast.walk(tree):
+                    if isinstance(node, (ast.Module, ast.ClassDef,
+                                         ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        doc = ast.get_docstring(node)
+                        if doc and len(doc) > 80:
+                            parts.append(doc)
+                if parts:
+                    yield path, "\n".join(parts)
+
+
+def looks_english(text: str) -> bool:
+    """Cheap prose filter: mostly letters, reasonable word lengths."""
+    if len(text) < 500:
+        return False
+    words = text.split()
+    if not words:
+        return False
+    avg = sum(len(w) for w in words) / len(words)
+    return 2.5 <= avg <= 9.0
+
+
+def build(out_path: str, max_bytes: int) -> int:
+    import sysconfig
+    roots = [sysconfig.get_paths()["purelib"]]
+    for extra in ("/opt/venv/lib", "/usr/local/lib/python3.12"):
+        if os.path.isdir(extra) and not any(
+                r.startswith(extra) for r in roots):
+            roots.append(extra)
+    os.makedirs(os.path.dirname(os.path.abspath(out_path)), exist_ok=True)
+    total = 0
+    with open(out_path, "w") as out:
+        for path in iter_doc_files(roots):
+            try:
+                with open(path, encoding="utf-8", errors="ignore") as f:
+                    raw = f.read(1 << 20)
+            except OSError:
+                continue
+            norm = text8_normalize(raw)
+            if not looks_english(norm):
+                continue
+            out.write(norm + " ")
+            total += len(norm) + 1
+            if total >= max_bytes:
+                return total
+        for _path, raw in iter_docstrings(roots):
+            norm = text8_normalize(raw)
+            if not looks_english(norm):
+                continue
+            out.write(norm + " ")
+            total += len(norm) + 1
+            if total >= max_bytes:
+                return total
+    return total
+
+
+if __name__ == "__main__":
+    out = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "data", "realtext.txt")
+    mb = float(sys.argv[2]) if len(sys.argv) > 2 else 8.0
+    n = build(out, int(mb * 1e6))
+    print(f"wrote {n/1e6:.1f} MB of normalized real text to {out}")
