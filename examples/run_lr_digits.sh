@@ -1,0 +1,18 @@
+#!/bin/sh
+# Tier-4 convergence run, CLI end-to-end (ref Applications/
+# LogisticRegression/example/run.sh — which downloaded MNIST; here
+# mnist_dir=auto picks the best REAL digit data in the image, or real
+# MNIST idx files via MV_MNIST_DIR). Expected: test accuracy >= 0.93.
+set -e
+cd "$(dirname "$0")/.."
+cfg=$(mktemp)
+cat > "$cfg" <<EOF
+mnist_dir=auto
+minibatch_size=64
+learning_rate=0.05
+train_epoch=30
+objective_type=softmax
+updater_type=sgd
+EOF
+python -m multiverso_tpu.apps.logistic_regression "$cfg"
+rm -f "$cfg"

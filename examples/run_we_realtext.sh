@@ -1,0 +1,12 @@
+#!/bin/sh
+# Tier-4 word2vec run on REAL text, CLI end-to-end (ref Applications/
+# WordEmbedding/example/run.bat trained text8; here the committed
+# text8-normalized real-prose shard is materialized first — an actual
+# text8 file via MV_TEXT8 is preferred automatically).
+set -e
+cd "$(dirname "$0")/.."
+corpus=$(python -c "from multiverso_tpu.io import realtext; print(realtext.materialize())")
+python -m multiverso_tpu.apps.word_embedding \
+  -train_file "$corpus" -output /tmp/realtext_vec.txt \
+  -size 128 -window 5 -negative 5 -min_count 5 -epoch 3
+echo "embeddings written to /tmp/realtext_vec.txt"

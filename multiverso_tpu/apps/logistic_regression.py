@@ -75,6 +75,9 @@ class LogRegConfig:
         self.heartbeat_dir = g("heartbeat_dir", "")
         self.pipeline = g("pipeline", "false").lower() == "true"
         self.use_ps = g("use_ps", "true").lower() == "true"
+        # uncoordinated async tables (multiverso_tpu.ps) for the dense PS
+        # path: workers push/pull at independent rates, no collectives
+        self.async_ps = g("async_ps", "false").lower() == "true"
         self.fused = g("fused", "false").lower() == "true"
         self.reader_type = g("reader_type", "libsvm")  # libsvm | dense
         self.mnist_dir = g("mnist_dir", "")  # BASELINE config 1: idx files
@@ -89,6 +92,10 @@ class LogRegConfig:
         if self.staleness >= 0 and not self.use_ps:
             raise ValueError("staleness needs use_ps=true (there is no "
                              "parameter server to be stale against)")
+        if self.async_ps and self.sparse:
+            raise ValueError("async_ps covers the dense path; the sparse "
+                             "stale-row protocol lives on the collective "
+                             "plane (use sparse=true without async_ps)")
 
     @classmethod
     def from_file(cls, path: str) -> "LogRegConfig":
@@ -114,6 +121,12 @@ class LogReg:
                 cfg.input_size + 1, cfg.output_size,
                 updater=cfg.updater_type, name="logreg_sparse")
             self.table = None
+        elif cfg.async_ps:
+            # the reference's default (async) server mode: deltas land on
+            # the owning shard as they arrive (ref src/server.cpp:36-58)
+            self.sparse_table = None
+            self.table = mv.AsyncArrayTable(
+                n_params, updater=cfg.updater_type, name="logreg_params")
         else:
             self.sparse_table = None
             self.table = mv.ArrayTable(n_params, updater=cfg.updater_type,
@@ -267,6 +280,11 @@ class LogReg:
                      epochs: Optional[int] = None) -> Dict[str, float]:
         """In-graph fused path: whole epoch as one lax.scan on device."""
         cfg = self.cfg
+        if cfg.async_ps:
+            raise ValueError("async_ps trains through the use_ps host loop "
+                             "(train_file / train_minibatches); the fused "
+                             "in-graph path needs the functional table "
+                             "plane, which async tables do not expose")
         epochs = epochs or cfg.train_epoch
         n = (len(y) // cfg.minibatch_size) * cfg.minibatch_size
         xb = jnp.asarray(x[:n]).reshape(-1, cfg.minibatch_size, cfg.input_size)
@@ -341,16 +359,26 @@ def main(argv=None) -> int:
     cfg = LogRegConfig.from_file(argv[0])
     mv.init()
     if cfg.mnist_dir:
+        # BASELINE config 1 (ref example/run.sh): mnist_dir=<idx dir> uses
+        # real MNIST files; mnist_dir=auto takes the best REAL digit data
+        # available (idx via $MV_MNIST_DIR, else sklearn's bundled UCI
+        # digits — io/mnist.load_real records the provenance)
         from multiverso_tpu.io import mnist
-        if not mnist.available(cfg.mnist_dir):
-            log.fatal("mnist_dir %s has no idx files", cfg.mnist_dir)
-        cfg.input_size, cfg.output_size = 784, 10
+        if cfg.mnist_dir != "auto" and not mnist.available(cfg.mnist_dir):
+            # explicit dir must exist — a typo'd path silently training on
+            # different data would report a meaningless accuracy
+            log.fatal("mnist_dir %s has no idx files (use mnist_dir=auto "
+                      "for the best available real digit data)",
+                      cfg.mnist_dir)
+        data = mnist.load_real(
+            None if cfg.mnist_dir == "auto" else cfg.mnist_dir)
+        cfg.input_size = int(data["x_train"].shape[1])
+        cfg.output_size = 10
         lr = LogReg(cfg)
-        x, y = mnist.load(cfg.mnist_dir, "train")
-        stats = lr.train_arrays(x, y)
-        log.info("train done: %s", stats)
-        xt, yt = mnist.load(cfg.mnist_dir, "test")
-        log.info("test accuracy: %.4f", lr.test_arrays(xt, yt))
+        stats = lr.train_arrays(data["x_train"], data["y_train"])
+        log.info("train done on %s: %s", data["provenance"], stats)
+        log.info("test accuracy: %.4f",
+                 lr.test_arrays(data["x_test"], data["y_test"]))
     else:
         if not cfg.train_file:
             log.fatal("config needs train_file=<path> (or mnist_dir=) — "

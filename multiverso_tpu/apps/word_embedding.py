@@ -444,7 +444,9 @@ class WordEmbedding:
             remap = prep["remap"]
             b = cfg.batch_size
             n = max((examples.size // b) * b, 0)
-            loss_sum, nb = 0.0, 0
+            # loss accumulates ON DEVICE; one host readback per block, not
+            # one per minibatch (each readback is a full dispatch round-trip)
+            loss_acc, nb = jnp.zeros(()), 0
             for i in range(0, n, b):
                 sl = slice(i, i + b)
                 if cfg.cbow:
@@ -468,7 +470,7 @@ class WordEmbedding:
                                         jnp.int32),
                             jnp.asarray(remap[prep["negs"][sl]], jnp.int32))
                 win_l, wsec_l, loss = step(win_l, wsec_l, *head, *tail)
-                loss_sum, nb = loss_sum + float(loss), nb + 1
+                loss_acc, nb = loss_acc + loss, nb + 1
             # AddDeltaParameter: (new - old) / workers
             # (ref communicator.cpp:144-236)
             with monitor("we.push"):
@@ -480,7 +482,7 @@ class WordEmbedding:
                                            d_sec[:-1])  # drop dummy row
                 else:
                     self.table_out.add_rows(prep["vocab"], d_sec)
-            return loss_sum / max(nb, 1)
+            return float(loss_acc) / max(nb, 1)
 
     def _ps_topology(self) -> Tuple[int, int]:
         """(num_workers, worker_id) of the PS plane in use: the async

@@ -262,6 +262,31 @@ def _perf(sparse: bool) -> None:
     mv.shutdown()
 
 
+def test_async() -> None:
+    """Uncoordinated async-PS plane (no reference analogue in Test/main.cpp
+    — the reference could only exercise async through full apps; here the
+    plane is its own battery entry): per-worker disjoint row sets at
+    per-worker rates over PSService shards, plus hash-sharded KV."""
+    mv = _init()
+    rank, world = mv.rank(), mv.size()
+    t = mv.AsyncMatrixTable(8 * max(world, 1), 4, name="harness_async")
+    kv = mv.AsyncKVTable(name="harness_async_kv")
+    my_rows = np.arange(8) * max(world, 1) + rank
+    for i in range(rank + 1):
+        t.add_rows(my_rows, np.ones((8, 4), np.float32))
+        kv.add([rank], [1.0])
+    t.flush()
+    mv.barrier()   # determinism fence for the asserts, not the plane
+    got = t.get_rows(np.arange(8 * max(world, 1)))
+    total = float(got.sum())
+    expect = sum((r + 1) for r in range(world)) * 8 * 4
+    assert total == expect, (total, expect)
+    counts = kv.get()
+    assert counts == {r: float(r + 1) for r in range(world)}, counts
+    log.info("async: %d workers, row mass %.0f, kv %s", world, total, counts)
+    mv.shutdown()
+
+
 def test_dense_perf() -> None:
     _perf(sparse=False)
 
@@ -279,12 +304,13 @@ _TESTS = {
     "checkpoint": lambda: test_checkpoint(False),
     "restore": lambda: test_checkpoint(True),
     "allreduce": test_allreduce,
+    "async": test_async,
     "dense_perf": test_dense_perf,
     "sparse_perf": test_sparse_perf,
 }
-# the Docker CI battery order (deploy/docker/Dockerfile)
+# the Docker CI battery order (deploy/docker/Dockerfile) + the async plane
 _ALL = ["kv", "array", "net", "ip", "matrix", "checkpoint", "restore",
-        "allreduce"]
+        "allreduce", "async"]
 
 
 def _spawn_cluster(cmd: str, nprocs: int, extra: List[str]) -> int:

@@ -32,11 +32,14 @@ traffic to live shards proceeds (the elastic story the reference lacked).
 from multiverso_tpu.ps.service import (PSContext, PSError, PSPeerError,
                                        PSService, default_context,
                                        reset_default_context)
-from multiverso_tpu.ps.tables import (AsyncArrayTable, AsyncKVTable,
-                                      AsyncMatrixTable)
+from multiverso_tpu.ps.tables import (AsyncArrayTable, AsyncArrayTableOption,
+                                      AsyncKVTable, AsyncMatrixTable,
+                                      AsyncMatrixTableOption,
+                                      AsyncSparseMatrixTable)
 
 __all__ = [
-    "AsyncArrayTable", "AsyncKVTable", "AsyncMatrixTable",
+    "AsyncArrayTable", "AsyncArrayTableOption", "AsyncKVTable",
+    "AsyncMatrixTable", "AsyncMatrixTableOption", "AsyncSparseMatrixTable",
     "PSContext", "PSError", "PSPeerError", "PSService",
     "default_context", "reset_default_context",
 ]

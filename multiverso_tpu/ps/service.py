@@ -42,6 +42,7 @@ import numpy as np
 
 from multiverso_tpu.ps import wire
 from multiverso_tpu.utils import config, log
+from multiverso_tpu.utils.dashboard import monitor
 
 # message types (request side; replies reuse the id space below 0x100)
 MSG_REPLY_OK = 1
@@ -309,7 +310,10 @@ class PSService:
                     continue
                 try:
                     handler = self._wait_handler(meta["table"])
-                    rmeta, rarrays = handler(msg_type, meta, arrays)
+                    # server-side Dashboard visibility (ref MONITOR_BEGIN
+                    # around Server::ProcessAdd/Get, src/server.cpp:37-45)
+                    with monitor(f"ps[{meta['table']}].serve"):
+                        rmeta, rarrays = handler(msg_type, meta, arrays)
                     with send_lock:
                         wire.send(conn, MSG_REPLY_OK, msg_id, rmeta, rarrays)
                 except Exception as e:  # reply errors, don't kill the conn

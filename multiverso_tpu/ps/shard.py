@@ -37,7 +37,15 @@ class RowShard:
     def __init__(self, lo: int, hi: int, num_col: int, dtype,
                  updater: Updater, name: str,
                  init: Optional[np.ndarray] = None,
-                 seed: Optional[int] = None, init_scale: float = 0.0):
+                 seed: Optional[int] = None, init_scale: float = 0.0,
+                 num_workers: int = 0):
+        """``num_workers > 0`` enables per-worker dirty-bit tracking for the
+        sparse stale-row protocol (ref src/table/matrix.cpp:432-572 — the
+        reference's ASYNC server kept up_to_date_[worker][row] bits; a
+        sparse Get returns only rows stale for the asking worker and an Add
+        marks its rows stale for everyone). Bits live host-side on the
+        owner: they are control metadata consulted per request, not tensor
+        math."""
         self.lo, self.hi = int(lo), int(hi)
         self.n = self.hi - self.lo
         self.num_col = int(num_col)
@@ -60,6 +68,10 @@ class RowShard:
         self._ustate = updater.init_state(self._padded, self.dtype)
         self._lock = threading.Lock()
         self._jit: Dict[Any, Any] = {}
+        # dirty[worker, local_row]: starts all-True so a worker's first
+        # sparse Get pulls everything (ref matrix.cpp up_to_date_ = false)
+        self._dirty = (np.ones((num_workers, self.n), bool)
+                       if num_workers > 0 else None)
 
     # ------------------------------------------------------------------ #
     @property
@@ -156,7 +168,34 @@ class RowShard:
             with self._lock:
                 self._data, self._ustate = self._row_update_fn(ids.size)(
                     self._data, self._ustate, ids, vals, opt)
+                if self._dirty is not None:
+                    self._dirty[:, ids[:k]] = True   # stale for everyone
             return {}, []
+        if msg_type == svc.MSG_GET_ROWS and meta.get("sparse"):
+            # stale-only reply for meta["worker_id"] (ref matrix.cpp
+            # :475-483 GetOption.worker_id + :540-572 stale filter)
+            wid = int(meta.get("worker_id", 0))
+            local = np.asarray(arrays[0], np.int64) - self.lo
+            if np.any((local < 0) | (local >= self.n)):
+                raise IndexError(f"row ids outside shard of {self.name}")
+            with self._lock:
+                if self._dirty is None:
+                    raise svc.PSError(
+                        f"{self.name} was not created with num_workers; "
+                        "sparse gets need dirty-bit tracking")
+                mask = self._dirty[wid, local].copy()
+                self._dirty[wid, local] = False
+                stale = local[mask]
+                if stale.size:
+                    b = _bucket_size(stale.size, self.n + 1)
+                    padded = np.concatenate(
+                        [stale, np.full(b - stale.size, self.scratch,
+                                        np.int64)]).astype(np.int32)
+                    rows = np.asarray(self._get_fn(b)(
+                        self._data, padded))[: stale.size]
+                else:
+                    rows = np.zeros((0, self.num_col), self.dtype)
+            return {}, [mask, rows]
         if msg_type == svc.MSG_GET_ROWS:
             ids, k = self._localize(arrays[0])
             # gather + host transfer stay under the lock: adds donate (and
@@ -173,6 +212,8 @@ class RowShard:
             vals = np.asarray(arrays[1], self.dtype)[:k]
             with self._lock:
                 self._data = self._data.at[ids[:k]].set(jnp.asarray(vals))
+                if self._dirty is not None:
+                    self._dirty[:, ids[:k]] = True
             return {}, []
         if msg_type == svc.MSG_ADD_FULL:
             opt = AddOption(**meta.get("opt", {}))
@@ -184,6 +225,8 @@ class RowShard:
                 self._data, self._ustate = self._full_update_fn()(
                     self._data, self._ustate, jnp.asarray(padded),
                     opt)
+                if self._dirty is not None:
+                    self._dirty[:] = True
             return {}, []
         if msg_type == svc.MSG_GET_FULL:
             with self._lock:   # same donation race as MSG_GET_ROWS

@@ -104,6 +104,14 @@ class _AsyncBase:
         for mid in ids:
             self.wait(mid)
 
+    def _zoo_dirty(self) -> None:
+        """Mutating ops register with the Zoo's dirty set so a
+        single-process ``mv.barrier()`` fences this table's local shard
+        (raw()) like every other table's."""
+        if getattr(self, "table_id", None) is not None:
+            from multiverso_tpu.zoo import Zoo
+            Zoo.get().mark_dirty(self.table_id)
+
 
 class AsyncMatrixTable(_AsyncBase):
     """Row-partitioned 2-D async table (ref MatrixTable in async mode)."""
@@ -113,7 +121,11 @@ class AsyncMatrixTable(_AsyncBase):
                  name: str = "async_matrix",
                  init: Optional[np.ndarray] = None,
                  seed: Optional[int] = None, init_scale: float = 0.0,
+                 shard_workers: int = 0,
                  ctx: Optional[svc.PSContext] = None):
+        """``shard_workers > 0`` enables per-worker dirty-bit tracking on
+        the owned shard (the sparse stale-row protocol; set by
+        AsyncSparseMatrixTable)."""
         super().__init__(ctx, name)
         self.num_row, self.num_col = int(num_row), int(num_col)
         self.shape = (self.num_row, self.num_col)
@@ -129,7 +141,8 @@ class AsyncMatrixTable(_AsyncBase):
                           if init is not None else None)
             self._shard = RowShard(lo, hi, self.num_col, self.dtype,
                                    self.updater, name, init=shard_init,
-                                   seed=seed, init_scale=init_scale)
+                                   seed=seed, init_scale=init_scale,
+                                   num_workers=shard_workers)
             self.ctx.service.register_handler(name, self._shard.handle)
         else:
             self._shard = None
@@ -174,6 +187,7 @@ class AsyncMatrixTable(_AsyncBase):
     def add_rows_async(self, row_ids, values,
                        opt: Optional[AddOption] = None) -> int:
         opt = opt or AddOption(worker_id=self.ctx.rank)
+        self._zoo_dirty()
         with monitor(f"table[{self.name}].add_rows"):
             uids, vals, _ = self._prep(row_ids, values)
             meta = {"table": self.name, "opt": opt._asdict()}
@@ -222,6 +236,7 @@ class AsyncMatrixTable(_AsyncBase):
         """Overwrite rows (load/master-init plumbing; no updater).
         Duplicate ids are ill-defined for an overwrite, so ids must be
         unique (checkpoint load passes ranges)."""
+        self._zoo_dirty()
         ids = np.asarray(row_ids, np.int64).reshape(-1)
         vals = np.asarray(values, self.dtype).reshape(-1, self.num_col)
         if vals.shape[0] != ids.size:
@@ -243,6 +258,7 @@ class AsyncMatrixTable(_AsyncBase):
     # ------------------------------------------------------------------ #
     def add_async(self, delta, opt: Optional[AddOption] = None) -> int:
         opt = opt or AddOption(worker_id=self.ctx.rank)
+        self._zoo_dirty()
         with monitor(f"table[{self.name}].add"):
             delta = np.asarray(delta, self.dtype).reshape(self.shape)
             meta = {"table": self.name, "opt": opt._asdict()}
@@ -289,6 +305,77 @@ class AsyncMatrixTable(_AsyncBase):
             raise ValueError(f"checkpoint shape {data.shape} != {self.shape}")
         for r, a, b in self._ranges:
             self.set_rows(np.arange(a, b), data[a:b])
+
+
+class AsyncSparseMatrixTable(AsyncMatrixTable):
+    """Stale-row protocol on the uncoordinated plane (ref src/table/
+    matrix.cpp:432-572 — the reference's async server's sparse mode):
+    ``get_rows_sparse(ids, worker_id)`` transfers ONLY the rows that
+    changed since this worker last pulled them; fresh rows come from the
+    worker-side row cache. Dirty bits live on each owning shard, per
+    worker — exactly the ``up_to_date_[worker][row]`` bookkeeping."""
+
+    def __init__(self, num_row: int, num_col: int, dtype=np.float32,
+                 updater=None, name: str = "async_sparse_matrix",
+                 init=None, seed=None, init_scale: float = 0.0,
+                 num_workers: Optional[int] = None,
+                 ctx: Optional[svc.PSContext] = None):
+        ctx = ctx if ctx is not None else svc.default_context()
+        self._n_workers = num_workers or max(ctx.world, 1)
+        super().__init__(num_row, num_col, dtype=dtype, updater=updater,
+                         name=name, init=init, seed=seed,
+                         init_scale=init_scale,
+                         shard_workers=self._n_workers, ctx=ctx)
+        self._caches: Dict[int, Any] = {}
+        self.last_transfer_rows = -1   # diagnostic: rows over the wire
+
+    def _worker_cache(self, worker_id: int):
+        from multiverso_tpu.tables.sparse_matrix_table import _RowCache
+        if not (0 <= worker_id < self._n_workers):
+            raise IndexError(f"worker_id {worker_id} out of range "
+                             f"[0, {self._n_workers})")
+        cache = self._caches.get(worker_id)
+        if cache is None:
+            cache = self._caches[worker_id] = _RowCache(self.num_col,
+                                                        self.dtype)
+        return cache
+
+    def get_rows_sparse(self, row_ids, worker_id: Optional[int] = None
+                        ) -> np.ndarray:
+        worker_id = self.ctx.rank if worker_id is None else worker_id
+        cache = self._worker_cache(worker_id)
+        with monitor(f"table[{self.name}].get_rows_sparse"):
+            uids, _, inv = self._prep(row_ids)
+            parts = list(self._by_owner(uids))
+            meta = {"table": self.name, "sparse": True,
+                    "worker_id": int(worker_id)}
+            futs = [self.ctx.service.request(r, svc.MSG_GET_ROWS, meta,
+                                             [uids[m]])
+                    for r, m in parts]
+            timeout = config.get_flag("ps_timeout")
+            transferred = 0
+            for (r, m), f in zip(parts, futs):
+                _, (mask, rows) = f.result(timeout=timeout)
+                stale = uids[m][mask.astype(bool)]
+                if stale.size:
+                    cache.put(stale, rows)
+                    transferred += int(stale.size)
+            try:
+                out = cache.take(uids)
+            except KeyError:
+                # self-healing: a previous sparse get cleared dirty bits on
+                # the server but its reply was lost (timeout/conn drop), so
+                # some "fresh" rows were never cached. Re-pull the gap with
+                # a plain (non-sparse) get. The reference had the same
+                # window and no recovery (matrix.cpp clears up_to_date_
+                # before the reply crosses MPI).
+                _, found = cache._locate(uids)
+                missing = uids[~found]
+                cache.put(missing, self.get_rows(missing))
+                transferred += int(missing.size)
+                out = cache.take(uids)
+            self.last_transfer_rows = transferred
+            return out[inv]
 
 
 class AsyncArrayTable(_AsyncBase):
@@ -344,6 +431,36 @@ class AsyncArrayTable(_AsyncBase):
         data = np.load(stream).reshape(self.size, 1)
         for r, a, b in self._m._ranges:
             self._m.set_rows(np.arange(a, b), data[a:b])
+
+
+class AsyncMatrixTableOption:
+    """ref DEFINE_TABLE_TYPE option parity for ``mv.create_table`` on the
+    uncoordinated plane."""
+
+    def __init__(self, num_row: int, num_col: int, dtype=np.float32,
+                 updater=None, init=None, seed=None,
+                 init_scale: float = 0.0):
+        self.num_row, self.num_col = num_row, num_col
+        self.dtype, self.updater = dtype, updater
+        self.init, self.seed, self.init_scale = init, seed, init_scale
+
+    def build(self, name: str = "async_matrix") -> "AsyncMatrixTable":
+        return AsyncMatrixTable(self.num_row, self.num_col,
+                                dtype=self.dtype, updater=self.updater,
+                                name=name, init=self.init, seed=self.seed,
+                                init_scale=self.init_scale)
+
+
+class AsyncArrayTableOption:
+    def __init__(self, size: int, dtype=np.float32, updater=None,
+                 init=None):
+        self.size, self.dtype, self.updater, self.init = (size, dtype,
+                                                          updater, init)
+
+    def build(self, name: str = "async_array") -> "AsyncArrayTable":
+        return AsyncArrayTable(self.size, dtype=self.dtype,
+                               updater=self.updater, name=name,
+                               init=self.init)
 
 
 class AsyncKVTable(_AsyncBase):

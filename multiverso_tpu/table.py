@@ -213,6 +213,7 @@ class Table:
 
     def adopt(self, state: Dict[str, Any]) -> None:
         """Commit an externally-advanced table state (end of in-graph loop)."""
+        self._zoo.mark_dirty(self.table_id)
         self._data = state["data"]
         self._ustate = state["ustate"]
 
@@ -346,6 +347,7 @@ class Table:
                   opt: Optional[AddOption] = None) -> int:
         """ref WorkerTable::AddAsync — dispatch the update, return a msg id."""
         opt = opt or AddOption()
+        self._zoo.mark_dirty(self.table_id)
         with monitor(f"table[{self.name}].add"), self._dispatch_lock:
             if (self._wire != "none" and not isinstance(delta, jax.Array)):
                 return self._add_async_wire(delta, opt)
@@ -449,6 +451,7 @@ class Table:
             np.save(stream, self._to_host(leaf), allow_pickle=False)
 
     def load(self, stream) -> None:
+        self._zoo.mark_dirty(self.table_id)
         data = np.load(stream)
         if data.shape != self._padded_shape:
             raise ValueError(

@@ -223,6 +223,7 @@ class MatrixTable(Table):
     def add_rows_async(self, row_ids, values,
                        opt: Optional[AddOption] = None) -> int:
         opt = opt or AddOption()
+        self._zoo.mark_dirty(self.table_id)
         with monitor(f"table[{self.name}].add_rows"), self._dispatch_lock:
             ids, vals, _, _ = self._prep_ids(row_ids, values)
             if self._zoo.size() > 1:

@@ -42,6 +42,7 @@ class Zoo:
         self._tables: Dict[int, Any] = {}
         self._next_table_id = 0
         self._barrier_count = 0
+        self._dirty: set = set()   # table_ids with ops since last barrier
 
     # ------------------------------------------------------------------ #
     # lifecycle
@@ -143,6 +144,13 @@ class Zoo:
     # ------------------------------------------------------------------ #
     # barrier (ref Zoo::Barrier, src/zoo.cpp:165-177 — controller round trip)
     # ------------------------------------------------------------------ #
+    def mark_dirty(self, table_id: int) -> None:
+        """Table ops call this; the next single-process barrier fences only
+        tables with activity since the last one (a battery that barriers
+        per block with many tables would otherwise pay O(tables) blocking
+        syncs per barrier)."""
+        self._dirty.add(table_id)
+
     def barrier(self) -> None:
         self._barrier_count += 1
         if jax.process_count() > 1:
@@ -150,10 +158,12 @@ class Zoo:
             multihost_utils.sync_global_devices(
                 f"multiverso_tpu_barrier_{self._barrier_count}")
         else:
-            # Single controller: block on every registered table's live arrays
-            # so the barrier has the reference's "all prior Adds are visible"
-            # fence semantics on every device in the mesh.
-            for table in self._tables.values():
+            # Single controller: block on the live arrays of every table
+            # TOUCHED since the last barrier, giving the reference's "all
+            # prior Adds are visible" fence without fencing idle tables.
+            dirty, self._dirty = self._dirty, set()
+            for table_id in dirty:
+                table = self._tables.get(table_id)
                 raw = getattr(table, "raw", None)
                 if callable(raw):
                     value = raw()

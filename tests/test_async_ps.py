@@ -16,7 +16,8 @@ from multiverso_tpu.ps import wire
 from multiverso_tpu.ps.service import (FileRendezvous, PSContext, PSPeerError,
                                        PSService)
 from multiverso_tpu.ps.tables import (AsyncArrayTable, AsyncKVTable,
-                                      AsyncMatrixTable)
+                                      AsyncMatrixTable,
+                                      AsyncSparseMatrixTable)
 from multiverso_tpu.updaters import AdaGradUpdater, AddOption
 
 
@@ -184,6 +185,63 @@ class TestAsyncMatrixTable:
             t0.get_rows([0.5])
         with pytest.raises(ValueError):
             t0.get_rows([])
+
+
+class TestAsyncSparse:
+    """Stale-row protocol on the uncoordinated plane (ref matrix.cpp
+    :432-572: the reference async server's sparse mode)."""
+
+    def test_stale_only_transfer(self, two_ranks):
+        ts = [AsyncSparseMatrixTable(10, 4, name="sp", num_workers=2,
+                                     ctx=two_ranks[r]) for r in range(2)]
+        ids = np.arange(10)
+        # first pull: everything is stale -> all 10 rows cross the wire
+        rows = ts[0].get_rows_sparse(ids, worker_id=0)
+        assert ts[0].last_transfer_rows == 10
+        np.testing.assert_allclose(rows, 0.0)
+        # nothing changed: second pull transfers NOTHING
+        rows = ts[0].get_rows_sparse(ids, worker_id=0)
+        assert ts[0].last_transfer_rows == 0
+        # worker 1 (via the other client) is tracked independently
+        rows1 = ts[1].get_rows_sparse(ids, worker_id=1)
+        assert ts[1].last_transfer_rows == 10
+        # a remote add dirties exactly its rows for worker 0
+        ts[1].add_rows([2, 7], np.ones((2, 4), np.float32))
+        rows = ts[0].get_rows_sparse(ids, worker_id=0)
+        assert ts[0].last_transfer_rows == 2
+        np.testing.assert_allclose(rows[2], 1.0)
+        np.testing.assert_allclose(rows[7], 1.0)
+        np.testing.assert_allclose(rows[3], 0.0)
+
+    def test_sparse_needs_num_workers(self, two_ranks):
+        t = AsyncMatrixTable(6, 2, name="nosp", ctx=two_ranks[0])
+        AsyncMatrixTable(6, 2, name="nosp", ctx=two_ranks[1])
+        from multiverso_tpu.ps.service import PSError
+        with pytest.raises(PSError):
+            # plain table has no dirty bits; typed error end-to-end
+            t.ctx.service.request(
+                0, 0x12, {"table": "nosp", "sparse": True,
+                          "worker_id": 0},
+                [np.array([0], np.int64)]).result(timeout=10)
+
+
+class TestCreateTableParity:
+    def test_options_via_create_table(self):
+        """Async tables ride the same MV_CreateTable option surface as the
+        collective tables (single-process default context)."""
+        import multiverso_tpu as mv
+        mv.init()
+        try:
+            from multiverso_tpu.ps import (AsyncArrayTableOption,
+                                           AsyncMatrixTableOption)
+            t = mv.create_table(AsyncMatrixTableOption(6, 3), name="opt_m")
+            t.add_rows([1], np.ones((1, 3), np.float32))
+            np.testing.assert_allclose(t.get_row(1), 1.0)
+            a = mv.create_table(AsyncArrayTableOption(8), name="opt_a")
+            a.add(np.arange(8, dtype=np.float32))
+            np.testing.assert_allclose(a.get(), np.arange(8))
+        finally:
+            mv.shutdown()
 
 
 class TestAsyncKV:

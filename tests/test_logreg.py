@@ -50,6 +50,32 @@ def test_ps_file_path_converges(tmp_path):
     assert acc > 0.9, f"accuracy {acc}, stats {stats}"
 
 
+def test_async_ps_path_converges(tmp_path):
+    """The LR app on the uncoordinated async plane (-async_ps): same
+    use_ps host loop, deltas land on owning shards as they arrive (ref
+    src/server.cpp:36-58 default async server mode)."""
+    x, y = model_lib.synthetic_dataset(1024, 10, 2, seed=6)
+    train = tmp_path / "train.svm"
+    with open(train, "w") as f:
+        for xi, yi in zip(x, y):
+            feats = " ".join(f"{j}:{v:.5f}" for j, v in enumerate(xi))
+            f.write(f"{yi} {feats}\n")
+    cfg = _cfg(input_size=10, output_size=2, train_file=str(train),
+               test_file=str(train), train_epoch=2, sync_frequency=1,
+               async_ps="true")
+    lr = LogReg(cfg)
+    lr.train_file()
+    acc = lr.test_file()
+    assert acc > 0.9, f"accuracy {acc}"
+    # the fused path is functional-plane-only: typed error, not a crash
+    with pytest.raises(ValueError, match="async_ps"):
+        lr.train_arrays(x, y)
+    # sparse + async is a typed config error
+    with pytest.raises(ValueError, match="async_ps"):
+        LogRegConfig(dict(input_size="10", sparse="true",
+                          async_ps="true"))
+
+
 def test_pipeline_and_sync_frequency(tmp_path):
     x, y = model_lib.synthetic_dataset(512, 10, 2, seed=4)
     train = tmp_path / "train.svm"
