@@ -237,23 +237,35 @@ def bench_array_table(size: int = 1_000_000, iters: int = 10):
 
     # wire-compressed plane (ref quantization_util.h filters on the MPI
     # wire; here the tunnel/PCIe wire): bf16 halves the payload, 1bit
-    # sends sign bits + block scales with error feedback
-    wf = {}
+    # sends sign bits + block scales with error feedback. Measured
+    # INTERLEAVED with a plain table so tunnel-load drift between runs
+    # cannot masquerade as a filter effect — compare the *_vs_plain ratios.
+    tables = {"plain": t}
     for mode in ("bf16", "1bit"):
-        tw = mv.ArrayTable(size, updater="sgd", name=f"bench_array_{mode}",
-                           wire_filter=mode)
-        tw.add(delta, opt)
-        tw.get()
-        wadds, wgets = [], []
-        for _ in range(max(iters // 2, 4)):
+        tables[mode] = mv.ArrayTable(size, updater="sgd",
+                                     name=f"bench_array_{mode}",
+                                     wire_filter=mode)
+        tables[mode].add(delta, opt)   # compile
+        tables[mode].get()
+    samples = {k: {"add": [], "get": []} for k in tables}
+    for _ in range(max(iters // 2, 5)):
+        for k, tw in tables.items():   # back-to-back: shared conditions
             t0 = time.perf_counter()
             tw.add(delta, opt)
-            wadds.append(time.perf_counter() - t0)
+            samples[k]["add"].append(time.perf_counter() - t0)
             t0 = time.perf_counter()
             tw.get()
-            wgets.append(time.perf_counter() - t0)
-        wf[mode] = {"add_p50_ms": _percentile_ms(wadds),
-                    "get_p50_ms": _percentile_ms(wgets)}
+            samples[k]["get"].append(time.perf_counter() - t0)
+    plain_add = _percentile_ms(samples["plain"]["add"])
+    plain_get = _percentile_ms(samples["plain"]["get"])
+    wf = {"plain_interleaved": {"add_p50_ms": plain_add,
+                                "get_p50_ms": plain_get}}
+    for mode in ("bf16", "1bit"):
+        am = _percentile_ms(samples[mode]["add"])
+        gm = _percentile_ms(samples[mode]["get"])
+        wf[mode] = {"add_p50_ms": am, "get_p50_ms": gm,
+                    "add_vs_plain": round(plain_add / am, 3),
+                    "get_vs_plain": round(plain_get / gm, 3)}
     # device plane: delta already resident (the real TPU deployment shape —
     # grads are produced on device; host numbers above are tunnel-bound)
     import jax

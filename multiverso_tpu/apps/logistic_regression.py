@@ -96,6 +96,11 @@ class LogRegConfig:
             raise ValueError("async_ps covers the dense path; the sparse "
                              "stale-row protocol lives on the collective "
                              "plane (use sparse=true without async_ps)")
+        if self.async_ps and self.mnist_dir:
+            raise ValueError("async_ps trains through the use_ps host loop "
+                             "(train_file=...); the mnist_dir route uses "
+                             "the fused in-graph path, which async tables "
+                             "do not expose")
 
     @classmethod
     def from_file(cls, path: str) -> "LogRegConfig":

@@ -137,6 +137,15 @@ class RowShard:
                 lambda data, ids: jnp.take(data, ids, axis=0))
         return fn
 
+    def _pad_to_bucket(self, local: np.ndarray) -> np.ndarray:
+        """Pad a local-id batch to its power-of-two bucket with the scratch
+        row (the one shape-discipline rule, shared by every row path)."""
+        b = _bucket_size(local.size, self.n + 1)
+        if b > local.size:
+            local = np.concatenate(
+                [local, np.full(b - local.size, self.scratch, np.int64)])
+        return local.astype(np.int32)
+
     def _localize(self, ids: np.ndarray) -> Tuple[np.ndarray, int]:
         """Global ids -> bucket-padded local ids (+ true count)."""
         local = np.asarray(ids, np.int64) - self.lo
@@ -144,12 +153,7 @@ class RowShard:
             raise IndexError(
                 f"row ids outside shard [{self.lo}, {self.hi}) of "
                 f"{self.name}")
-        k = local.size
-        b = _bucket_size(k, self.n + 1)
-        if b > k:
-            local = np.concatenate(
-                [local, np.full(b - k, self.scratch, np.int64)])
-        return local.astype(np.int32), k
+        return self._pad_to_bucket(local), local.size
 
     # ------------------------------------------------------------------ #
     # request handler (runs on service connection threads)
@@ -176,7 +180,7 @@ class RowShard:
             # :475-483 GetOption.worker_id + :540-572 stale filter)
             wid = int(meta.get("worker_id", 0))
             local = np.asarray(arrays[0], np.int64) - self.lo
-            if np.any((local < 0) | (local >= self.n)):
+            if local.size == 0 or np.any((local < 0) | (local >= self.n)):
                 raise IndexError(f"row ids outside shard of {self.name}")
             with self._lock:
                 if self._dirty is None:
@@ -187,11 +191,8 @@ class RowShard:
                 self._dirty[wid, local] = False
                 stale = local[mask]
                 if stale.size:
-                    b = _bucket_size(stale.size, self.n + 1)
-                    padded = np.concatenate(
-                        [stale, np.full(b - stale.size, self.scratch,
-                                        np.int64)]).astype(np.int32)
-                    rows = np.asarray(self._get_fn(b)(
+                    padded = self._pad_to_bucket(stale)
+                    rows = np.asarray(self._get_fn(padded.size)(
                         self._data, padded))[: stale.size]
                 else:
                     rows = np.zeros((0, self.num_col), self.dtype)
