@@ -359,6 +359,13 @@ class WordEmbedding:
             losses.append(self._train_prepared(prepared, nw))
             words += block.size
             prepared = nxt
+        # drain in-flight async pushes so the trained state is durable
+        # before the caller reads embeddings (sync tables order by program
+        # order; async tables need the explicit flush)
+        for t in (self.table_in, self.table_out,
+                  getattr(self, "table_hs", None)):
+            if t is not None and hasattr(t, "flush"):
+                t.flush()
         dt = time.perf_counter() - t0
         self._trained_words += words
         self.word_count.add([0], [words])
@@ -471,17 +478,20 @@ class WordEmbedding:
                             jnp.asarray(remap[prep["negs"][sl]], jnp.int32))
                 win_l, wsec_l, loss = step(win_l, wsec_l, *head, *tail)
                 loss_acc, nb = loss_acc + loss, nb + 1
-            # AddDeltaParameter: (new - old) / workers
-            # (ref communicator.cpp:144-236)
+            # AddDeltaParameter: (new - old) / workers, pushed ASYNC like
+            # the reference (ref communicator.cpp:144-236 AddAsync) — the
+            # push overlaps the next block's prep/compute. Ordering is
+            # safe: sync tables dispatch in program order, and on the
+            # async plane arrival-order accumulation is the semantics.
             with monitor("we.push"):
                 d_in = np.asarray(win_l - old_in) / num_workers
-                self.table_in.add_rows(prep["vocab"], d_in)
+                self.table_in.add_rows_async(prep["vocab"], d_in)
                 d_sec = np.asarray(wsec_l - old_sec) / num_workers
                 if cfg.hs:
-                    self.table_hs.add_rows(prep["hs_rows"],
-                                           d_sec[:-1])  # drop dummy row
+                    self.table_hs.add_rows_async(prep["hs_rows"],
+                                                 d_sec[:-1])  # drop dummy
                 else:
-                    self.table_out.add_rows(prep["vocab"], d_sec)
+                    self.table_out.add_rows_async(prep["vocab"], d_sec)
             return float(loss_acc) / max(nb, 1)
 
     def _ps_topology(self) -> Tuple[int, int]:

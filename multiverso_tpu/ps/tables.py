@@ -60,6 +60,10 @@ class _AsyncBase:
         self._pending: Dict[int, Tuple[List[cf.Future], Any]] = {}
         self._next_msg_id = 0
         self._lock = threading.Lock()
+        # failures of already-swept fire-and-forget ops, kept so flush()
+        # can surface them deterministically (sweep timing must not decide
+        # whether a lost delta is seen)
+        self._swept_failures: List[Exception] = []
 
     def _track(self, futures: List[cf.Future], finalize=None) -> int:
         with self._lock:
@@ -77,6 +81,8 @@ class _AsyncBase:
                     if exc is not None:
                         log.error("table[%s]: fire-and-forget op %d "
                                   "failed: %s", self.name, mid, exc)
+                        if len(self._swept_failures) < 100:
+                            self._swept_failures.append(exc)
             msg_id = self._next_msg_id
             self._next_msg_id += 1
             self._pending[msg_id] = (futures, finalize)
@@ -98,11 +104,19 @@ class _AsyncBase:
 
     def flush(self) -> None:
         """Wait for every outstanding op on this table (this worker only —
-        NOT a barrier; peers are unaffected)."""
+        NOT a barrier; peers are unaffected). Raises the first failure of
+        any fire-and-forget op issued since the last flush, whether it is
+        still pending or was already swept — a lost delta is reported
+        deterministically, not only when sweep timing happens to expose
+        it."""
         with self._lock:
             ids = list(self._pending)
         for mid in ids:
             self.wait(mid)
+        with self._lock:
+            failures, self._swept_failures = self._swept_failures, []
+        if failures:
+            raise failures[0]
 
     def _zoo_dirty(self) -> None:
         """Mutating ops register with the Zoo's dirty set so a

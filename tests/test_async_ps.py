@@ -307,6 +307,35 @@ class TestFailureSemantics:
             for c in ctxs:
                 c.close()
 
+    def test_flush_surfaces_swept_failures_deterministically(self, tmp_path):
+        """A fire-and-forget push to a dead shard must be reported by the
+        NEXT flush even if the sweep already logged-and-dropped it — a
+        training loop pushing async and flushing at the end (the WE block
+        path) gets a deterministic error, never silent delta loss."""
+        from multiverso_tpu.utils import config
+        config.set_flag("ps_timeout", 5.0)
+        config.set_flag("ps_connect_timeout", 5.0)
+        rdv = FileRendezvous(str(tmp_path / "rdv"))
+        ctxs = [PSContext(r, 2, PSService(r, 2, rdv)) for r in range(2)]
+        try:
+            t0 = AsyncMatrixTable(10, 2, name="sf", ctx=ctxs[0])
+            AsyncMatrixTable(10, 2, name="sf", ctx=ctxs[1])
+            t0.add_rows([9], np.ones((1, 2), np.float32))
+            ctxs[1].close()
+            time.sleep(0.1)
+            t0.add_rows_async([8], np.ones((1, 2), np.float32))  # will fail
+            time.sleep(0.3)
+            # trigger sweeps so the failed op is popped before the flush
+            for _ in range(3):
+                t0.add_rows([1], np.ones((1, 2), np.float32))
+            with pytest.raises(PSPeerError):
+                t0.flush()
+            t0.flush()   # failure consumed; table stays usable
+            np.testing.assert_allclose(t0.get_rows([1])[0], 3.0)
+        finally:
+            for c in ctxs:
+                c.close()
+
     def test_failed_fire_and_forget_does_not_poison_table(self, tmp_path):
         """A dead shard's unawaited add is logged, not re-raised: later ops
         on live shards keep working (the elasticity contract)."""
