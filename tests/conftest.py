@@ -44,6 +44,20 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.slow)
 
 
+@pytest.fixture
+def two_ranks(tmp_path):
+    """Two async-PS contexts sharing a file rendezvous — a 2-rank world in
+    one process; every cross-rank op crosses a real localhost socket. The
+    single-process tier-2 fixture for the uncoordinated plane."""
+    from multiverso_tpu.ps.service import (FileRendezvous, PSContext,
+                                           PSService)
+    rdv = FileRendezvous(str(tmp_path / "rdv"))
+    ctxs = [PSContext(r, 2, PSService(r, 2, rdv)) for r in range(2)]
+    yield ctxs
+    for c in ctxs:
+        c.close()
+
+
 @pytest.fixture(autouse=True)
 def _fresh_runtime():
     """Reset flags + Zoo between tests (the reference restarts processes)."""

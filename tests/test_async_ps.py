@@ -21,15 +21,8 @@ from multiverso_tpu.ps.tables import (AsyncArrayTable, AsyncKVTable,
 from multiverso_tpu.updaters import AdaGradUpdater, AddOption
 
 
-@pytest.fixture
-def two_ranks(tmp_path):
-    """Two PSContexts sharing a file rendezvous — a 2-rank world in one
-    process; every remote op crosses a real socket."""
-    rdv = FileRendezvous(str(tmp_path / "rdv"))
-    ctxs = [PSContext(r, 2, PSService(r, 2, rdv)) for r in range(2)]
-    yield ctxs
-    for c in ctxs:
-        c.close()
+# the shared two_ranks fixture lives in conftest.py (used here and by the
+# async-plane LDA test)
 
 
 class TestWire:

@@ -75,3 +75,31 @@ def test_batch_step_counts_are_conserved():
     np.testing.assert_allclose(np.sum(np.asarray(theta), axis=1), 1.0,
                                rtol=1e-5)
     assert np.isfinite(float(ll))
+
+
+def test_recovers_planted_topics_on_async_plane(two_ranks):
+    """The LDA sparse push/pull loop runs UNCHANGED over the uncoordinated
+    plane: two workers, each training its own document subset against
+    AsyncSparseMatrixTable shards (stale-only pulls over real sockets),
+    recover the planted topics — the third app family on the async PS."""
+    from multiverso_tpu.ps.tables import AsyncSparseMatrixTable
+
+    cfg = lda.LDAConfig(vocab_size=400, num_topics=4, doc_len=32,
+                        em_iters=4)
+    tables = [AsyncSparseMatrixTable(
+                  cfg.vocab_size, cfg.num_topics, name="lda_async",
+                  num_workers=2, ctx=two_ranks[r]) for r in range(2)]
+    trainers = [lda.LDATrainer(cfg, tables[r], worker_id=r)
+                for r in range(2)]
+    docs, labels = lda.synthetic_corpus(cfg, 600, seed=3)
+    lls = []
+    for epoch in range(3):
+        for lo in range(0, len(docs), 64):
+            w = (lo // 64) % 2          # alternate batches per worker
+            lls.append(trainers[w].train_batch(docs[lo: lo + 64]))
+    assert np.mean(lls[-5:]) > np.mean(lls[:5]) + 0.1
+    # both workers read the same converged global table
+    for r in range(2):
+        purity = _purity(trainers[r].word_topics(), labels,
+                         cfg.num_topics)
+        assert purity > 0.85, (r, purity)
