@@ -1,0 +1,77 @@
+"""Async-PS plane throughput: two real processes hammering row traffic.
+
+Worker body for bench.bench_async_ps(): rank 0 and rank 1 each own half of
+a (rows, dim) table and push/pull batches of their OWN row sets for a
+fixed duration — uncoordinated, so the measured rate is the plane's
+(serialization + TCP + shard update) throughput, not a collective's.
+
+Invoked as: python tools/bench_async_ps.py <rdv> <world> <rank> <seconds>
+Prints "RESULT {...}" with ops and rows moved.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    rdv_dir, world, rank, seconds = (sys.argv[1], int(sys.argv[2]),
+                                     int(sys.argv[3]), float(sys.argv[4]))
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from multiverso_tpu.ps.service import (FileRendezvous, PSContext,
+                                           PSService)
+    from multiverso_tpu.ps.tables import AsyncMatrixTable
+    from multiverso_tpu.utils import config
+
+    config.set_flag("ps_timeout", 120.0)
+    ctx = PSContext(rank, world,
+                    PSService(rank, world, FileRendezvous(rdv_dir)))
+    rows, dim, batch = 100_000, 128, 1024
+    t = AsyncMatrixTable(rows, dim, name="bench_async", ctx=ctx)
+    rng = np.random.default_rng(rank)
+    # this worker's ids: strided so every batch spans BOTH shards (half
+    # the traffic crosses the socket, half short-circuits — the realistic
+    # mix for world=2)
+    vals = rng.normal(size=(batch, dim)).astype(np.float32)
+
+    def sync_point(tag):
+        open(os.path.join(rdv_dir, f"{tag}.{rank}"), "w").close()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if all(os.path.exists(os.path.join(rdv_dir, f"{tag}.{r}"))
+                   for r in range(world)):
+                return
+            time.sleep(0.01)
+        raise TimeoutError(tag)
+
+    ids = (np.arange(batch) * (rows // batch) + rank) % rows
+    t.add_rows(ids, vals)       # compile both shards' programs
+    t.get_rows(ids)
+    sync_point("warm")
+
+    ops = 0
+    start = time.monotonic()
+    mids = []
+    while time.monotonic() - start < seconds:
+        mids.append(t.add_rows_async(ids, vals))
+        if len(mids) >= 4:      # bounded pipeline depth
+            t.wait(mids.pop(0))
+        t.get_rows(ids)
+        ops += 2
+    for m in mids:
+        t.wait(m)
+    dt = time.monotonic() - start
+    sync_point("done")
+    ctx.close()
+    print("RESULT " + json.dumps({
+        "rank": rank, "ops": ops, "rows": ops * batch, "seconds": dt,
+        "rows_per_sec": ops * batch / dt,
+        "mb_per_sec": ops * batch * dim * 4 / dt / 1e6}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
