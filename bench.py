@@ -210,7 +210,8 @@ def bench_async_ps(seconds: float = 4.0):
     total_rows = sum(r["rows_per_sec"] for r in results)
     return {"rows_per_sec_2workers": total_rows,
             "mb_per_sec_2workers": sum(r["mb_per_sec"] for r in results),
-            "batch_rows": 1024, "dim": 128, "note":
+            "batch_rows": results[0]["batch_rows"],
+            "dim": results[0]["dim"], "note":
             "np=2 CPU processes, add+get interleaved, loopback TCP"}
 
 

@@ -1,0 +1,20 @@
+#!/bin/sh
+# Uncoordinated async-PS demo: 4 OS processes (no JAX coordinator), each
+# training its own data blocks of a shared word2vec corpus against
+# row-sharded async tables — the reference's defining workflow
+# (mpirun -np 4 distributed_wordembedding), rebuilt TPU-native.
+# Mirrors tests/we_async_worker.py, runnable by hand.
+set -e
+cd "$(dirname "$0")/.."
+RDV=$(mktemp -d)
+trap 'rm -rf "$RDV"' EXIT
+PIDS=""
+for RANK in 0 1 2 3; do
+  python tests/we_async_worker.py "$RDV" 4 "$RANK" &
+  PIDS="$PIDS $!"
+done
+# wait per-pid: a bare `wait` always exits 0, hiding worker crashes
+for P in $PIDS; do
+  wait "$P"
+done
+echo "async PS demo: 4 workers done (rendezvous $RDV)"

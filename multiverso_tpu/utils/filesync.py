@@ -1,0 +1,25 @@
+"""File-based rendezvous barrier for coordinating plain OS processes.
+
+Used by the multi-process test/bench workers (the async-PS plane itself
+has NO barriers — this is harness-side coordination, the moral equivalent
+of mpirun's world bring-up around the reference's Test/main.cpp battery).
+Each rank publishes ``<dir>/<tag>.<rank>`` and polls for all ranks.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+
+def file_barrier(directory: str, world: int, rank: int, tag: str,
+                 timeout: float = 120.0, poll: float = 0.01) -> None:
+    open(os.path.join(directory, f"{tag}.{rank}"), "w").close()
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if all(os.path.exists(os.path.join(directory, f"{tag}.{r}"))
+               for r in range(world)):
+            return
+        time.sleep(poll)
+    raise TimeoutError(f"file_barrier {tag!r}: not all of {world} ranks "
+                       f"arrived within {timeout}s")

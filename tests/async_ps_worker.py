@@ -27,15 +27,9 @@ import numpy as np
 
 def _sync_point(rdv_dir, world, rank, tag):
     """Test-harness sync via files (NOT a framework barrier — the plane
-    under test has none): rank writes its marker, then polls for all."""
-    open(os.path.join(rdv_dir, f"{tag}.{rank}"), "w").close()
-    deadline = time.monotonic() + 60
-    while time.monotonic() < deadline:
-        if all(os.path.exists(os.path.join(rdv_dir, f"{tag}.{r}"))
-               for r in range(world)):
-            return
-        time.sleep(0.01)
-    raise TimeoutError(f"sync point {tag} timed out")
+    under test has none); shared helper in utils/filesync."""
+    from multiverso_tpu.utils.filesync import file_barrier
+    file_barrier(rdv_dir, world, rank, tag, timeout=60)
 
 
 def main():

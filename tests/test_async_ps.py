@@ -49,6 +49,32 @@ class TestWire:
             wire.recv(b)
         a.close(), b.close()
 
+    def test_service_survives_garbage_connections(self, two_ranks):
+        """A network-facing server must shrug off malformed frames: random
+        bytes, truncated frames, oversized length fields — the offending
+        connection dies, the service keeps serving real clients."""
+        import socket
+
+        t0 = AsyncMatrixTable(10, 2, name="g", ctx=two_ranks[0])
+        AsyncMatrixTable(10, 2, name="g", ctx=two_ranks[1])
+        host, port = two_ranks[1].service.addr.rsplit(":", 1)
+        rng = np.random.default_rng(0)
+        for payload in (
+                rng.integers(0, 256, 64, dtype=np.uint8).tobytes(),
+                b"MVPS" + bytes(4),                       # truncated header
+                wire.encode(0x11, 1, {"table": "g"})[:10],  # cut mid-frame
+                # huge meta length field: must be rejected, not allocated
+                wire._HEADER.pack(wire.MAGIC, 0x11, 0, 1,
+                                  wire.MAX_META + 1, 0),
+        ):
+            s = socket.create_connection((host, int(port)), timeout=5)
+            s.sendall(payload)
+            s.close()
+        time.sleep(0.2)
+        # the real client plane is unaffected
+        t0.add_rows([9], np.ones((1, 2), np.float32))
+        np.testing.assert_allclose(t0.get_rows([9])[0], 1.0)
+
 
 class TestAsyncMatrixTable:
     def test_different_row_sets_per_worker(self, two_ranks):

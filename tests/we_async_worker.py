@@ -18,14 +18,8 @@ import numpy as np
 
 
 def _sync(rdv_dir, world, rank, tag, timeout=120):
-    open(os.path.join(rdv_dir, f"{tag}.{rank}"), "w").close()
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if all(os.path.exists(os.path.join(rdv_dir, f"{tag}.{r}"))
-               for r in range(world)):
-            return
-        time.sleep(0.02)
-    raise TimeoutError(tag)
+    from multiverso_tpu.utils.filesync import file_barrier
+    file_barrier(rdv_dir, world, rank, tag, timeout=timeout, poll=0.02)
 
 
 def main():

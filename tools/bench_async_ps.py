@@ -26,6 +26,7 @@ def main():
                                            PSService)
     from multiverso_tpu.ps.tables import AsyncMatrixTable
     from multiverso_tpu.utils import config
+    from multiverso_tpu.utils.filesync import file_barrier
 
     config.set_flag("ps_timeout", 120.0)
     ctx = PSContext(rank, world,
@@ -37,21 +38,10 @@ def main():
     # the traffic crosses the socket, half short-circuits — the realistic
     # mix for world=2)
     vals = rng.normal(size=(batch, dim)).astype(np.float32)
-
-    def sync_point(tag):
-        open(os.path.join(rdv_dir, f"{tag}.{rank}"), "w").close()
-        deadline = time.monotonic() + 60
-        while time.monotonic() < deadline:
-            if all(os.path.exists(os.path.join(rdv_dir, f"{tag}.{r}"))
-                   for r in range(world)):
-                return
-            time.sleep(0.01)
-        raise TimeoutError(tag)
-
     ids = (np.arange(batch) * (rows // batch) + rank) % rows
     t.add_rows(ids, vals)       # compile both shards' programs
     t.get_rows(ids)
-    sync_point("warm")
+    file_barrier(rdv_dir, world, rank, "warm", timeout=60)
 
     ops = 0
     start = time.monotonic()
@@ -65,12 +55,13 @@ def main():
     for m in mids:
         t.wait(m)
     dt = time.monotonic() - start
-    sync_point("done")
+    file_barrier(rdv_dir, world, rank, "done", timeout=60)
     ctx.close()
     print("RESULT " + json.dumps({
         "rank": rank, "ops": ops, "rows": ops * batch, "seconds": dt,
         "rows_per_sec": ops * batch / dt,
-        "mb_per_sec": ops * batch * dim * 4 / dt / 1e6}), flush=True)
+        "mb_per_sec": ops * batch * dim * 4 / dt / 1e6,
+        "batch_rows": batch, "dim": dim}), flush=True)
 
 
 if __name__ == "__main__":
