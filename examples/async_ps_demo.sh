@@ -7,8 +7,10 @@
 set -e
 cd "$(dirname "$0")/.."
 RDV=$(mktemp -d)
-trap 'rm -rf "$RDV"' EXIT
 PIDS=""
+# kill stragglers before deleting their rendezvous dir (a crashed rank
+# must not leave the others polling a vanished directory)
+trap 'kill $PIDS 2>/dev/null; rm -rf "$RDV"' EXIT
 for RANK in 0 1 2 3; do
   python tests/we_async_worker.py "$RDV" 4 "$RANK" &
   PIDS="$PIDS $!"

@@ -16,7 +16,8 @@ wire is a host-side RPC service instead:
   a listener thread + per-connection handler threads (the reference's
   Communicator recv thread + Server actor, collapsed);
 * every process *owns* a contiguous row range of each async table as a
-  device-resident shard (:class:`~multiverso_tpu.ps.shard.RowShard`); the
+  device-resident shard (:class:`~multiverso_tpu.ps.shard.RowShard`),
+  itself sharded across the process's local chips; the
   shard's updater runs as a jitted program on the owner's local TPU device
   — the compute stays on the accelerator, only the row payloads ride TCP
   (the DCN-analogue wire; ICI collectives are the *sync* plane's wire);

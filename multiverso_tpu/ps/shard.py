@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from multiverso_tpu.ps import service as svc
+from multiverso_tpu.table import _ceil_to
 from multiverso_tpu.tables.matrix_table import _bucket_size
 from multiverso_tpu.updaters import AddOption, Updater
 
@@ -52,7 +53,22 @@ class RowShard:
         self.name = name
         self.dtype = jnp.dtype(dtype)
         self.updater = updater
-        self._padded = (self.n + 1, self.num_col)   # +1 scratch row
+        # shard this process's rows over its LOCAL devices: on a real
+        # multi-host TPU every host owns several chips, and its row range
+        # should live (and its updater run) across all of them — the
+        # process-level partition (ps/tables.py) composes with this
+        # device-level one. Rows pad to a device multiple (>= +1 scratch).
+        local = jax.local_devices()
+        self._local_sharding = None
+        if len(local) > 1:
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec
+            padded_rows = _ceil_to(self.n + 1, len(local))
+            mesh = Mesh(np.asarray(local), ("rows",))
+            self._local_sharding = NamedSharding(
+                mesh, PartitionSpec("rows", None))
+        else:
+            padded_rows = self.n + 1
+        self._padded = (padded_rows, self.num_col)
         host = np.zeros(self._padded, self.dtype)
         if init is not None:
             host[: self.n] = np.asarray(init, self.dtype)
@@ -64,14 +80,33 @@ class RowShard:
             host[: self.n] = rng.uniform(
                 -init_scale, init_scale, (self.n, self.num_col)
             ).astype(self.dtype)
-        self._data = jnp.asarray(host)
+        if self._local_sharding is not None:
+            self._data = jax.device_put(host, self._local_sharding)
+        else:
+            self._data = jnp.asarray(host)
         self._ustate = updater.init_state(self._padded, self.dtype)
+        if self._local_sharding is not None:
+            self._ustate = jax.tree.map(self._place_state_local,
+                                        self._ustate)
         self._lock = threading.Lock()
         self._jit: Dict[Any, Any] = {}
         # dirty[worker, local_row]: starts all-True so a worker's first
         # sparse Get pulls everything (ref matrix.cpp up_to_date_ = false)
         self._dirty = (np.ones((num_workers, self.n), bool)
                        if num_workers > 0 else None)
+
+    def _place_state_local(self, x):
+        """Shard updater-state leaves over the local device mesh where the
+        shape lines up (per-worker adagrad g² etc.), else replicate.
+        Row-axis detection reuses :meth:`_state_row_axis` — one shape rule."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = self._local_sharding.mesh
+        axis = self._state_row_axis(x)
+        if axis >= 0:
+            nd = np.ndim(x)
+            spec = P(*([None] * axis), "rows", *([None] * (nd - axis - 1)))
+            return jax.device_put(x, NamedSharding(mesh, spec))
+        return jax.device_put(x, NamedSharding(mesh, P()))
 
     # ------------------------------------------------------------------ #
     @property
