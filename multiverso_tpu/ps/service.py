@@ -66,6 +66,11 @@ config.define_int("ps_rank", -1,
 config.define_int("ps_world", 0,
                   "async-PS world-size override (0 = jax.process_count)")
 config.define_int("ps_port", 0, "async-PS listen port (0 = ephemeral)")
+config.define_float("ps_local_shard_min_mb", 1.0,
+                    "shard an owned row range over the process's local "
+                    "devices only when it is at least this big (tiny "
+                    "shards would pay GSPMD partitioning overhead for "
+                    "nothing); 0 = always shard")
 config.define_float("ps_timeout", 300.0,
                     "async-PS request timeout seconds (generous default: "
                     "a shard's FIRST add/get of each bucket size jit-"

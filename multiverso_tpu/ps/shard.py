@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from multiverso_tpu.ps import service as svc
+from multiverso_tpu.ps import wire
 from multiverso_tpu.table import _ceil_to
 from multiverso_tpu.tables.matrix_table import _bucket_size
 from multiverso_tpu.updaters import AddOption, Updater
@@ -58,9 +59,16 @@ class RowShard:
         # should live (and its updater run) across all of them — the
         # process-level partition (ps/tables.py) composes with this
         # device-level one. Rows pad to a device multiple (>= +1 scratch).
+        # Tiny shards stay single-device: GSPMD partitioning would cost
+        # more (compile + per-op overhead) than it buys below ~1 MB
+        # (ps_local_shard_min_mb).
+        from multiverso_tpu.utils import config as _config
         local = jax.local_devices()
+        min_bytes = _config.get_flag("ps_local_shard_min_mb") * 1e6
         self._local_sharding = None
-        if len(local) > 1:
+        if (len(local) > 1
+                and self.n * self.num_col * self.dtype.itemsize
+                >= min_bytes):
             from jax.sharding import Mesh, NamedSharding, PartitionSpec
             padded_rows = _ceil_to(self.n + 1, len(local))
             mesh = Mesh(np.asarray(local), ("rows",))
@@ -242,6 +250,7 @@ class RowShard:
             with self._lock:
                 rows = np.asarray(
                     self._get_fn(ids.size)(self._data, ids))[:k]
+            rows = wire.to_wire(rows, meta.get("wire", "none"))
             return {}, [rows]
         if msg_type == svc.MSG_SET_ROWS:
             ids, k = self._localize(arrays[0])
@@ -267,7 +276,8 @@ class RowShard:
         if msg_type == svc.MSG_GET_FULL:
             with self._lock:   # same donation race as MSG_GET_ROWS
                 full = np.asarray(self._data)
-            return {}, [full[: self.n]]
+            full = wire.to_wire(full[: self.n], meta.get("wire", "none"))
+            return {}, [full]
         raise svc.PSError(f"unknown message type {msg_type}")
 
 

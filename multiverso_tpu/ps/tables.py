@@ -26,6 +26,7 @@ import numpy as np
 
 from multiverso_tpu import updaters as updaters_lib
 from multiverso_tpu.ps import service as svc
+from multiverso_tpu.ps import wire as wire_mod
 from multiverso_tpu.ps.shard import KVShard, RowShard
 from multiverso_tpu.updaters import AddOption
 from multiverso_tpu.utils import config, log
@@ -135,12 +136,18 @@ class AsyncMatrixTable(_AsyncBase):
                  name: str = "async_matrix",
                  init: Optional[np.ndarray] = None,
                  seed: Optional[int] = None, init_scale: float = 0.0,
-                 shard_workers: int = 0,
+                 shard_workers: int = 0, wire: str = "none",
                  ctx: Optional[svc.PSContext] = None):
         """``shard_workers > 0`` enables per-worker dirty-bit tracking on
         the owned shard (the sparse stale-row protocol; set by
-        AsyncSparseMatrixTable)."""
+        AsyncSparseMatrixTable). ``wire="bf16"`` sends row payloads over
+        TCP as bfloat16 — half the bytes on the DCN-analogue wire, the
+        role the reference's SparseFilter played on its MPI wire
+        (quantization_util.h); values are cast back at the endpoint."""
         super().__init__(ctx, name)
+        if wire not in ("none", "bf16"):
+            raise ValueError(f"unknown wire {wire!r}")
+        self._wire = wire
         self.num_row, self.num_col = int(num_row), int(num_col)
         self.shape = (self.num_row, self.num_col)
         self.dtype = np.dtype(dtype)
@@ -195,6 +202,12 @@ class AsyncMatrixTable(_AsyncBase):
         for r in np.unique(owners):
             yield int(r), owners == r
 
+    def _wire_for(self, rank: int) -> str:
+        """Wire codec per destination: the local rank short-circuits the
+        socket, so compressing its payload would cost two casts (and bf16
+        precision) for zero transport savings."""
+        return "none" if rank == self.ctx.rank else self._wire
+
     # ------------------------------------------------------------------ #
     # row ops (ref matrix_table.h:26-75)
     # ------------------------------------------------------------------ #
@@ -206,7 +219,9 @@ class AsyncMatrixTable(_AsyncBase):
             uids, vals, _ = self._prep(row_ids, values)
             meta = {"table": self.name, "opt": opt._asdict()}
             futs = [self.ctx.service.request(
-                        r, svc.MSG_ADD_ROWS, meta, [uids[m], vals[m]])
+                        r, svc.MSG_ADD_ROWS, meta,
+                        [uids[m], wire_mod.to_wire(vals[m],
+                                                   self._wire_for(r))])
                     for r, m in self._by_owner(uids)]
         return self._track(futs)
 
@@ -218,9 +233,10 @@ class AsyncMatrixTable(_AsyncBase):
         with monitor(f"table[{self.name}].get_rows"):
             uids, _, inv = self._prep(row_ids)
             parts = list(self._by_owner(uids))
-            meta = {"table": self.name}
-            futs = [self.ctx.service.request(r, svc.MSG_GET_ROWS, meta,
-                                             [uids[m]])
+            futs = [self.ctx.service.request(
+                        r, svc.MSG_GET_ROWS,
+                        {"table": self.name, "wire": self._wire_for(r)},
+                        [uids[m]])
                     for r, m in parts]
 
             def _assemble(results):
@@ -276,8 +292,9 @@ class AsyncMatrixTable(_AsyncBase):
         with monitor(f"table[{self.name}].add"):
             delta = np.asarray(delta, self.dtype).reshape(self.shape)
             meta = {"table": self.name, "opt": opt._asdict()}
-            futs = [self.ctx.service.request(r, svc.MSG_ADD_FULL, meta,
-                                             [delta[a:b]])
+            futs = [self.ctx.service.request(
+                        r, svc.MSG_ADD_FULL, meta,
+                        [wire_mod.to_wire(delta[a:b], self._wire_for(r))])
                     for r, a, b in self._ranges]
         return self._track(futs)
 
@@ -286,9 +303,10 @@ class AsyncMatrixTable(_AsyncBase):
 
     def get_async(self) -> int:
         with monitor(f"table[{self.name}].get"):
-            meta = {"table": self.name}
             ranges = list(self._ranges)
-            futs = [self.ctx.service.request(r, svc.MSG_GET_FULL, meta)
+            futs = [self.ctx.service.request(
+                        r, svc.MSG_GET_FULL,
+                        {"table": self.name, "wire": self._wire_for(r)})
                     for r, _, _ in ranges]
 
             def _assemble(results):
@@ -311,7 +329,13 @@ class AsyncMatrixTable(_AsyncBase):
     # rank 0's stream is real under checkpoint.save)
     # ------------------------------------------------------------------ #
     def store(self, stream) -> None:
-        np.save(stream, self.get(), allow_pickle=False)
+        # checkpoints are durable state: always pull full precision, even
+        # when the table's live traffic rides a compressed wire
+        saved, self._wire = self._wire, "none"
+        try:
+            np.save(stream, self.get(), allow_pickle=False)
+        finally:
+            self._wire = saved
 
     def load(self, stream) -> None:
         data = np.load(stream)

@@ -44,6 +44,17 @@ class WireError(RuntimeError):
     pass
 
 
+def to_wire(arr: np.ndarray, wire: str) -> np.ndarray:
+    """Payload-side codec for a wire mode ("none" | "bf16"): the ONE place
+    wire formats are encoded, shared by client sends and shard replies.
+    The receiving side decodes implicitly — ``np.asarray(x, table_dtype)``
+    casts back."""
+    if wire == "bf16":
+        import ml_dtypes
+        return np.asarray(arr).astype(ml_dtypes.bfloat16)
+    return arr
+
+
 def _recv_exact(sock: socket.socket, n: int, *, sof: bool = False
                 ) -> memoryview:
     """Read exactly ``n`` bytes. ``sof`` (start-of-frame): a timeout with
@@ -77,7 +88,12 @@ def encode(msg_type: int, msg_id: int, meta: Dict,
         # asarray, not ascontiguousarray: the latter promotes 0-d to 1-d,
         # and tobytes() already linearizes non-contiguous layouts
         a = np.asarray(a)
-        dt = a.dtype.str.encode()
+        # custom dtypes (bfloat16 etc.) stringify as '<V2' which does NOT
+        # round-trip; their registered NAME does
+        ds = a.dtype.str
+        if np.dtype(ds) != a.dtype:
+            ds = a.dtype.name
+        dt = ds.encode()
         parts.append(struct.pack("<B", len(dt)))
         parts.append(dt)
         parts.append(struct.pack("<B", a.ndim))

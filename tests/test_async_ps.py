@@ -206,6 +206,45 @@ class TestAsyncMatrixTable:
             t0.get_rows([])
 
 
+class TestWireBf16:
+    def test_bf16_wire_roundtrip(self, two_ranks):
+        """wire="bf16" halves the TCP payload both directions (the role
+        the reference's filters played on its MPI wire); values come back
+        in table dtype with bf16 precision."""
+        t0 = AsyncMatrixTable(10, 4, name="wb", wire="bf16",
+                              ctx=two_ranks[0])
+        t1 = AsyncMatrixTable(10, 4, name="wb", wire="bf16",
+                              ctx=two_ranks[1])
+        rng = np.random.default_rng(0)
+        vals = rng.normal(size=(4, 4)).astype(np.float32)
+        t0.add_rows([0, 3, 7, 9], vals)       # spans both shards
+        got = t1.get_rows([0, 3, 7, 9])
+        assert got.dtype == np.float32
+        np.testing.assert_allclose(got, vals, rtol=2e-2, atol=2e-2)
+        t0.add(np.ones((10, 4), np.float32))  # full-table path too
+        np.testing.assert_allclose(t0.get()[1], 1.0, rtol=2e-2)
+
+    def test_unknown_wire_raises(self, two_ranks):
+        with pytest.raises(ValueError):
+            AsyncMatrixTable(4, 2, name="wx", wire="zstd",
+                             ctx=two_ranks[0])
+
+    def test_store_keeps_full_precision_despite_wire(self, two_ranks,
+                                                     tmp_path):
+        """Checkpoints are durable state: store() must bypass the bf16
+        wire (values below bf16 resolution survive a save round-trip)."""
+        t0 = AsyncMatrixTable(6, 2, name="ws", wire="bf16",
+                              ctx=two_ranks[0])
+        AsyncMatrixTable(6, 2, name="ws", wire="bf16", ctx=two_ranks[1])
+        exact = np.full((6, 2), 1.0009765625, np.float32)  # not bf16-exact
+        t0.set_rows(np.arange(6), exact)                   # exact path in
+        with open(tmp_path / "ws.npy", "wb") as f:
+            t0.store(f)
+        saved = np.load(tmp_path / "ws.npy")
+        np.testing.assert_array_equal(saved, exact)        # bit-exact
+        assert t0._wire == "bf16"                          # mode restored
+
+
 class TestLocalDeviceSharding:
     def test_shard_spans_local_devices(self, two_ranks):
         """On a multi-chip host the owned row range itself shards over the
@@ -213,6 +252,9 @@ class TestLocalDeviceSharding:
         process-level one) — here the 8-device CPU mesh stands in for an
         8-chip host."""
         import jax
+
+        from multiverso_tpu.utils import config
+        config.set_flag("ps_local_shard_min_mb", 0.0)  # force for tiny table
         t0 = AsyncMatrixTable(64, 8, name="lds", ctx=two_ranks[0])
         AsyncMatrixTable(64, 8, name="lds", ctx=two_ranks[1])
         ndev = len(jax.local_devices())
