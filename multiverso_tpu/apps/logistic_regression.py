@@ -53,9 +53,15 @@ class LogRegConfig:
 
     def __init__(self, pairs: Dict[str, str]):
         g = pairs.get
+
+        def b(key, default="false"):
+            # accept the same truthy spellings as the WE argv parser so
+            # "-async_ps 1"-style configs behave identically across apps
+            return g(key, default).lower() in ("true", "1", "yes")
+
         self.input_size = int(g("input_size", "0"))
         self.output_size = int(g("output_size", "2"))
-        self.sparse = g("sparse", "false").lower() == "true"
+        self.sparse = b("sparse")
         self.objective_type = g("objective_type", "softmax")
         self.updater_type = g("updater_type", "sgd")
         self.regular_type = g("regular_type", "none")
@@ -73,12 +79,12 @@ class LogRegConfig:
         self.ssp_dir = g("ssp_dir", "")
         self.ssp_timeout = float(g("ssp_timeout", "600"))
         self.heartbeat_dir = g("heartbeat_dir", "")
-        self.pipeline = g("pipeline", "false").lower() == "true"
-        self.use_ps = g("use_ps", "true").lower() == "true"
+        self.pipeline = b("pipeline")
+        self.use_ps = b("use_ps", "true")
         # uncoordinated async tables (multiverso_tpu.ps) for the dense PS
         # path: workers push/pull at independent rates, no collectives
-        self.async_ps = g("async_ps", "false").lower() == "true"
-        self.fused = g("fused", "false").lower() == "true"
+        self.async_ps = b("async_ps")
+        self.fused = b("fused")
         self.reader_type = g("reader_type", "libsvm")  # libsvm | dense
         self.mnist_dir = g("mnist_dir", "")  # BASELINE config 1: idx files
         self.train_file = g("train_file", "")
