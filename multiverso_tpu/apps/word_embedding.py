@@ -40,6 +40,7 @@ from multiverso_tpu import native
 from multiverso_tpu.data.dictionary import Dictionary, build_huffman
 from multiverso_tpu.models import word2vec as w2v
 from multiverso_tpu.utils import log
+from multiverso_tpu.tables.matrix_table import _bucket_size
 from multiverso_tpu.utils.async_buffer import AsyncBuffer
 from multiverso_tpu.utils.dashboard import monitor
 
@@ -90,6 +91,13 @@ class WEConfig:
         # uncoordinated async tables (multiverso_tpu.ps): workers trade
         # rows at independent rates — the reference's default Server mode
         self.async_ps = str(kw.get("async_ps", "0")) in ("1", "true", "True")
+        # PS-block execution plane: "auto" fuses pull+train+push into one
+        # device program when this process is the only worker (the sync
+        # single-controller case); "0" forces the host Get/Add plane (the
+        # multi-worker wire path); "1" asserts the device plane.
+        self.ps_device_plane = str(kw.get("ps_device_plane", "auto"))
+        self.data_presplit = str(kw.get("data_presplit", "0")) in (
+            "1", "true", "True")
         self.max_vocab = kw.get("max_vocab")
         self.train_file = kw.get("train_file", "")
         self.output = kw.get("output", "")
@@ -131,7 +139,17 @@ class WordEmbedding:
         self.word_count = kv(name="word_count")
         self.unigram = dictionary.unigram_table()
         self._trained_words = 0
-        self._data_presplit = False   # caller already sharded the corpus
+        # caller already sharded the corpus (skip the blocks[wid::nw] split;
+        # we_async_worker-style drivers that feed per-rank shards set it via
+        # -data_presplit 1)
+        self._data_presplit = cfg.data_presplit
+        self._neg_host: Optional[np.ndarray] = None
+        self._neg_dev = None
+        # device-plane in-graph negative re-derivation pays one remap upload
+        # of V ids per block; worth it unless the vocab dwarfs the block's
+        # negative traffic (a 21M-vocab run keeps the packed-negs upload)
+        self._dev_negs = (not cfg.hs and cfg.negative > 0
+                          and 4 * v <= cfg.data_block_size * cfg.negative)
         self._fused_cache: Dict[str, object] = {}
         self._pair_cache: Dict[object, object] = {}
         if cfg.hs:
@@ -326,18 +344,44 @@ class WordEmbedding:
             self._block_jit = jax.jit(fn)
         return self._block_jit
 
+    def _use_device_plane(self, num_workers: int) -> bool:
+        """The single-worker sync case fuses each block's pull+train+push
+        into ONE device program (see :meth:`_fused_block_fn`); multi-worker
+        and uncoordinated runs keep the host Get/Add wire."""
+        mode = self.cfg.ps_device_plane
+        eligible = num_workers == 1 and not self.cfg.async_ps
+        if mode == "1":
+            if not eligible:
+                raise ValueError(
+                    "ps_device_plane=1 requires a single worker on the sync "
+                    "plane; multi-worker runs exchange deltas over the "
+                    "Get/Add wire")
+            return True
+        if mode == "0":
+            return False
+        return eligible
+
     def train_ps_blocks(self, ids: np.ndarray,
                         epochs: Optional[int] = None) -> Dict[str, float]:
         """ref distributed_wordembedding.cpp:147-252: per block pull rows,
         train locally, push (new - old) deltas. The pull for block N+1 is
         dispatched before block N trains (ref :202-223 OMP overlap thread) —
         its device gather + host transfer proceed while block N computes, at
-        the cost of the same one-block staleness the reference accepts."""
+        the cost of the same one-block staleness the reference accepts.
+
+        Single-worker sync runs take the *device plane*: the worker's pull /
+        local-train / push collapses into one jitted program per block, so
+        block traffic never crosses the host boundary (the reference's
+        worker and server are separate address spaces; here both live on
+        the same chip, so the Get/Add hop is a device gather/scatter — the
+        semantics, not the message flow, is the parity surface)."""
         cfg = self.cfg
         epochs = epochs or cfg.epoch
         rng = np.random.default_rng(cfg.seed)
         nw, wid = self._ps_topology()
+        device_plane = self._use_device_plane(nw)
         t0, losses, words = time.perf_counter(), [], 0
+        dev_losses: List[jax.Array] = []
         blocks = [ids[lo: lo + cfg.data_block_size]
                   for lo in range(0, ids.size, cfg.data_block_size)]
         blocks = [b for b in blocks if b.size >= 2]
@@ -352,13 +396,43 @@ class WordEmbedding:
         # across epoch boundaries (ref :202-223 keeps its overlap thread
         # alive for the whole multi-epoch run)
         schedule = [b for _ in range(epochs) for b in blocks]
-        prepared = self._prepare_block(schedule[0], rng) if schedule else None
-        for i, block in enumerate(schedule):
-            nxt = (self._prepare_block(schedule[i + 1], rng)
-                   if i + 1 < len(schedule) else None)
-            losses.append(self._train_prepared(prepared, nw))
-            words += block.size
-            prepared = nxt
+        # per-block child rngs: identical draws whether blocks are prepped
+        # serially (host plane) or by prefetch threads (device plane) — the
+        # two planes must stay bit-comparable
+        child_rngs = rng.spawn(len(schedule)) if schedule else []
+        if device_plane and schedule:
+            if self._neg_host is None and not cfg.hs:
+                self._host_negs(1, 1, np.random.default_rng(0))  # build once
+            from concurrent.futures import ThreadPoolExecutor
+            depth = 4   # blocks in flight: bounds host+device prep memory
+            with ThreadPoolExecutor(2) as pool:
+                futs = [pool.submit(self._prepare_block_device,
+                                    schedule[i], child_rngs[i])
+                        for i in range(min(depth, len(schedule)))]
+                for i, block in enumerate(schedule):
+                    j = i + depth
+                    if j < len(schedule):
+                        futs.append(pool.submit(self._prepare_block_device,
+                                                schedule[j], child_rngs[j]))
+                    prepared = futs[i].result()
+                    if prepared is not None:
+                        dev_losses.append(self._train_block_device(prepared))
+                    futs[i] = None   # release the payload
+                    words += block.size
+        else:
+            prepared = (self._prepare_block(schedule[0], child_rngs[0])
+                        if schedule else None)
+            for i, block in enumerate(schedule):
+                nxt = (self._prepare_block(schedule[i + 1], child_rngs[i + 1])
+                       if i + 1 < len(schedule) else None)
+                losses.append(self._train_prepared(prepared, nw))
+                words += block.size
+                prepared = nxt
+        if dev_losses:
+            # ONE host readback for the whole run: materializing the stacked
+            # per-block losses drains the device program chain, so the
+            # trained state is durable when the clock stops
+            losses = [float(x) for x in np.asarray(jnp.stack(dev_losses))]
         # drain in-flight async pushes so the trained state is durable
         # before the caller reads embeddings (sync tables order by program
         # order; async tables need the explicit flush)
@@ -372,54 +446,87 @@ class WordEmbedding:
         return {"loss": float(np.mean(losses)) if losses else 0.0,
                 "words_per_sec": words / dt, "seconds": dt}
 
+    def _host_negs(self, n: int, k: int, rng) -> Tuple[np.ndarray, np.uint32]:
+        """Negative draws from a precomputed unigram^0.75 slot table
+        (word2vec.c's 1e8-slot design, ref wordembedding NS branch). Slot
+        indices come from a counter-based hash (w2v.splitmix32) seeded per
+        block, so the device plane can RE-DERIVE the identical draws
+        in-graph from just the 4-byte seed instead of shipping the
+        (nb, B, K) id array across the host->device wire."""
+        if self._neg_host is None:
+            self._neg_host = w2v.build_negative_table(self.unigram)
+        seed = np.uint32(rng.integers(0, 1 << 32))
+        idx = w2v.counter_negs(seed, max(n, 1) * k, self._neg_host.size - 1)
+        return (self._neg_host[idx].reshape(max(n, 1), k).astype(np.int32),
+                seed)
+
+    def _block_arrays(self, block: np.ndarray, rng) -> Dict:
+        """Host-side block prep shared by both PS planes: the mode-specific
+        training arrays, the block's input-vocab set/remap, and — for HS
+        modes — the block's Huffman inner-node set/remap
+        (ref RequestParameter's needed-row collection,
+        communicator.cpp:104-142)."""
+        cfg = self.cfg
+        prep: Dict = {}
+        if cfg.cbow:
+            windows, masks, targets = w2v.generate_cbow_batches(
+                block, cfg.window)
+            prep.update(windows=windows, masks=masks, targets=targets)
+            used = [windows.reshape(-1), targets, np.zeros(1, np.int64)]
+            examples = targets   # the word whose path/negs are scored
+        else:
+            centers, contexts = _gen_pairs(block, cfg.window,
+                                           int(rng.integers(1 << 31)))
+            prep.update(centers=centers, contexts=contexts)
+            used = [centers, contexts]
+            examples = contexts
+        prep["examples"] = examples
+        if cfg.hs:
+            codes, points, lengths = self._hs
+            t = np.asarray(examples, np.int64)
+            pmask = (np.arange(codes.shape[1])[None, :]
+                     < lengths[t][:, None])
+            prep.update(codes=codes[t], points=points[t], pmask=pmask)
+            prep["hs_rows"] = self._used_ids(
+                self.table_hs.shape[0], [prep["points"][pmask]])
+        else:
+            negs, neg_seed = self._host_negs(examples.size, cfg.negative, rng)
+            prep.update(negs=negs, neg_seed=neg_seed)
+            used.append(negs.reshape(-1))
+        prep["vocab"] = self._used_ids(len(self.dict), used)
+        return prep
+
+    @staticmethod
+    def _used_ids(limit: int, arrays) -> np.ndarray:
+        """Sorted unique ids across ``arrays`` via a presence mask — O(n + V)
+        instead of np.unique's O(n log n) sort (block prep is on the
+        words/sec critical path)."""
+        seen = np.zeros(limit, bool)
+        for a in arrays:
+            seen[np.asarray(a).reshape(-1)] = True
+        return np.flatnonzero(seen)
+
     def _prepare_block(self, block: np.ndarray, rng) -> Dict:
-        """Host-side block prep + *dispatch* of the row pulls
-        (ref RequestParameter, communicator.cpp:104-142). Builds the
-        mode-specific training arrays, the block's input-vocab remap, and
-        — for HS modes — the block's Huffman inner-node set/remap."""
+        """Host-plane block prep + *dispatch* of the row pulls
+        (ref RequestParameter, communicator.cpp:104-142)."""
         cfg = self.cfg
         with monitor("we.prepare"):
-            prep: Dict = {}
-            if cfg.cbow:
-                windows, masks, targets = w2v.generate_cbow_batches(
-                    block, cfg.window)
-                prep.update(windows=windows, masks=masks, targets=targets)
-                used = [windows.reshape(-1), targets, np.zeros(1, np.int64)]
-                examples = targets   # the word whose path/negs are scored
-            else:
-                centers, contexts = _gen_pairs(block, cfg.window,
-                                               int(rng.integers(1 << 31)))
-                prep.update(centers=centers, contexts=contexts)
-                used = [centers, contexts]
-                examples = contexts
+            prep = self._block_arrays(block, rng)
+            vocab = prep["vocab"]
             if cfg.hs:
-                codes, points, lengths = self._hs
-                t = np.asarray(examples, np.int64)
-                pmask = (np.arange(codes.shape[1])[None, :]
-                         < lengths[t][:, None])
-                prep.update(codes=codes[t], points=points[t], pmask=pmask)
-                hs_rows = np.unique(prep["points"][pmask])
+                hs_rows = prep["hs_rows"]
                 # remap path points into the pulled hs block; padded path
                 # slots route to a dummy extra row (their grads are masked
                 # to zero, the scatter just needs a valid index)
                 remap_hs = np.full(self.table_hs.shape[0] + 1,
                                    hs_rows.size, np.int64)
                 remap_hs[hs_rows] = np.arange(hs_rows.size)
-                prep.update(hs_rows=hs_rows, remap_hs=remap_hs,
+                prep.update(remap_hs=remap_hs,
                             pull_hs=self.table_hs.get_rows_async(hs_rows))
-            else:
-                negs = rng.choice(
-                    len(self.dict),
-                    size=(max(examples.size, 1), cfg.negative),
-                    p=self.unigram).astype(np.int32)
-                prep["negs"] = negs
-                used.append(negs.reshape(-1))
-            vocab = np.unique(np.concatenate(
-                [np.asarray(u).reshape(-1) for u in used]))
             remap = np.full(len(self.dict), -1, np.int64)
             remap[vocab] = np.arange(vocab.size)
             prep.update(
-                vocab=vocab, remap=remap,
+                remap=remap,
                 pull_in=self.table_in.get_rows_async(vocab))
             if not cfg.hs:
                 prep["pull_out"] = self.table_out.get_rows_async(vocab)
@@ -493,6 +600,202 @@ class WordEmbedding:
                 else:
                     self.table_out.add_rows_async(prep["vocab"], d_sec)
             return float(loss_acc) / max(nb, 1)
+
+    # ------------------------------------------------------------------ #
+    # PS block path, device plane (single-worker sync): ONE program per
+    # block
+    # ------------------------------------------------------------------ #
+    def _sec_table(self):
+        return self.table_hs if self.cfg.hs else self.table_out
+
+    def _prepare_block_device(self, block: np.ndarray, rng) -> Optional[Dict]:
+        """Pack the block's training arrays into bucketed device-resident
+        batches. Index spaces: table row ids are remapped into the block's
+        pulled-row array; the bucket's pad slots and padded minibatches
+        point at a dummy extra row appended after the pulled rows, so their
+        (masked) garbage never touches real rows. ONE pytree device_put =
+        one host->device transfer per block, overlapped with the previous
+        block's compute by JAX async dispatch."""
+        cfg = self.cfg
+        b = cfg.batch_size
+        with monitor("we.prepare"):
+            prep = self._block_arrays(block, rng)
+            n = (prep["examples"].size // b) * b
+            if n == 0:
+                return None
+            nb = n // b
+            # multiple-of-8 bucket: pair counts per fixed-size block jitter
+            # by << 8 minibatches, so this stays on one compiled program
+            # while wasting far less upload padding than pow2 would
+            nbb = -(-nb // 8) * 8
+            vocab = prep["vocab"]
+            k = vocab.size
+            vbb = _bucket_size(k, self.table_in.padded_shape[0])
+            # bucket the pulled-row count; pad ids gather the table's
+            # scratch row (zero delta scatters back into it, a no-op)
+            ids_in = np.full(vbb, self.table_in.scratch_row, np.int32)
+            ids_in[:k] = vocab
+            remap = np.full(len(self.dict), vbb, np.int64)  # default: dummy
+            remap[vocab] = np.arange(k)
+
+            def idt(limit):
+                return np.int16 if limit < (1 << 15) else np.int32
+
+            def pack(x, fill, dtype):
+                out = np.full((nbb, b) + x.shape[1:], fill, dtype)
+                out[:nb] = x[:n].reshape((nb, b) + x.shape[1:])
+                return out
+
+            din = idt(vbb)
+            if cfg.hs:
+                hs_rows = prep["hs_rows"]
+                hk = hs_rows.size
+                hsb = _bucket_size(hk, self._sec_table().padded_shape[0])
+                ids_sec = np.full(hsb, self._sec_table().scratch_row,
+                                  np.int32)
+                ids_sec[:hk] = hs_rows
+                remap_hs = np.full(self.table_hs.shape[0] + 1, hsb, np.int64)
+                remap_hs[hs_rows] = np.arange(hk)
+                dhs = idt(hsb)
+                points = remap_hs[prep["points"][:n]]
+                points[~prep["pmask"][:n]] = hsb  # mask off-path garbage
+                sec_batch = (pack(prep["codes"][:n], 0, np.int8),
+                             pack(points, hsb, dhs),
+                             pack(prep["pmask"][:n], False, bool))
+            elif self._dev_negs:
+                ids_sec = ids_in
+                sec_batch = ()  # negatives re-derived in-graph from the seed
+            else:
+                ids_sec = ids_in
+                sec_batch = (pack(remap[prep["negs"][:n]], vbb, din),)
+            if cfg.cbow:
+                head = (pack(remap[prep["windows"][:n]], vbb, din),
+                        pack(prep["masks"][:n], False, bool),
+                        pack(remap[prep["targets"][:n]], vbb, din))
+                if cfg.hs:      # cbow_hs_step(w, m, codes, points, pmask)
+                    batch = head[:2] + sec_batch
+                else:           # cbow_ns_step(w, m, targets, negs)
+                    batch = head + sec_batch
+            else:
+                centers = pack(remap[prep["centers"][:n]], vbb, din)
+                if cfg.hs:      # skipgram_hs_step(c, codes, points, pmask)
+                    batch = (centers,) + sec_batch
+                else:           # skipgram_ns_step(c, contexts, negs)
+                    batch = (centers,
+                             pack(remap[prep["contexts"][:n]], vbb, din),
+                             ) + sec_batch
+            valid = np.zeros(nbb, np.float32)
+            valid[:nb] = 1.0
+            payload = {"ids_in": ids_in, "ids_sec": ids_sec, "valid": valid,
+                       "batch": batch, "remap": None, "neg_seed": None}
+            if self._dev_negs:
+                # in-graph negatives need the step index, the global->local
+                # remap (V small ids), and the block's 4-byte draw seed
+                payload["batch"] = (np.arange(nbb, dtype=np.uint32),) + batch
+                payload["remap"] = remap.astype(din)
+                payload["neg_seed"] = np.uint32(prep["neg_seed"])
+            return jax.device_put(
+                payload,
+                jax.sharding.NamedSharding(mv.mesh(),
+                                           jax.sharding.PartitionSpec()))
+
+    def _fused_block_fn(self):
+        """One jitted program = the whole reference block cycle: pull
+        (device gather of the block's rows), local train (lax.scan over
+        minibatches), push (new - old deltas through the table updater,
+        functional_add_rows). Donates both tables' buffers — the block
+        chain re-uses device memory like the reference's in-place server
+        shard (ref distributed_wordembedding.cpp:147-252 collapsed into
+        XLA)."""
+        fn = self._fused_cache.get("ps_block")
+        if fn is not None:
+            return fn
+        cfg = self.cfg
+        t_in, t_sec = self.table_in, self._sec_table()
+        alpha = cfg.alpha
+        if cfg.cbow and cfg.hs:
+            step = lambda a, s, w, m, c, p, pm: w2v.cbow_hs_step(
+                a, s, w, m, c, p, pm, alpha)
+        elif cfg.cbow:
+            step = lambda a, s, w, m, t, g: w2v.cbow_ns_step(
+                a, s, w, m, t, g, alpha)
+        elif cfg.hs:
+            step = lambda a, s, c, cd, p, pm: w2v.skipgram_hs_step(
+                a, s, c, cd, p, pm, alpha)
+        else:
+            step = lambda a, s, c, x, g: w2v.skipgram_ns_step(
+                a, s, c, x, g, alpha)
+
+        dev_negs = self._dev_negs
+        bsz, k = cfg.batch_size, cfg.negative
+        if dev_negs and self._neg_host is None:
+            self._host_negs(1, 1, np.random.default_rng(0))  # build table
+        tbl_mask = (self._neg_host.size - 1) if dev_negs else 0
+
+        def fused(din, uin, dsec, usec, ids_in, ids_sec, valid, batch,
+                  remap, neg_seed, neg_table):
+            old_in = jnp.take(din, ids_in, axis=0)
+            old_sec = jnp.take(dsec, ids_sec, axis=0)
+            dummy_id = ids_in.shape[0]
+
+            def dummy(r):   # padded slots train against this extra row
+                return jnp.concatenate(
+                    [r, jnp.zeros((1, r.shape[1]), r.dtype)])
+
+            def body(carry, xs):
+                ri, rs = carry
+                w, arrs = xs[0], xs[1:]
+                if dev_negs:
+                    stp, arrs = arrs[0], arrs[1:]
+                arrs = tuple(a.astype(jnp.int32)
+                             if a.dtype == jnp.int16 else a for a in arrs)
+                if dev_negs:
+                    # same splitmix32 counter stream the host used to build
+                    # the pull set — only the 4-byte seed crossed the wire
+                    base = neg_seed + stp * jnp.uint32(bsz * k)
+                    slots = w2v.counter_negs(base, bsz * k, tbl_mask)
+                    ng = jnp.take(neg_table, slots).reshape(bsz, k)
+                    nl = jnp.take(remap, ng).astype(jnp.int32)
+                    # padded steps: their counters weren't in the host's
+                    # vocab pass, so point them at the dummy row
+                    nl = jnp.where(w > 0, nl, jnp.int32(dummy_id))
+                    arrs = arrs + (nl,)
+                ri, rs, loss = step(ri, rs, *arrs)
+                return (ri, rs), loss * w
+
+            (ri, rs), losses = jax.lax.scan(
+                body, (dummy(old_in), dummy(old_sec)), (valid,) + batch)
+            loss = losses.sum() / jnp.maximum(valid.sum(), 1.0)
+            s_in = t_in.functional_add_rows(
+                {"data": din, "ustate": uin}, ids_in, ri[:-1] - old_in)
+            s_sec = t_sec.functional_add_rows(
+                {"data": dsec, "ustate": usec}, ids_sec, rs[:-1] - old_sec)
+            return (s_in["data"], s_in["ustate"],
+                    s_sec["data"], s_sec["ustate"], loss)
+
+        fn = jax.jit(fused, donate_argnums=(0, 1, 2, 3))
+        self._fused_cache["ps_block"] = fn
+        return fn
+
+    def _train_block_device(self, prep: Dict) -> jax.Array:
+        """Dispatch one fused block program; returns the block loss as a
+        DEVICE scalar (readback deferred to end of run)."""
+        t_in, t_sec = self.table_in, self._sec_table()
+        fn = self._fused_block_fn()
+        if self._dev_negs and self._neg_dev is None:
+            self._neg_dev = jax.device_put(
+                self._neg_host, jax.sharding.NamedSharding(
+                    mv.mesh(), jax.sharding.PartitionSpec()))
+        with monitor("we.block"), t_in._dispatch_lock, t_sec._dispatch_lock:
+            si, ss = t_in.state, t_sec.state
+            din, uin, dsec, usec, loss = fn(
+                si["data"], si["ustate"], ss["data"], ss["ustate"],
+                prep["ids_in"], prep["ids_sec"], prep["valid"],
+                prep["batch"], prep.get("remap"), prep.get("neg_seed"),
+                self._neg_dev)
+            t_in.adopt({"data": din, "ustate": uin})
+            t_sec.adopt({"data": dsec, "ustate": usec})
+        return loss
 
     def _ps_topology(self) -> Tuple[int, int]:
         """(num_workers, worker_id) of the PS plane in use: the async

@@ -85,6 +85,26 @@ def sample_negatives_table(key: jax.Array, neg_table: jax.Array, batch: int,
     return jnp.take(neg_table, idx, axis=0)
 
 
+def splitmix32(x):
+    """Counter-based hash (splitmix64's finalizer, 32-bit constants) that is
+    BIT-IDENTICAL between numpy and jnp uint32 arrays. The PS block path
+    uses it to draw the same negative-sample stream twice: once on the host
+    (to know which rows to pull) and once in-graph (so the sampled ids never
+    have to cross the host->device wire)."""
+    x = (x ^ (x >> np.uint32(16))) * np.uint32(0x7FEB352D)
+    x = (x ^ (x >> np.uint32(15))) * np.uint32(0x846CA68B)
+    return x ^ (x >> np.uint32(16))
+
+
+def counter_negs(base, count: int, table_mask: int):
+    """Slot indices into a pow2-sized negative table for counters
+    [base, base+count): works on host (numpy) and in-graph (jnp, ``base``
+    traced) with identical results. ``table_mask`` = table_size - 1."""
+    mod = jnp if isinstance(base, jax.Array) else np
+    ctr = mod.arange(count, dtype=mod.uint32) + base
+    return splitmix32(ctr) & mod.uint32(table_mask)
+
+
 def _ns_forward_backward(v: jax.Array, u: jax.Array, labels: jax.Array,
                          lr: float) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Shared NS math. v: (B, D); u: (B, T, D); labels: (T,) or (B, T).

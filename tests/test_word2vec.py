@@ -313,6 +313,26 @@ class TestModesAndRegressions:
         else:
             assert np.linalg.norm(we.table_out.get()) > 0
 
+    @pytest.mark.parametrize("cbow,hs", [(0, 0), (0, 1), (1, 0), (1, 1)])
+    def test_ps_device_plane_matches_host_plane(self, cbow, hs):
+        # the fused single-dispatch device plane and the host Get/Add plane
+        # must train to the same state: same seed => identical pair/negative
+        # draws => the only divergence allowed is float reassociation
+        tokens = self._tokens()
+        emb = {}
+        for mode in ("0", "1"):
+            cfg = WEConfig(size=16, min_count=5, batch_size=128, negative=3,
+                           cbow=cbow, hs=hs, data_block_size=4000,
+                           ps_device_plane=mode, seed=9)
+            d = Dictionary.build(tokens, cfg.min_count)
+            we = WordEmbedding(cfg, d)
+            stats = we.train_ps_blocks(we.prepare_ids(tokens), epochs=1)
+            assert stats["loss"] > 0
+            emb[mode] = (we.embeddings(),
+                         (we.table_hs if hs else we.table_out).get())
+        np.testing.assert_allclose(emb["0"][0], emb["1"][0], atol=1e-3)
+        np.testing.assert_allclose(emb["0"][1], emb["1"][1], atol=1e-3)
+
     def test_words_per_sec_counts_tokens(self):
         tokens = self._tokens()
         cfg = WEConfig(size=16, min_count=5, batch_size=256, negative=3)
