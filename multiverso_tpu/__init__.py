@@ -16,7 +16,8 @@ from multiverso_tpu.api import (
     worker_id, workers_num,
 )
 from multiverso_tpu.ps import (AsyncArrayTable, AsyncKVTable,
-                               AsyncMatrixTable, AsyncSparseMatrixTable)
+                               AsyncMatrixTable, AsyncSparseKVTable,
+                               AsyncSparseMatrixTable)
 from multiverso_tpu.table import Table
 from multiverso_tpu.tables import ArrayTable, KVTable, MatrixTable, SparseMatrixTable
 from multiverso_tpu.tables.array_table import ArrayTableOption

@@ -98,10 +98,6 @@ class LogRegConfig:
         if self.staleness >= 0 and not self.use_ps:
             raise ValueError("staleness needs use_ps=true (there is no "
                              "parameter server to be stale against)")
-        if self.async_ps and self.sparse:
-            raise ValueError("async_ps covers the dense path; the sparse "
-                             "stale-row protocol lives on the collective "
-                             "plane (use sparse=true without async_ps)")
         if self.async_ps and self.mnist_dir:
             raise ValueError("async_ps trains through the use_ps host loop "
                              "(train_file=...); the mnist_dir route uses "
@@ -123,7 +119,16 @@ class LogReg:
         if not mv.Zoo.get().started:
             mv.init()
         n_params = model_lib.param_count(cfg.input_size, cfg.output_size)
-        if cfg.sparse:
+        if cfg.sparse and cfg.async_ps:
+            # the reference's flagship sparse workload: hash-keyed rows on
+            # the UNCOORDINATED plane, FTRL z/n living as shard updater
+            # state (ref model/ps_model.cpp:24-41 creates SparseTable /
+            # FTRL table; util/sparse_table.h, util/ftrl_sparse_table.h)
+            self.sparse_table = mv.AsyncSparseKVTable(
+                cfg.output_size, updater=cfg.updater_type,
+                name="logreg_sparse", num_row=cfg.input_size + 1)
+            self.table = None
+        elif cfg.sparse:
             # feature-major layout: row = feature (last row = bias), col =
             # class, in a SparseMatrixTable so only active-feature rows cross
             # the wire (ref custom SparseWorkerTable + per-chunk key sets,
@@ -277,8 +282,8 @@ class LogReg:
             xa = np.concatenate(
                 [x[:, keys], np.ones((len(y), 1), np.float32),
                  np.zeros((len(y), pad), np.float32)], axis=1)
-            wsub = self.sparse_table.get_rows_sparse(
-                keys_p, worker_id=mv.worker_id())
+            wid = None if cfg.async_ps else mv.worker_id()
+            wsub = self.sparse_table.get_rows_sparse(keys_p, worker_id=wid)
             loss, grad = self._sparse_grad_fn(kb)(
                 jnp.asarray(wsub), jnp.asarray(xa), jnp.asarray(y))
             grad = np.asarray(grad)

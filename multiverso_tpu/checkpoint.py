@@ -91,8 +91,11 @@ def save(directory: str, tag: str = "checkpoint",
         if zoo.rank() == 0:
             with open_stream(_join(path, fname), "wb") as s:
                 table.store(s)
-        else:
+        elif getattr(table, "collective_store", True):
             table.store(_DevNull())
+        # async (uncoordinated) tables: store() is plain RPC, not a
+        # collective — non-zero ranks skip it entirely instead of pulling
+        # world-sized state dumps just to discard them
         manifest["tables"][str(table_id)] = dict(
             _manifest_entry(table), file=fname)
     if zoo.rank() == 0:

@@ -36,11 +36,13 @@ from multiverso_tpu.ps.service import (PSContext, PSError, PSPeerError,
 from multiverso_tpu.ps.tables import (AsyncArrayTable, AsyncArrayTableOption,
                                       AsyncKVTable, AsyncMatrixTable,
                                       AsyncMatrixTableOption,
+                                      AsyncSparseKVTable,
                                       AsyncSparseMatrixTable)
 
 __all__ = [
     "AsyncArrayTable", "AsyncArrayTableOption", "AsyncKVTable",
-    "AsyncMatrixTable", "AsyncMatrixTableOption", "AsyncSparseMatrixTable",
+    "AsyncMatrixTable", "AsyncMatrixTableOption", "AsyncSparseKVTable",
+    "AsyncSparseMatrixTable",
     "PSContext", "PSError", "PSPeerError", "PSService",
     "default_context", "reset_default_context",
 ]

@@ -55,6 +55,8 @@ MSG_ADD_FULL = 0x14
 MSG_GET_FULL = 0x15
 MSG_KV_ADD = 0x16
 MSG_KV_GET = 0x17
+MSG_GET_STATE = 0x18
+MSG_SET_STATE = 0x19
 
 config.define_string("ps_rendezvous", "",
                      "directory for async-PS rank rendezvous (empty = use "
@@ -66,6 +68,11 @@ config.define_int("ps_rank", -1,
 config.define_int("ps_world", 0,
                   "async-PS world-size override (0 = jax.process_count)")
 config.define_int("ps_port", 0, "async-PS listen port (0 = ephemeral)")
+config.define_string("ps_host", "127.0.0.1",
+                     "async-PS bind host. Single-host runs keep the "
+                     "loopback default; multi-host runs set 0.0.0.0 (the "
+                     "published address is then the auto-detected routable "
+                     "IP) or this machine's explicit routable IP")
 config.define_float("ps_local_shard_min_mb", 1.0,
                     "shard an owned row range over the process's local "
                     "devices only when it is at least this big (tiny "
@@ -86,6 +93,16 @@ class PSError(RuntimeError):
 
 class PSPeerError(PSError):
     """A specific peer is unreachable/dead; traffic to others is unaffected."""
+
+
+def await_reply(fut: cf.Future, timeout: float, what: str):
+    """``fut.result`` with waiter timeouts surfaced as PSPeerError — a
+    request that never got a reply is a peer-health event, not a generic
+    concurrent.futures condition."""
+    try:
+        return fut.result(timeout=timeout)
+    except cf.TimeoutError as e:
+        raise PSPeerError(f"{what}: no reply within {timeout}s") from e
 
 
 # ---------------------------------------------------------------------- #
@@ -227,6 +244,16 @@ class _Peer:
                 with self._pending_lock:
                     self._pending.pop(msg_id, None)
                 fut.set_exception(err)
+                return fut
+        # the recv loop may have died BETWEEN the entry _dead check and the
+        # _pending insert (it fails only futures it saw in _pending when it
+        # swept) — re-check so this future fails fast instead of dangling
+        # until the 300s waiter timeout
+        if self._dead is not None:
+            with self._pending_lock:
+                still = self._pending.pop(msg_id, None)
+            if still is not None and not fut.done():
+                fut.set_exception(self._dead)
         return fut
 
     def close(self) -> None:
@@ -240,12 +267,32 @@ class _Peer:
 # ---------------------------------------------------------------------- #
 # the service
 # ---------------------------------------------------------------------- #
+def _routable_ip() -> str:
+    """Best-effort routable address of this host (the reference's
+    GetLocalIPAddress, src/util/net_util.cpp — which was Windows-only;
+    this one works everywhere): the UDP-connect trick picks the egress
+    interface without sending a packet."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("10.255.255.255", 1))
+        return s.getsockname()[0]
+    except OSError:
+        try:
+            return socket.gethostbyname(socket.gethostname())
+        except OSError:
+            return "127.0.0.1"
+    finally:
+        s.close()
+
+
 class PSService:
     """Listener + shard registry + peer pool for one process."""
 
     def __init__(self, rank: int, world: int, rendezvous=None,
-                 host: str = "127.0.0.1", port: Optional[int] = None):
+                 host: Optional[str] = None, port: Optional[int] = None):
         self.rank, self.world = rank, world
+        if host is None:
+            host = config.get_flag("ps_host") or "127.0.0.1"
         self._rendezvous = rendezvous
         self._handlers: Dict[str, Callable] = {}
         self._handlers_cv = threading.Condition()
@@ -261,7 +308,12 @@ class PSService:
             max_workers=1, thread_name_prefix="ps-local")
         self._listener = socket.create_server(
             (host, port if port is not None else config.get_flag("ps_port")))
-        self.addr = "%s:%d" % (host, self._listener.getsockname()[1])
+        # published address must be ROUTABLE: a wildcard bind advertises the
+        # machine's egress IP, not 0.0.0.0 (peers could never connect to it)
+        publish_host = (_routable_ip() if host in ("", "0.0.0.0", "::")
+                        else host)
+        self.addr = "%s:%d" % (publish_host,
+                               self._listener.getsockname()[1])
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="ps-accept", daemon=True)
         self._accept_thread.start()

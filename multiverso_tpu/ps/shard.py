@@ -88,20 +88,25 @@ class RowShard:
             host[: self.n] = rng.uniform(
                 -init_scale, init_scale, (self.n, self.num_col)
             ).astype(self.dtype)
-        if self._local_sharding is not None:
-            self._data = jax.device_put(host, self._local_sharding)
-        else:
-            self._data = jnp.asarray(host)
+        self._data = self._place_rows(host)
         self._ustate = updater.init_state(self._padded, self.dtype)
         if self._local_sharding is not None:
             self._ustate = jax.tree.map(self._place_state_local,
                                         self._ustate)
-        self._lock = threading.Lock()
+        # RLock: HashShard wraps handle() in the same lock to make its
+        # key->slot translation atomic with the update it guards
+        self._lock = threading.RLock()
         self._jit: Dict[Any, Any] = {}
         # dirty[worker, local_row]: starts all-True so a worker's first
         # sparse Get pulls everything (ref matrix.cpp up_to_date_ = false)
         self._dirty = (np.ones((num_workers, self.n), bool)
                        if num_workers > 0 else None)
+
+    def _place_rows(self, host):
+        """Place a row buffer honoring the size-gated local-device sharding."""
+        if self._local_sharding is not None:
+            return jax.device_put(host, self._local_sharding)
+        return jnp.asarray(host)
 
     def _place_state_local(self, x):
         """Shard updater-state leaves over the local device mesh where the
@@ -278,7 +283,205 @@ class RowShard:
                 full = np.asarray(self._data)
             full = wire.to_wire(full[: self.n], meta.get("wire", "none"))
             return {}, [full]
+        if msg_type == svc.MSG_GET_STATE:
+            # updater-state leaves, full precision (checkpoint plumbing:
+            # the sync table persists ustate, table.py store(); async
+            # shards must too or a restore silently resets accumulators)
+            with self._lock:
+                leaves = [np.asarray(l)
+                          for l in jax.tree.leaves(self._ustate)]
+            return {"n_leaves": len(leaves)}, leaves
+        if msg_type == svc.MSG_SET_STATE:
+            with self._lock:
+                flat, treedef = jax.tree.flatten(self._ustate)
+                if len(arrays) != len(flat):
+                    raise svc.PSError(
+                        f"{self.name}: checkpoint has {len(arrays)} updater-"
+                        f"state leaves, shard expects {len(flat)} (was the "
+                        "table created with a different updater?)")
+                for got, want in zip(arrays, flat):
+                    if tuple(got.shape) != tuple(np.shape(want)):
+                        raise svc.PSError(
+                            f"{self.name}: updater-state leaf shape "
+                            f"{got.shape} != {np.shape(want)} (partition "
+                            "changed since the checkpoint?)")
+                leaves = [jnp.asarray(np.asarray(a, dtype=np.asarray(w).dtype))
+                          for a, w in zip(arrays, flat)]
+                self._ustate = jax.tree.unflatten(treedef, leaves)
+                if self._local_sharding is not None:
+                    self._ustate = jax.tree.map(self._place_state_local,
+                                                self._ustate)
+            return {}, []
         raise svc.PSError(f"unknown message type {msg_type}")
+
+
+class HashShard(RowShard):
+    """Sparse-key shard: arbitrary non-negative int64 keys map to device
+    row slots allocated on first touch — the owner-side storage of the
+    reference's app-defined sparse tables (ref Applications/
+    LogisticRegression/src/util/sparse_table.h:1-306 hash-stored
+    SparseServerTable; util/ftrl_sparse_table.h:1-90 FTRL z/n payloads,
+    which arrive here as updater state on the row axis). The slot buffer
+    doubles on demand; a Get of a never-added key allocates its slot and
+    returns the initial row (zeros — exactly FTRL's w for empty z/n)."""
+
+    def __init__(self, num_col: int, dtype, updater: Updater, name: str,
+                 capacity: int = 1024, num_workers: int = 0):
+        super().__init__(0, capacity, num_col, dtype, updater, name,
+                         num_workers=num_workers)
+        self._slot_of: Dict[int, int] = {}
+        self._nw = num_workers
+
+    @property
+    def keys(self) -> List[int]:
+        with self._lock:
+            return list(self._slot_of)
+
+    def _grow(self, need: int) -> None:
+        old_padded = self._padded
+        old_rows = old_padded[0]
+        new_n = max(self.n, 1)
+        while new_n < need:
+            new_n *= 2
+        if self._local_sharding is not None:
+            # keep the device-multiple row padding the GSPMD layout needs
+            ndev = self._local_sharding.mesh.devices.size
+            rows = _ceil_to(new_n + 1, ndev)
+        else:
+            rows = new_n + 1
+
+        def grow(leaf):
+            arr = np.asarray(leaf)
+            nd, pd = arr.ndim, len(old_padded)
+            if nd >= pd and arr.shape[nd - pd:] == old_padded:
+                axis = nd - pd
+                widths = [(0, 0)] * nd
+                widths[axis] = (0, rows - old_rows)
+                return np.pad(arr, widths)
+            return leaf
+
+        data = grow(self._data)
+        ustate = jax.tree.map(grow, self._ustate)
+        if self._dirty is not None:
+            self._dirty = np.pad(
+                self._dirty, [(0, 0), (0, new_n - self.n)],
+                constant_values=True)
+        self.n = self.hi = new_n
+        self._padded = (rows, self.num_col)
+        # re-place AFTER _padded is updated: the grown buffers must keep
+        # the size-gated local-device sharding, not silently collapse to
+        # one device exactly when the table gets big enough to matter
+        self._data = self._place_rows(data)
+        if self._local_sharding is not None:
+            self._ustate = jax.tree.map(
+                lambda l: (self._place_state_local(l)
+                           if isinstance(l, np.ndarray) else l), ustate)
+        else:
+            self._ustate = jax.tree.map(
+                lambda l: jnp.asarray(l) if isinstance(l, np.ndarray) else l,
+                ustate)
+
+    def _slots_for(self, keys: np.ndarray) -> np.ndarray:
+        """key -> slot, allocating unseen keys (under the caller's lock)."""
+        out = np.empty(keys.size, np.int64)
+        fresh = [i for i, k in enumerate(keys.tolist())
+                 if k not in self._slot_of]
+        if len(self._slot_of) + len(fresh) > self.n:
+            self._grow(len(self._slot_of) + len(fresh))
+        for i, k in enumerate(keys.tolist()):
+            slot = self._slot_of.get(k)
+            if slot is None:
+                slot = self._slot_of[k] = len(self._slot_of)
+            out[i] = slot
+        return out
+
+    def handle(self, msg_type: int, meta: Dict,
+               arrays: Sequence[np.ndarray]
+               ) -> Tuple[Dict, List[np.ndarray]]:
+        if msg_type in (svc.MSG_ADD_FULL, svc.MSG_GET_FULL):
+            raise svc.PSError(
+                f"{self.name}: hash-sharded table has no dense whole-table "
+                "plane; use row/key ops")
+        with self._lock:   # reentrant: key->slot stays atomic w/ the update
+            if msg_type == svc.MSG_GET_STATE and meta.get("dump"):
+                return self._dump()
+            if msg_type == svc.MSG_SET_STATE and meta.get("dump"):
+                return self._restore(arrays)
+            if msg_type in (svc.MSG_ADD_ROWS, svc.MSG_GET_ROWS,
+                            svc.MSG_SET_ROWS):
+                keys = np.asarray(arrays[0], np.int64)
+                if keys.size == 0:
+                    raise IndexError(f"{self.name}: empty key batch")
+                if np.any(keys < 0):
+                    raise IndexError(f"{self.name}: negative keys")
+                slots = self._slots_for(keys)
+                arrays = [slots] + list(arrays[1:])
+            return super().handle(msg_type, meta, arrays)
+
+    # ------------------------------------------------------------------ #
+    # checkpoint: (keys, rows, per-key updater state) — the reference left
+    # KV/sparse Store/Load stubbed (kv_table.h:101-119); here it is real
+    # ------------------------------------------------------------------ #
+    def _dump(self) -> Tuple[Dict, List[np.ndarray]]:
+        keys = np.array(sorted(self._slot_of), np.int64)
+        slots = np.array([self._slot_of[k] for k in keys.tolist()], np.int64)
+        if keys.size:
+            padded = self._pad_to_bucket(slots)
+            rows = np.asarray(self._get_fn(padded.size)(
+                self._data, padded))[: keys.size]
+        else:
+            rows = np.zeros((0, self.num_col), self.dtype)
+        leaves, axes = [], []
+        for leaf in jax.tree.leaves(self._ustate):
+            axis = self._state_row_axis(leaf)
+            arr = np.asarray(leaf)
+            if axis >= 0 and keys.size:
+                leaves.append(np.take(arr, slots, axis=axis))
+            elif axis >= 0:
+                leaves.append(np.take(arr, np.empty(0, np.int64), axis=axis))
+            else:
+                leaves.append(arr)
+            axes.append(axis)
+        return ({"axes": axes}, [keys, rows] + leaves)
+
+    def _restore(self, arrays: Sequence[np.ndarray]
+                 ) -> Tuple[Dict, List[np.ndarray]]:
+        keys, rows = np.asarray(arrays[0], np.int64), arrays[1]
+        leaves_in = list(arrays[2:])
+        self._slot_of = {}
+        self.n = self.hi = 0
+        self._padded = (1, self.num_col)
+        self._data = jnp.zeros(self._padded, self.dtype)
+        self._ustate = self.updater.init_state(self._padded, self.dtype)
+        if self._dirty is not None:
+            self._dirty = np.ones((self._nw, 0), bool)
+        if keys.size == 0:
+            return {}, []
+        slots = self._slots_for(keys)
+        data = np.array(self._data)   # writable copy
+        data[slots] = np.asarray(rows, self.dtype)
+        self._data = self._place_rows(data)
+        flat, treedef = jax.tree.flatten(self._ustate)
+        if len(leaves_in) != len(flat):
+            raise svc.PSError(
+                f"{self.name}: checkpoint has {len(leaves_in)} updater-state "
+                f"leaves, expected {len(flat)}")
+        out = []
+        for got, want in zip(leaves_in, flat):
+            arr = np.asarray(want).copy()
+            axis = self._state_row_axis(want)
+            if axis >= 0:
+                idx = (slice(None),) * axis + (slots,)
+                arr[idx] = np.asarray(got, arr.dtype)
+            else:
+                arr = np.asarray(got, arr.dtype)
+            out.append(self._place_state_local(arr)
+                       if self._local_sharding is not None
+                       else jnp.asarray(arr))
+        self._ustate = jax.tree.unflatten(treedef, out)
+        if self._dirty is not None:
+            self._dirty = np.ones((self._nw, self.n), bool)
+        return {}, []
 
 
 class KVShard:

@@ -42,6 +42,30 @@ def _resolve_updater(updater, num_workers: int, dtype):
     return updater
 
 
+def _dedupe_batch(row_ids, num_col: int, dtype,
+                  bound: Optional[int], values=None):
+    """Validate + dedupe a row/key batch, accumulating duplicate values in
+    float64 (one implementation for range-sharded rows and hash keys).
+    Returns (unique_ids, summed_vals | None, inverse)."""
+    raw = np.asarray(row_ids)
+    if raw.size == 0:
+        raise ValueError("empty row_ids")
+    if not np.issubdtype(raw.dtype, np.integer):
+        raise TypeError(f"row_ids must be integers, got {raw.dtype}")
+    ids = raw.astype(np.int64).reshape(-1)
+    if np.any(ids < 0):
+        raise IndexError("row ids/keys must be non-negative")
+    if bound is not None and np.any(ids >= bound):
+        raise IndexError(f"row id out of range [0, {bound})")
+    uids, inv = np.unique(ids, return_inverse=True)
+    if values is None:
+        return uids, None, inv
+    vals = np.asarray(values, dtype).reshape(ids.size, num_col)
+    acc = np.zeros((uids.size, num_col), np.float64)
+    np.add.at(acc, inv, vals.astype(np.float64))
+    return uids, acc.astype(dtype), inv
+
+
 def _maybe_register_in_zoo(table) -> Optional[int]:
     """Async tables join the Zoo registry (checkpoint walk, C ABI) when the
     runtime is up; standalone PSContext tests run without a Zoo."""
@@ -54,6 +78,11 @@ def _maybe_register_in_zoo(table) -> Optional[int]:
 
 class _AsyncBase:
     """msg-id -> futures bookkeeping shared by the async tables."""
+
+    # store() is plain RPC to the owners, not a collective: checkpoint.save
+    # runs it on rank 0 only (sync tables' sharded-state fetch is collective,
+    # so THEY must run store() on every rank)
+    collective_store = False
 
     def __init__(self, ctx: Optional[svc.PSContext], name: str):
         self.ctx = ctx if ctx is not None else svc.default_context()
@@ -100,7 +129,9 @@ class _AsyncBase:
             return None
         futures, finalize = entry
         timeout = config.get_flag("ps_timeout")
-        results = [f.result(timeout=timeout) for f in futures]
+        results = [svc.await_reply(f, timeout,
+                                   f"table[{self.name}] op {msg_id}")
+                   for f in futures]
         return finalize(results) if finalize is not None else None
 
     def flush(self) -> None:
@@ -180,22 +211,8 @@ class AsyncMatrixTable(_AsyncBase):
         return self._shard._data if self._shard is not None else None
 
     def _prep(self, row_ids, values: Optional[np.ndarray] = None):
-        raw = np.asarray(row_ids)
-        if raw.size == 0:
-            raise ValueError("empty row_ids")
-        if not np.issubdtype(raw.dtype, np.integer):
-            raise TypeError(f"row_ids must be integers, got {raw.dtype}")
-        ids = raw.astype(np.int64).reshape(-1)
-        if np.any((ids < 0) | (ids >= self.num_row)):
-            raise IndexError(f"row id out of range [0, {self.num_row})")
-        uids, inv = np.unique(ids, return_inverse=True)
-        if values is not None:
-            vals = np.asarray(values, self.dtype).reshape(ids.size,
-                                                          self.num_col)
-            acc = np.zeros((uids.size, self.num_col), np.float64)
-            np.add.at(acc, inv, vals.astype(np.float64))
-            return uids, acc.astype(self.dtype), inv
-        return uids, None, inv
+        return _dedupe_batch(row_ids, self.num_col, self.dtype,
+                             self.num_row, values)
 
     def _by_owner(self, uids: np.ndarray):
         owners = uids // self._rows_per
@@ -328,6 +345,8 @@ class AsyncMatrixTable(_AsyncBase):
     # checkpoint (whole-table via the service; every rank may call, only
     # rank 0's stream is real under checkpoint.save)
     # ------------------------------------------------------------------ #
+    _STATE_MARKER = 0x4D565553   # "MVUS": updater state follows the data
+
     def store(self, stream) -> None:
         # checkpoints are durable state: always pull full precision, even
         # when the table's live traffic rides a compressed wire
@@ -336,16 +355,158 @@ class AsyncMatrixTable(_AsyncBase):
             np.save(stream, self.get(), allow_pickle=False)
         finally:
             self._wire = saved
+        # per-owner updater state (sync tables persist theirs, table.py
+        # store(); restoring without it would silently reset adagrad/adam
+        # accumulators). Stored per shard — async shards legitimately
+        # diverge (e.g. adam step counts advance at each owner's own rate),
+        # so there is no meaningful global reassembly.
+        np.save(stream, np.array([self._STATE_MARKER, len(self._ranges)],
+                                 np.int64), allow_pickle=False)
+        timeout = config.get_flag("ps_timeout")
+        for r, _, _ in self._ranges:
+            meta, leaves = svc.await_reply(
+                self.ctx.service.request(r, svc.MSG_GET_STATE,
+                                         {"table": self.name}),
+                timeout, f"table[{self.name}] state from {r}")
+            np.save(stream, np.array([len(leaves)], np.int64),
+                    allow_pickle=False)
+            for leaf in leaves:
+                np.save(stream, leaf, allow_pickle=False)
 
-    def load(self, stream) -> None:
-        data = np.load(stream)
+    def load(self, stream, _data: Optional[np.ndarray] = None) -> None:
+        data = np.load(stream) if _data is None else _data
         if data.shape != self.shape:
             raise ValueError(f"checkpoint shape {data.shape} != {self.shape}")
         for r, a, b in self._ranges:
             self.set_rows(np.arange(a, b), data[a:b])
+        try:
+            header = np.load(stream)
+        except (EOFError, OSError, ValueError):
+            log.warning("table[%s]: checkpoint predates updater-state "
+                        "persistence; optimizer accumulators keep their "
+                        "current values", self.name)
+            return
+        if header.size != 2 or int(header[0]) != self._STATE_MARKER:
+            raise ValueError(
+                f"table[{self.name}]: unrecognized checkpoint trailer "
+                "(not an async-table stream?)")
+        if int(header[1]) != len(self._ranges):
+            raise ValueError(
+                f"table[{self.name}]: checkpoint has per-shard updater "
+                f"state for {int(header[1])} owners but the world now has "
+                f"{len(self._ranges)} — shard accumulators cannot be "
+                "remapped; restore with the original world size")
+        timeout = config.get_flag("ps_timeout")
+        for r, _, _ in self._ranges:
+            n = int(np.load(stream)[0])
+            leaves = [np.load(stream) for _ in range(n)]
+            svc.await_reply(
+                self.ctx.service.request(r, svc.MSG_SET_STATE,
+                                         {"table": self.name}, leaves),
+                timeout, f"table[{self.name}] state to {r}")
 
 
-class AsyncSparseMatrixTable(AsyncMatrixTable):
+class _SparseGetMixin:
+    """Worker-side half of the stale-row protocol, shared by the range-
+    sharded and hash-sharded sparse tables: per-worker row cache + the
+    stale-only pull.
+
+    Pipeline-safe: ``get_rows_sparse_async`` lets a prefetch thread pull
+    block N+1 while block N trains — the reference had to DOUBLE its
+    per-worker state slots to tolerate exactly this overlap
+    (ref src/table/matrix.cpp:407-418 is_pipeline). Here the server reply
+    carries the stale rows atomically with the bits it cleared, so
+    overlapped pulls need only a per-worker cache lock; an out-of-order
+    wait() at worst self-heals with a plain re-pull, never serves wrong
+    data."""
+
+    def _worker_cache(self, worker_id: int):
+        from multiverso_tpu.tables.sparse_matrix_table import _RowCache
+        if not (0 <= worker_id < self._n_workers):
+            raise IndexError(f"worker_id {worker_id} out of range "
+                             f"[0, {self._n_workers})")
+        with self._caches_lock:
+            entry = self._caches.get(worker_id)
+            if entry is None:
+                entry = self._caches[worker_id] = (
+                    _RowCache(self.num_col, self.dtype),
+                    threading.Lock(), {})   # cache, lock, row -> pull seq
+        return entry
+
+    def _next_seq(self) -> int:
+        with self._caches_lock:
+            self._pull_seq += 1
+            return self._pull_seq
+
+    def get_rows_sparse_async(self, row_ids,
+                              worker_id: Optional[int] = None) -> int:
+        """Dispatch a stale-only pull; ``wait(msg_id)`` returns the rows.
+        Multiple pulls for the same worker may be in flight (the
+        double-buffer pattern, ref async_buffer.h + matrix.cpp:407-418)."""
+        worker_id = self.ctx.rank if worker_id is None else worker_id
+        cache, cache_lock, seqs = self._worker_cache(worker_id)
+        with monitor(f"table[{self.name}].get_rows_sparse"):
+            uids, _, inv = self._prep(row_ids)
+            parts = list(self._by_owner(uids))
+            meta = {"table": self.name, "sparse": True,
+                    "worker_id": int(worker_id)}
+            with cache_lock:
+                # seq is allocated AND the requests are sent under the
+                # cache lock, so per worker: seq order == wire send order
+                # == server processing order (one conn per owner, FIFO) —
+                # the ordering the version filter below relies on
+                seq = self._next_seq()
+                futs = [self.ctx.service.request(r, svc.MSG_GET_ROWS, meta,
+                                                 [uids[m]])
+                        for r, m in parts]
+
+        def _finalize(results):
+            transferred = 0
+            with cache_lock:
+                for (r, m), (_, (mask, rows)) in zip(parts, results):
+                    stale = uids[m][mask.astype(bool)]
+                    if stale.size == 0:
+                        continue
+                    # version filter: an out-of-order wait() must not let
+                    # an OLDER pull's rows overwrite data a newer pull
+                    # already cached (the server bit is clear by now, so
+                    # the revert would be served forever)
+                    keep = np.array([seqs.get(int(i), -1) < seq
+                                     for i in stale.tolist()])
+                    fresh_ids = stale[keep]
+                    if fresh_ids.size:
+                        cache.put(fresh_ids, rows[keep])
+                        for i in fresh_ids.tolist():
+                            seqs[int(i)] = seq
+                        transferred += int(fresh_ids.size)
+                try:
+                    out = cache.take(uids)
+                except KeyError:
+                    # self-healing: a reply that cleared dirty bits on the
+                    # server was lost (timeout/conn drop) or is being
+                    # waited out of dispatch order — re-pull the gap with a
+                    # plain get. The reference had the same window and no
+                    # recovery (matrix.cpp clears up_to_date_ before the
+                    # reply crosses MPI).
+                    _, found = cache._locate(uids)
+                    missing = uids[~found]
+                    heal_seq = self._next_seq()  # plain get: newest data
+                    cache.put(missing, self.get_rows(missing))
+                    for i in missing.tolist():
+                        seqs[int(i)] = heal_seq
+                    transferred += int(missing.size)
+                    out = cache.take(uids)
+            self.last_transfer_rows = transferred
+            return out[inv]
+
+        return self._track(futs, _finalize)
+
+    def get_rows_sparse(self, row_ids, worker_id: Optional[int] = None
+                        ) -> np.ndarray:
+        return self.wait(self.get_rows_sparse_async(row_ids, worker_id))
+
+
+class AsyncSparseMatrixTable(_SparseGetMixin, AsyncMatrixTable):
     """Stale-row protocol on the uncoordinated plane (ref src/table/
     matrix.cpp:432-572 — the reference's async server's sparse mode):
     ``get_rows_sparse(ids, worker_id)`` transfers ONLY the rows that
@@ -365,55 +526,133 @@ class AsyncSparseMatrixTable(AsyncMatrixTable):
                          init_scale=init_scale,
                          shard_workers=self._n_workers, ctx=ctx)
         self._caches: Dict[int, Any] = {}
+        self._caches_lock = threading.Lock()
+        self._pull_seq = 0
         self.last_transfer_rows = -1   # diagnostic: rows over the wire
 
-    def _worker_cache(self, worker_id: int):
-        from multiverso_tpu.tables.sparse_matrix_table import _RowCache
-        if not (0 <= worker_id < self._n_workers):
-            raise IndexError(f"worker_id {worker_id} out of range "
-                             f"[0, {self._n_workers})")
-        cache = self._caches.get(worker_id)
-        if cache is None:
-            cache = self._caches[worker_id] = _RowCache(self.num_col,
-                                                        self.dtype)
-        return cache
 
-    def get_rows_sparse(self, row_ids, worker_id: Optional[int] = None
-                        ) -> np.ndarray:
-        worker_id = self.ctx.rank if worker_id is None else worker_id
-        cache = self._worker_cache(worker_id)
-        with monitor(f"table[{self.name}].get_rows_sparse"):
-            uids, _, inv = self._prep(row_ids)
+class AsyncSparseKVTable(_SparseGetMixin, _AsyncBase):
+    """Hash-sharded sparse-KEY table: arbitrary non-negative int64 keys,
+    owner = ``key % world`` — the uncoordinated home of the reference's
+    app-defined sparse LR tables (ref Applications/LogisticRegression/src/
+    util/sparse_table.h:1-306 SparseWorkerTable/SparseServerTable;
+    model/ps_model.cpp:24-41 creates them for sparse/FTRL runs). With
+    ``updater="ftrl"`` each key's row is the ready weight recomputed from
+    the z/n state (ftrl_sparse_table.h:1-90) — workers push raw gradients.
+    Slots materialize server-side on first touch; a Get of a fresh key
+    returns zeros (= FTRL's w for empty state)."""
+
+    def __init__(self, num_col: int, dtype=np.float32,
+                 updater: Union[str, updaters_lib.Updater, None] = None,
+                 name: str = "async_sparse_kv",
+                 num_row: Optional[int] = None,
+                 num_workers: Optional[int] = None,
+                 ctx: Optional[svc.PSContext] = None):
+        super().__init__(ctx, name)
+        self.num_col = int(num_col)
+        self.dtype = np.dtype(dtype)
+        self.num_row = num_row   # optional key bound (enables dense get())
+        self._n_workers = num_workers or max(self.ctx.world, 1)
+        self.updater = _resolve_updater(updater, self._n_workers, self.dtype)
+        from multiverso_tpu.ps.shard import HashShard
+        self._shard = HashShard(self.num_col, self.dtype, self.updater,
+                                name, num_workers=self._n_workers)
+        self.ctx.service.register_handler(name, self._shard.handle)
+        self._caches: Dict[int, Any] = {}
+        self._caches_lock = threading.Lock()
+        self._pull_seq = 0
+        self.last_transfer_rows = -1
+        self.table_id = _maybe_register_in_zoo(self)
+
+    def raw(self):
+        return self._shard._data
+
+    # --------------------------- partitioning ------------------------- #
+    def _prep(self, keys, values: Optional[np.ndarray] = None):
+        return _dedupe_batch(keys, self.num_col, self.dtype,
+                             self.num_row, values)
+
+    def _by_owner(self, uids: np.ndarray):
+        owners = uids % self.ctx.world
+        for r in np.unique(owners):
+            yield int(r), owners == r
+
+    # --------------------------- key ops ------------------------------ #
+    def add_rows_async(self, keys, values,
+                       opt: Optional[AddOption] = None) -> int:
+        opt = opt or AddOption(worker_id=self.ctx.rank)
+        self._zoo_dirty()
+        with monitor(f"table[{self.name}].add_rows"):
+            uids, vals, _ = self._prep(keys, values)
+            meta = {"table": self.name, "opt": opt._asdict()}
+            futs = [self.ctx.service.request(r, svc.MSG_ADD_ROWS, meta,
+                                             [uids[m], vals[m]])
+                    for r, m in self._by_owner(uids)]
+        return self._track(futs)
+
+    def add_rows(self, keys, values,
+                 opt: Optional[AddOption] = None) -> None:
+        self.wait(self.add_rows_async(keys, values, opt))
+
+    def get_rows_async(self, keys) -> int:
+        with monitor(f"table[{self.name}].get_rows"):
+            uids, _, inv = self._prep(keys)
             parts = list(self._by_owner(uids))
-            meta = {"table": self.name, "sparse": True,
-                    "worker_id": int(worker_id)}
-            futs = [self.ctx.service.request(r, svc.MSG_GET_ROWS, meta,
-                                             [uids[m]])
+            futs = [self.ctx.service.request(
+                        r, svc.MSG_GET_ROWS, {"table": self.name},
+                        [uids[m]])
                     for r, m in parts]
-            timeout = config.get_flag("ps_timeout")
-            transferred = 0
-            for (r, m), f in zip(parts, futs):
-                _, (mask, rows) = f.result(timeout=timeout)
-                stale = uids[m][mask.astype(bool)]
-                if stale.size:
-                    cache.put(stale, rows)
-                    transferred += int(stale.size)
-            try:
-                out = cache.take(uids)
-            except KeyError:
-                # self-healing: a previous sparse get cleared dirty bits on
-                # the server but its reply was lost (timeout/conn drop), so
-                # some "fresh" rows were never cached. Re-pull the gap with
-                # a plain (non-sparse) get. The reference had the same
-                # window and no recovery (matrix.cpp clears up_to_date_
-                # before the reply crosses MPI).
-                _, found = cache._locate(uids)
-                missing = uids[~found]
-                cache.put(missing, self.get_rows(missing))
-                transferred += int(missing.size)
-                out = cache.take(uids)
-            self.last_transfer_rows = transferred
-            return out[inv]
+
+            def _assemble(results):
+                out = np.empty((uids.size, self.num_col), self.dtype)
+                for (r, m), (_, arrays) in zip(parts, results):
+                    out[m] = arrays[0]
+                return out[inv]
+
+        return self._track(futs, _assemble)
+
+    def get_rows(self, keys) -> np.ndarray:
+        return self.wait(self.get_rows_async(keys))
+
+    def get(self) -> np.ndarray:
+        """Dense (num_row, num_col) view; needs the key bound."""
+        if self.num_row is None:
+            raise ValueError(f"table[{self.name}] is unbounded; get() needs "
+                             "num_row (or use get_rows/key enumeration)")
+        return self.get_rows(np.arange(self.num_row))
+
+    # --------------------------- checkpoint --------------------------- #
+    def store(self, stream) -> None:
+        """(keys, rows, per-key updater state) per owner — the reference
+        stubbed KV Store/Load (kv_table.h:101-119); here it round-trips."""
+        timeout = config.get_flag("ps_timeout")
+        np.save(stream, np.array([self.ctx.world], np.int64),
+                allow_pickle=False)
+        for r in range(self.ctx.world):
+            meta, arrays = svc.await_reply(
+                self.ctx.service.request(
+                    r, svc.MSG_GET_STATE, {"table": self.name, "dump": True}),
+                timeout, f"table[{self.name}] dump from {r}")
+            np.save(stream, np.array([len(arrays)], np.int64),
+                    allow_pickle=False)
+            for a in arrays:
+                np.save(stream, a, allow_pickle=False)
+
+    def load(self, stream) -> None:
+        world = int(np.load(stream)[0])
+        if world != self.ctx.world:
+            raise ValueError(
+                f"table[{self.name}]: checkpoint written at world={world}, "
+                f"now {self.ctx.world} — hash shards cannot be remapped")
+        timeout = config.get_flag("ps_timeout")
+        for r in range(self.ctx.world):
+            n = int(np.load(stream)[0])
+            arrays = [np.load(stream) for _ in range(n)]
+            svc.await_reply(
+                self.ctx.service.request(
+                    r, svc.MSG_SET_STATE, {"table": self.name, "dump": True},
+                    arrays),
+                timeout, f"table[{self.name}] restore to {r}")
 
 
 class AsyncArrayTable(_AsyncBase):
@@ -463,12 +702,13 @@ class AsyncArrayTable(_AsyncBase):
         self._m.flush()
 
     def store(self, stream) -> None:
-        np.save(stream, self.get(), allow_pickle=False)
+        self._m.store(stream)   # (size, 1) data + per-owner updater state
 
     def load(self, stream) -> None:
-        data = np.load(stream).reshape(self.size, 1)
-        for r, a, b in self._m._ranges:
-            self._m.set_rows(np.arange(a, b), data[a:b])
+        data = np.load(stream)
+        if data.ndim == 1:   # legacy 1-D array-table stream stays loadable
+            data = data.reshape(self.size, 1)
+        self._m.load(stream, _data=data)
 
 
 class AsyncMatrixTableOption:
@@ -551,7 +791,8 @@ class AsyncKVTable(_AsyncBase):
                         r, svc.MSG_KV_GET, meta, [uk[m]]))
         timeout = config.get_flag("ps_timeout")
         for f in futs:
-            _, arrays = f.result(timeout=timeout)
+            _, arrays = svc.await_reply(f, timeout,
+                                        f"table[{self.name}] kv get")
             for k, v in zip(arrays[0].tolist(), arrays[1].tolist()):
                 out[int(k)] = v   # assignment: shards are disjoint by hash
         if keys is not None:

@@ -238,7 +238,15 @@ class MatrixTable(Table):
                 self._data, self._ustate,
                 jax.device_put(ids, self._replicated),
                 jax.device_put(vals, self._replicated), opt)
+            # subclass hook, fed the ids ACTUALLY applied (the cross-process
+            # union, not just this worker's set): the sparse table's dirty
+            # bits must cover rows other workers contributed
+            self._rows_applied(ids)
         return self._track(token)
+
+    def _rows_applied(self, ids: np.ndarray) -> None:
+        """Called under the dispatch lock with the final (deduped, padded,
+        cross-process-unioned) row ids of an add. Default: nothing."""
 
     def add_rows(self, row_ids, values, opt: Optional[AddOption] = None) -> None:
         self.wait(self.add_rows_async(row_ids, values, opt))

@@ -110,14 +110,15 @@ class SparseMatrixTable(MatrixTable):
     # ------------------------------------------------------------------ #
     # ops
     # ------------------------------------------------------------------ #
-    def add_rows_async(self, row_ids, values,
-                       opt: Optional[AddOption] = None) -> int:
-        with self._dispatch_lock:
-            msg_id = super().add_rows_async(row_ids, values, opt)
-            ids, _, _, _ = self._prep_ids(row_ids)
-            self._dirty = self._mark_dirty_fn(ids.size)(
-                self._dirty, jax.device_put(ids, self._replicated))
-        return msg_id
+    def _rows_applied(self, ids: np.ndarray) -> None:
+        """Mark the applied rows stale for every worker. Fed the CROSS-
+        PROCESS UNION by MatrixTable.add_rows_async, so rows contributed
+        only by other workers still invalidate this worker's cache (ref
+        matrix.cpp:516-540 marks on the server, which sees the union by
+        construction). Pad slots point at the scratch row — marking it is
+        harmless (it is never a visible row)."""
+        self._dirty = self._mark_dirty_fn(ids.size)(
+            self._dirty, jax.device_put(ids, self._replicated))
 
     def add_async(self, delta, opt: Optional[AddOption] = None) -> int:
         msg_id = super().add_async(delta, opt)

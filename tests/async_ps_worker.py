@@ -14,6 +14,11 @@ Modes:
   kill  — the last rank dies abruptly mid-run; survivors keep trading
           rows on live shards and see a typed PSPeerError (bounded time)
           for the dead shard.
+  ftrl_lr — the reference's flagship sparse workload: every rank trains
+          sparse FTRL LR through the app on ITS OWN data shard,
+          uncoordinated, against the hash-sharded AsyncSparseKVTable
+          (ref model/ps_model.cpp:24-41, util/ftrl_sparse_table.h);
+          asserts the jointly-trained model classifies well.
 Prints "RESULT <json>" on success.
 """
 
@@ -45,8 +50,10 @@ def main():
 
     config.set_flag("ps_timeout", 20.0)
     config.set_flag("ps_connect_timeout", 10.0)
-    ctx = PSContext(rank, world,
-                    PSService(rank, world, FileRendezvous(rdv_dir)))
+    ctx = None
+    if mode != "ftrl_lr":   # ftrl_lr goes through the app's default context
+        ctx = PSContext(rank, world,
+                        PSService(rank, world, FileRendezvous(rdv_dir)))
     out = {"rank": rank}
 
     if mode == "rates":
@@ -116,10 +123,41 @@ def main():
                 os.path.exists(os.path.join(rdv_dir, f"alive.{r}"))
                 for r in range(world - 1)):
             time.sleep(0.01)
+    elif mode == "ftrl_lr":
+        # the app builds its tables against the default context — point it
+        # at this world via the ps_* flags (no JAX coordinator involved)
+        config.set_flag("ps_rendezvous", rdv_dir)
+        config.set_flag("ps_rank", rank)
+        config.set_flag("ps_world", world)
+        from multiverso_tpu.apps.logistic_regression import (LogReg,
+                                                             LogRegConfig)
+        from multiverso_tpu.models import logreg as model_lib
+        x, y = model_lib.synthetic_dataset(2048, 12, 2, seed=42)
+        train = os.path.join(rdv_dir, f"train_{rank}.svm")
+        with open(train, "w") as f:
+            for xi, yi in zip(x[rank::world], y[rank::world]):
+                feats = " ".join(f"{j}:{v:.5f}" for j, v in enumerate(xi))
+                f.write(f"{yi} {feats}\n")
+        cfg = LogRegConfig({
+            "input_size": "12", "output_size": "2", "sparse": "true",
+            "async_ps": "true", "updater_type": "ftrl",
+            "learning_rate": "0.1", "train_file": train,
+            "train_epoch": "3", "minibatch_size": "64"})
+        lr = LogReg(cfg)
+        _sync_point(rdv_dir, world, rank, "tables")
+        lr.train_file()
+        _sync_point(rdv_dir, world, rank, "trained")
+        acc = lr.test_arrays(x, y)   # full dataset, jointly-trained model
+        assert acc > 0.85, f"accuracy {acc}"
+        out["acc"] = round(float(acc), 4)
+        _sync_point(rdv_dir, world, rank, "done")
+        from multiverso_tpu.ps.service import reset_default_context
+        reset_default_context()
     else:
         raise ValueError(mode)
 
-    ctx.close()
+    if ctx is not None:
+        ctx.close()
     print("RESULT " + json.dumps(out), flush=True)
 
 

@@ -58,6 +58,17 @@ def main():
     mt2.add_rows([pid, pid + 1], np.full((2, 4), float(pid + 1), np.float32))
     out["matrix_union"] = mt2.get_rows(list(range(nprocs + 1)))[:, 0].tolist()
 
+    # sparse stale-row protocol under DIFFERING per-rank id sets: rank p
+    # adds only row p, but the dirty bits must cover the cross-process
+    # union, or every other rank serves row p stale from its cache
+    smt = mv.SparseMatrixTable(nprocs + 1, 4, name="mp_sparse_union",
+                               num_workers=nprocs)
+    all_rows = list(range(nprocs + 1))
+    smt.get_rows_sparse(all_rows, worker_id=pid)      # warm the cache
+    smt.add_rows([pid], np.ones((1, 4), np.float32))  # collective, union ids
+    out["sparse_union"] = smt.get_rows_sparse(
+        all_rows, worker_id=pid)[:, 0].tolist()
+
     # uncoordinated async plane over the jax.distributed coordinator's KV
     # store: each rank pushes its OWN disjoint rows at its own pace
     from multiverso_tpu.ps import AsyncMatrixTable

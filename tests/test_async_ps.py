@@ -495,3 +495,237 @@ class TestAsyncCheckpoint:
             np.testing.assert_allclose(a.get(), np.arange(5))
         finally:
             mv.shutdown()
+
+    def test_checkpoint_restores_updater_state(self, tmp_path):
+        """Async store/load round-trips the shard's optimizer accumulators
+        (adagrad g²) — restoring must NOT silently reset them (sync-table
+        parity: table.py store() persists ustate)."""
+        import jax
+        import multiverso_tpu as mv
+        from multiverso_tpu import checkpoint
+        mv.init()
+        try:
+            t = mv.AsyncMatrixTable(8, 3, name="ck_async_ada",
+                                    updater="adagrad")
+            t.add_rows([1, 2], np.ones((2, 3), np.float32))
+            t.flush()
+            before = [np.asarray(l) for l in jax.tree.leaves(t._shard._ustate)]
+            assert any(np.abs(b).sum() > 0 for b in before)  # g² accumulated
+            checkpoint.save(str(tmp_path), tag="u1")
+            t.add_rows([1, 2], np.ones((2, 3), np.float32))  # diverge
+            t.flush()
+            checkpoint.restore(str(tmp_path), tag="u1")
+            after = [np.asarray(l) for l in jax.tree.leaves(t._shard._ustate)]
+            assert len(after) == len(before)
+            for b, a in zip(before, after):
+                np.testing.assert_allclose(a, b, rtol=1e-6)
+        finally:
+            mv.shutdown()
+
+
+class TestAsyncSparseKVTable:
+    """Hash-sharded sparse keys + FTRL payloads on the uncoordinated plane
+    (ref sparse_table.h:1-306, ftrl_sparse_table.h:1-90,
+    model/ps_model.cpp:24-41 — the reference's flagship sparse-LR tables)."""
+
+    def _pair(self, two_ranks, **kw):
+        from multiverso_tpu.ps.tables import AsyncSparseKVTable
+        return [AsyncSparseKVTable(3, name="skv", ctx=c, **kw)
+                for c in two_ranks]
+
+    def test_hash_partition_and_accumulation(self, two_ranks):
+        t0, t1 = self._pair(two_ranks)
+        # arbitrary sparse keys, both parities (owner = key % 2)
+        keys = np.array([7, 1_000_003, 42, 88])
+        t0.add_rows(keys, np.ones((4, 3), np.float32))
+        t1.add_rows(keys[:2], 2 * np.ones((2, 3), np.float32))
+        got = t0.get_rows(keys)
+        np.testing.assert_allclose(got[:2], 3.0)   # 1 + 2
+        np.testing.assert_allclose(got[2:], 1.0)
+        # a never-touched key reads as zeros (fresh slot)
+        np.testing.assert_allclose(t1.get_rows([555])[0], 0.0)
+        # duplicate keys in one call pre-accumulate
+        t1.add_rows([9, 9], np.ones((2, 3), np.float32))
+        np.testing.assert_allclose(t0.get_rows([9])[0], 2.0)
+
+    def test_negative_and_float_keys_rejected(self, two_ranks):
+        t0, _ = self._pair(two_ranks)
+        with pytest.raises(IndexError):
+            t0.add_rows([-1], np.ones((1, 3), np.float32))
+        with pytest.raises(TypeError):
+            t0.get_rows(np.array([1.5]))
+
+    def test_ftrl_over_the_wire(self, two_ranks):
+        """FTRL z/n live as shard state; pushing raw gradients moves the
+        stored weight the way the proximal update says (sign-opposite to
+        the gradient, zero until |z| clears lambda1)."""
+        t0, t1 = self._pair(two_ranks, updater="ftrl")
+        g = np.full((1, 3), 0.5, np.float32)
+        key = [12345]
+        for _ in range(20):
+            t0.add_rows(key, g)
+        w = t0.get_rows(key)[0]
+        assert np.all(w < 0)                     # steady +g pushes w negative
+        assert np.all(np.abs(w) < 10)
+        # the other rank sees the same uncoordinated state
+        np.testing.assert_allclose(t1.get_rows(key)[0], w, rtol=1e-6)
+
+    def test_sparse_get_stale_protocol(self, two_ranks):
+        t0, t1 = self._pair(two_ranks, num_workers=2)
+        keys = np.array([3, 4, 5, 6])
+        first = t0.get_rows_sparse(keys, worker_id=0)
+        np.testing.assert_allclose(first, 0.0)
+        assert t0.last_transfer_rows == 4        # first pull: everything
+        again = t0.get_rows_sparse(keys, worker_id=0)
+        assert t0.last_transfer_rows == 0        # all fresh now
+        np.testing.assert_allclose(again, 0.0)
+        # rank 1 touches ONE key -> exactly one row re-crosses the wire
+        t1.add_rows([5], np.ones((1, 3), np.float32))
+        got = t0.get_rows_sparse(keys, worker_id=0)
+        assert t0.last_transfer_rows == 1
+        np.testing.assert_allclose(got[2], 1.0)
+
+    def test_dense_get_and_bound(self, two_ranks):
+        t0, _ = self._pair(two_ranks, num_row=10)
+        t0.add_rows([2, 9], np.ones((2, 3), np.float32))
+        dense = t0.get()
+        assert dense.shape == (10, 3)
+        np.testing.assert_allclose(dense[[2, 9]], 1.0)
+        np.testing.assert_allclose(dense[0], 0.0)
+        with pytest.raises(IndexError):
+            t0.get_rows([10])
+
+    def test_checkpoint_roundtrip_with_state(self, two_ranks, tmp_path):
+        t0, t1 = self._pair(two_ranks, updater="adagrad")
+        t0.add_rows([1, 2, 1001], np.ones((3, 3), np.float32))
+        t1.flush(), t0.flush()
+        saved_rows = t0.get_rows([1, 2, 1001])
+        with open(tmp_path / "skv.ck", "wb") as f:
+            t0.store(f)
+        t0.add_rows([1, 7], np.ones((2, 3), np.float32))  # diverge
+        with open(tmp_path / "skv.ck", "rb") as f:
+            t0.load(f)
+        np.testing.assert_allclose(t0.get_rows([1, 2, 1001]), saved_rows)
+        np.testing.assert_allclose(t0.get_rows([7])[0], 0.0)
+        # adagrad accumulators restored: the next identical add moves the
+        # weight by the same amount it did the first time after the save
+        before = t0.get_rows([1])[0].copy()
+        t0.add_rows([1], np.ones((1, 3), np.float32))
+        step_after_restore = t0.get_rows([1])[0] - before
+        assert np.all(np.abs(step_after_restore) > 0)
+
+    def test_slot_growth_past_capacity(self, two_ranks):
+        from multiverso_tpu.ps.tables import AsyncSparseKVTable
+        t0 = AsyncSparseKVTable(2, name="skv_grow", ctx=two_ranks[0])
+        AsyncSparseKVTable(2, name="skv_grow", ctx=two_ranks[1])
+        n = 3000   # > initial 1024-slot capacity per shard
+        keys = np.arange(n)
+        t0.add_rows(keys, np.ones((n, 2), np.float32))
+        got = t0.get_rows(keys[::7])
+        np.testing.assert_allclose(got, 1.0)
+
+
+class TestPipelineSparseGets:
+    """Prefetch-overlapped sparse pulls (ref matrix.cpp:407-418 is_pipeline
+    doubled its per-worker slots for exactly this; here overlapped pulls are
+    first-class). Exact rows-transferred assertions."""
+
+    def test_two_pulls_in_flight(self, two_ranks):
+        t0 = AsyncSparseMatrixTable(12, 2, num_workers=2, name="pp",
+                                    ctx=two_ranks[0])
+        t1 = AsyncSparseMatrixTable(12, 2, num_workers=2, name="pp",
+                                    ctx=two_ranks[1])
+        lo, hi = np.arange(6), np.arange(6, 12)
+        # double-buffer: both pulls dispatched before either is consumed
+        a = t0.get_rows_sparse_async(lo, worker_id=0)
+        b = t0.get_rows_sparse_async(hi, worker_id=0)
+        ra = t0.wait(a)
+        n_a = t0.last_transfer_rows
+        rb = t0.wait(b)
+        n_b = t0.last_transfer_rows
+        np.testing.assert_allclose(ra, 0.0)
+        np.testing.assert_allclose(rb, 0.0)
+        assert n_a == 6 and n_b == 6          # first epoch: everything stale
+        # steady state: overlapped pulls of fresh rows transfer NOTHING
+        a = t0.get_rows_sparse_async(lo, worker_id=0)
+        b = t0.get_rows_sparse_async(hi, worker_id=0)
+        t0.wait(a); assert t0.last_transfer_rows == 0
+        t0.wait(b); assert t0.last_transfer_rows == 0
+        # a peer dirties one row per block -> exactly one row per pull
+        t1.add_rows([2, 8], np.ones((2, 2), np.float32))
+        a = t0.get_rows_sparse_async(lo, worker_id=0)
+        b = t0.get_rows_sparse_async(hi, worker_id=0)
+        ra = t0.wait(a); assert t0.last_transfer_rows == 1
+        rb = t0.wait(b); assert t0.last_transfer_rows == 1
+        np.testing.assert_allclose(ra[2], 1.0)
+        np.testing.assert_allclose(rb[2], 1.0)   # row 8 -> position 2 in hi
+
+    def test_out_of_order_wait_stays_correct(self, two_ranks):
+        """Waiting the second pull before the first, with OVERLAPPING rows:
+        worst case the client self-heals with a plain re-pull — values are
+        always right."""
+        t0 = AsyncSparseMatrixTable(8, 2, num_workers=2, name="oo",
+                                    ctx=two_ranks[0])
+        t1 = AsyncSparseMatrixTable(8, 2, num_workers=2, name="oo",
+                                    ctx=two_ranks[1])
+        t1.add_rows(np.arange(8), np.ones((8, 2), np.float32))
+        a = t0.get_rows_sparse_async(np.arange(8), worker_id=0)
+        b = t0.get_rows_sparse_async(np.arange(4), worker_id=0)
+        rb = t0.wait(b)    # consumed before a
+        ra = t0.wait(a)
+        np.testing.assert_allclose(ra, 1.0)
+        np.testing.assert_allclose(rb, 1.0)
+
+    def test_threaded_prefetch_against_training(self, two_ranks):
+        """An AsyncBuffer-style prefetch thread pulls while the main thread
+        pushes — no corruption, final state exact."""
+        t0 = AsyncSparseMatrixTable(16, 2, num_workers=2, name="th",
+                                    ctx=two_ranks[0])
+        AsyncSparseMatrixTable(16, 2, num_workers=2, name="th",
+                               ctx=two_ranks[1])
+        stop, errs = threading.Event(), []
+
+        def prefetch():
+            try:
+                while not stop.is_set():
+                    t0.get_rows_sparse(np.arange(16), worker_id=0)
+            except Exception as e:   # pragma: no cover
+                errs.append(e)
+
+        th = threading.Thread(target=prefetch)
+        th.start()
+        for _ in range(30):
+            t0.add_rows([1, 9], np.ones((2, 2), np.float32))
+        t0.flush()
+        stop.set()
+        th.join(timeout=30)
+        assert not errs, errs
+        got = t0.get_rows_sparse(np.arange(16), worker_id=0)
+        np.testing.assert_allclose(got[1], 30.0)
+        np.testing.assert_allclose(got[9], 30.0)
+        np.testing.assert_allclose(got[0], 0.0)
+
+    def test_out_of_order_wait_does_not_revert_newer_data(self, two_ranks):
+        """An older pull consumed AFTER a newer one must not overwrite the
+        newer cached rows (the server bit is clear by then — a revert would
+        be served forever)."""
+        t0 = AsyncSparseMatrixTable(8, 2, num_workers=2, name="rv",
+                                    ctx=two_ranks[0])
+        t1 = AsyncSparseMatrixTable(8, 2, num_workers=2, name="rv",
+                                    ctx=two_ranks[1])
+        t0.get_rows_sparse(np.arange(8), worker_id=0)          # warm
+        t1.add_rows([1], np.ones((1, 2), np.float32))          # v = 1
+        a = t0.get_rows_sparse_async([1, 2], worker_id=0)
+        with t0._lock:   # stage: A fully processed server-side before B
+            futs_a = t0._pending[a][0]
+        for f in futs_a:
+            f.result(timeout=10)
+        t1.add_rows([1], np.ones((1, 2), np.float32))          # v = 2
+        b = t0.get_rows_sparse_async([1, 2, 3], worker_id=0)
+        rb = t0.wait(b)                                        # newer first
+        ra = t0.wait(a)                                        # older second
+        np.testing.assert_allclose(rb[0], 2.0)
+        np.testing.assert_allclose(ra[0], 2.0)   # not reverted to 1.0
+        again = t0.get_rows_sparse([1], worker_id=0)
+        assert t0.last_transfer_rows == 0        # cache kept the newer row
+        np.testing.assert_allclose(again[0], 2.0)

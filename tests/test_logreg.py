@@ -70,10 +70,30 @@ def test_async_ps_path_converges(tmp_path):
     # the fused path is functional-plane-only: typed error, not a crash
     with pytest.raises(ValueError, match="async_ps"):
         lr.train_arrays(x, y)
-    # sparse + async is a typed config error
-    with pytest.raises(ValueError, match="async_ps"):
-        LogRegConfig(dict(input_size="10", sparse="true",
-                          async_ps="true"))
+
+
+@pytest.mark.parametrize("updater", ["sgd", "ftrl"])
+def test_async_sparse_lr_converges(tmp_path, updater):
+    """sparse=true + async_ps=true: hash-sharded keys with the updater
+    (incl. FTRL z/n) living on the uncoordinated shard — the reference's
+    flagship sparse-LR workload (ref model/ps_model.cpp:24-41,
+    util/sparse_table.h, util/ftrl_sparse_table.h)."""
+    x, y = model_lib.synthetic_dataset(1024, 10, 2, seed=8)
+    train = tmp_path / "train.svm"
+    with open(train, "w") as f:
+        for xi, yi in zip(x, y):
+            feats = " ".join(f"{j}:{v:.5f}" for j, v in enumerate(xi))
+            f.write(f"{yi} {feats}\n")
+    cfg = _cfg(input_size=10, output_size=2, train_file=str(train),
+               test_file=str(train), train_epoch=3, sync_frequency=1,
+               async_ps="true", sparse="true", updater_type=updater,
+               learning_rate="0.5" if updater == "sgd" else "0.1")
+    lr = LogReg(cfg)
+    lr.train_file()
+    acc = lr.test_file()
+    assert acc > 0.9, f"accuracy {acc} (updater={updater})"
+    from multiverso_tpu.ps.tables import AsyncSparseKVTable
+    assert isinstance(lr.sparse_table, AsyncSparseKVTable)
 
 
 def test_pipeline_and_sync_frequency(tmp_path):

@@ -73,6 +73,9 @@ def test_process_cluster(nprocs):
         expect_union = [(k + 1 if k < nprocs else 0) + (k if k >= 1 else 0)
                         for k in range(nprocs + 1)]
         assert r["matrix_union"] == [float(v) for v in expect_union]
+        # sparse dirty bits cover the union: every rank added 1.0 to its own
+        # row, and every rank must observe ALL of them fresh
+        assert r["sparse_union"] == [1.0] * nprocs + [0.0]
         # async plane over the coordinator KV store: rank p pushed its 8
         # disjoint rows (value 1) p+1 times -> sum = 8*4*tri
         assert r["async_row_sum"] == 8 * 4 * tri

@@ -62,6 +62,18 @@ def test_uncoordinated_rates(tmp_path, nprocs):
         assert r["kv"] == {str(k): (k + 1) * 5.0 for k in range(nprocs)}
 
 
+@pytest.mark.parametrize("nprocs", [4])
+def test_uncoordinated_sparse_ftrl_lr(tmp_path, nprocs):
+    """np=4 sparse FTRL LR through the app, uncoordinated: each rank trains
+    on its own data shard against the hash-sharded FTRL table and the
+    jointly-trained model classifies the full dataset (VERDICT r2 item 3;
+    ref model/ps_model.cpp:24-41 + util/ftrl_sparse_table.h)."""
+    results = _spawn(tmp_path, nprocs, "ftrl_lr")
+    assert set(results) == set(range(nprocs))
+    for r in results.values():
+        assert r["acc"] > 0.85
+
+
 @pytest.mark.parametrize("nprocs", [3])
 def test_killed_worker_does_not_hang_peers(tmp_path, nprocs):
     """The last rank crashes mid-run (os._exit, no cleanup). Survivors keep
