@@ -180,12 +180,11 @@ def bench_we_real(n_lo: int = 1, n_hi: int = 5):
             "provenance": realtext.provenance()}
 
 
-def bench_async_ps(seconds: float = 4.0):
-    """Uncoordinated-plane throughput: two real OS processes (CPU) pushing
-    and pulling 1024-row batches against each other's shards — half the
-    traffic crosses loopback TCP, half short-circuits. Measures the
-    serialization + wire + shard-update rate of multiverso_tpu/ps, the
-    capability the reference's whole actor/MPI stack existed for."""
+def _run_async_ps_world(world: int, wire: str, seconds: float):
+    """One configuration of the uncoordinated-plane bench: ``world`` real
+    OS processes (CPU) pushing/pulling 1024-row batches against each
+    other's shards over loopback TCP (1/world of the traffic
+    short-circuits)."""
     import json as _json
     import os
     import subprocess
@@ -200,9 +199,9 @@ def bench_async_ps(seconds: float = 4.0):
         procs = [subprocess.Popen(
                     [sys.executable, os.path.join(repo, "tools",
                                                   "bench_async_ps.py"),
-                     rdv, "2", str(r), str(seconds)],
+                     rdv, str(world), str(r), str(seconds), wire],
                     stdout=subprocess.PIPE, text=True, env=env)
-                 for r in range(2)]
+                 for r in range(world)]
         try:
             for p in procs:
                 out, _ = p.communicate(timeout=240)
@@ -219,12 +218,62 @@ def bench_async_ps(seconds: float = 4.0):
                 if p.poll() is None:
                     p.kill()
                     p.wait()
-    total_rows = sum(r["rows_per_sec"] for r in results)
-    return {"rows_per_sec_2workers": total_rows,
-            "mb_per_sec_2workers": sum(r["mb_per_sec"] for r in results),
-            "batch_rows": results[0]["batch_rows"],
-            "dim": results[0]["dim"], "note":
-            "np=2 CPU processes, add+get interleaved, loopback TCP"}
+    return {
+        "rows_per_sec": round(sum(r["rows_per_sec"] for r in results)),
+        "mb_per_sec": round(sum(r["mb_per_sec"] for r in results), 1),
+        "get_p50_ms": round(float(np.median(
+            [r["get_p50_ms"] for r in results])), 2),
+        "get_p99_ms": round(float(np.max(
+            [r["get_p99_ms"] for r in results])), 2),
+    }
+
+
+def bench_async_ps(seconds: float = 4.0):
+    """Uncoordinated-plane scaling curve (ref dense-perf harness intent,
+    Test/main.cpp:340-495): throughput + request latency at np=2/4/8,
+    plus the bf16 wire variant (the SparseFilter-analogue compression)."""
+    out = {"batch_rows": 1024, "dim": 128,
+           "note": "real CPU processes, add+get interleaved, loopback TCP; "
+                   f"host has {os.cpu_count()} cores (np8 oversubscribes)"}
+    for world in (2, 4, 8):
+        out[f"np{world}"] = _run_async_ps_world(world, "none", seconds)
+    out["np2_bf16"] = _run_async_ps_world(2, "bf16", seconds)
+    # r02-comparable aliases
+    out["rows_per_sec_2workers"] = out["np2"]["rows_per_sec"]
+    out["mb_per_sec_2workers"] = out["np2"]["mb_per_sec"]
+    return out
+
+
+def bench_array_table_nontunnel(size: int = 1_000_000, iters: int = 10):
+    """The BASELINE ArrayTable metric WITHOUT the tunneled device link:
+    same code on the in-process CPU backend (subprocess so the parent's
+    TPU backend is untouched). Turns HOSTPLANE.md's 'sub-ms off the
+    tunnel' extrapolation into a measurement (VERDICT r2 item 9)."""
+    import json as _json
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import json, bench\n"
+        "import multiverso_tpu as mv\n"
+        "mv.init()\n"
+        f"r = bench.bench_array_table(size={size}, iters={iters})\n"
+        "print('RESULT ' + json.dumps(bench._sanitize(r)))\n")
+    out = subprocess.run([sys.executable, "-c", code], cwd=repo, env=env,
+                         capture_output=True, text=True, timeout=300)
+    if out.returncode != 0:
+        raise RuntimeError(f"cpu array bench rc={out.returncode}: "
+                           f"{out.stderr[-300:]}")
+    for line in out.stdout.splitlines():
+        if line.startswith("RESULT "):
+            r = _json.loads(line[len("RESULT "):])
+            r["note"] = "CPU backend, no tunnel: the same host-plane code"
+            return r
+    raise RuntimeError("cpu array bench produced no RESULT line")
 
 
 def bench_host_wire():
@@ -566,6 +615,10 @@ def main() -> None:
         async_ps_stats = {"error": f"{type(e).__name__}: {e}"[:200]}
     array_stats = bench_array_table()
     try:
+        array_cpu_stats = bench_array_table_nontunnel()
+    except Exception as e:
+        array_cpu_stats = {"error": f"{type(e).__name__}: {e}"[:200]}
+    try:
         lm_stats = bench_transformer()
     except Exception as e:  # secondary metric must never sink the bench
         lm_stats = {"error": f"{type(e).__name__}: {e}"[:200]}
@@ -624,6 +677,7 @@ def main() -> None:
         "host_wire": wire_stats,
         "async_ps_plane": async_ps_stats,
         "array_table_4M_float32": array_stats,
+        "array_table_cpu_nontunnel": array_cpu_stats,
         "transformer_lm_bs8_seq512_d256_L4": lm_stats,
         "transformer_lm_472M_bs2_seq1024_d2048_L8": lm_large_stats,
         "resnet32_cifar_50k": resnet_stats,

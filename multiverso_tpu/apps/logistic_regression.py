@@ -185,7 +185,12 @@ class LogReg:
                 ignore = lambda: elastic.failed(cfg.heartbeat_dir)
             ssp_clock = SSPClock(cfg.ssp_dir, staleness=cfg.staleness,
                                  timeout=cfg.ssp_timeout, ignore=ignore)
-        self._sync_model()
+        # the sparse path trains against the table's row ops directly —
+        # _local_w is only read by test/save (which sync themselves), and a
+        # dense pull of a hash-sharded table would materialize every
+        # possible key for nothing
+        if not cfg.sparse:
+            self._sync_model()
         for epoch in range(cfg.train_epoch):
             reader = SampleReader(cfg.train_file, cfg.input_size,
                                   cfg.minibatch_size, fmt=cfg.reader_type)
@@ -202,7 +207,8 @@ class LogReg:
                     log.info("epoch %d, samples %d, loss %.4f",
                              epoch, seen, losses[-1])
             mv.barrier()
-            self._sync_model()
+            if not cfg.sparse:
+                self._sync_model()
         if pull_buffer is not None:
             pull_buffer.stop()
         dt = time.perf_counter() - t0

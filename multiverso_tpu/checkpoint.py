@@ -133,6 +133,13 @@ def restore(directory: str, tag: str = "checkpoint") -> int:
                 f"checkpoint table {table_id} is {entry['name']!r}, "
                 f"registry has {table.name!r} — create tables in the same "
                 "order before restoring")
+        if (zoo.rank() != 0
+                and not getattr(table, "collective_store", True)):
+            # async tables: load() pushes the full state to every owner —
+            # plain RPC, not a collective; rank 0's push restores everyone
+            # (same gate as save(), symmetric)
+            restored += 1
+            continue
         with open_stream(_join(path, entry["file"]), "rb") as s:
             table.load(s)
         restored += 1

@@ -85,6 +85,11 @@ config.define_float("ps_timeout", 300.0,
                     "per program on a cold TPU)")
 config.define_float("ps_connect_timeout", 30.0,
                     "async-PS peer connect timeout seconds")
+config.define_float("ps_reconnect_backoff", 5.0,
+                    "seconds to fail fast against a rank that just died "
+                    "before trying a fresh rendezvous lookup + reconnect "
+                    "(lets a RESTARTED rank rejoin without every request "
+                    "to a still-dead one stalling a connect timeout)")
 
 
 class PSError(RuntimeError):
@@ -168,8 +173,10 @@ class JaxRendezvous:
 # ---------------------------------------------------------------------- #
 class _Peer:
     def __init__(self, rank: int, addr: str, connect_timeout: float,
-                 io_timeout: float):
+                 io_timeout: float,
+                 on_death: Optional[Callable[[Exception], None]] = None):
         self.rank = rank
+        self._on_death = on_death
         host, port = addr.rsplit(":", 1)
         deadline = time.monotonic() + connect_timeout
         last: Optional[Exception] = None
@@ -224,6 +231,8 @@ class _Peer:
             for fut in pending.values():
                 if not fut.done():
                     fut.set_exception(err)
+            if self._on_death is not None:
+                self._on_death(err)
 
     def request(self, msg_type: int, meta: Dict,
                 arrays: Sequence[np.ndarray]) -> cf.Future:
@@ -244,6 +253,8 @@ class _Peer:
                 with self._pending_lock:
                     self._pending.pop(msg_id, None)
                 fut.set_exception(err)
+                if self._on_death is not None:
+                    self._on_death(err)
                 return fut
         # the recv loop may have died BETWEEN the entry _dead check and the
         # _pending insert (it fails only futures it saw in _pending when it
@@ -299,6 +310,10 @@ class PSService:
         self._peers: Dict[int, _Peer] = {}
         self._peers_lock = threading.Lock()
         self._peer_locks: Dict[int, threading.Lock] = {}
+        # rank -> last observed death (monotonic ts); feeds the reconnect
+        # backoff and the death hooks (elastic integration)
+        self._dead_ranks: Dict[int, float] = {}
+        self._death_hooks: List[Callable[[int], None]] = []
         self._conns: List[socket.socket] = []
         self._conns_lock = threading.Lock()
         self._closed = False
@@ -384,29 +399,78 @@ class PSService:
             conn.close()
 
     # ----------------------------- client side ----------------------- #
+    def add_death_hook(self, fn: Callable[[int], None]) -> None:
+        """``fn(rank)`` runs when a peer connection is observed dead —
+        the PS plane's failure signal, consumable by elastic heartbeats
+        (elastic.bind_ps) or any supervisor."""
+        self._death_hooks.append(fn)
+
+    def dead_ranks(self) -> List[int]:
+        """Ranks whose connection died and has not been re-established."""
+        with self._peers_lock:
+            return sorted(self._dead_ranks)
+
+    def _note_death(self, rank: int, hooks: bool = True) -> None:
+        """``hooks=False`` records the failure for reconnect backoff only:
+        a rendezvous-lookup/connect timeout may just mean the rank has not
+        STARTED yet — only an established socket dying is a death signal
+        worth tombstoning (a supervisor keying restarts off elastic.failed
+        must not kill a rank that was never up)."""
+        with self._peers_lock:
+            self._dead_ranks[rank] = time.monotonic()
+        if not hooks:
+            return
+        for fn in self._death_hooks:
+            try:
+                fn(rank)
+            except Exception as e:   # a hook must never break the plane
+                log.error("ps death hook failed for rank %d: %s", rank, e)
+
     def _peer(self, rank: int) -> _Peer:
         # two-phase: the global lock only guards the dict; the (slow)
         # rendezvous lookup + connect runs under a PER-RANK lock, so a dead
         # rank's connect_timeout cannot stall requests to healthy ranks
         with self._peers_lock:
             peer = self._peers.get(rank)
-            if peer is not None:
+            if peer is not None and peer._dead is None:
                 return peer
+            if peer is not None:
+                # dead connection: fail fast inside the backoff window,
+                # else drop it and re-resolve below — a RESTARTED rank
+                # republished its address, so a fresh rendezvous lookup
+                # finds the new incarnation (recovery path)
+                last = self._dead_ranks.get(rank, 0.0)
+                if (time.monotonic() - last
+                        < config.get_flag("ps_reconnect_backoff")):
+                    raise peer._dead
+                del self._peers[rank]
+                peer.close()   # release the dead socket fd now, not at GC
             lock = self._peer_locks.setdefault(rank, threading.Lock())
         with lock:
             with self._peers_lock:
                 peer = self._peers.get(rank)
-                if peer is not None:
+                if peer is not None and peer._dead is None:
                     return peer
             if self._rendezvous is None:
                 raise PSError("no rendezvous configured for remote ranks")
-            addr = self._rendezvous.lookup(
-                rank, config.get_flag("ps_connect_timeout"))
-            peer = _Peer(rank, addr,
-                         config.get_flag("ps_connect_timeout"),
-                         config.get_flag("ps_timeout"))
+            try:
+                addr = self._rendezvous.lookup(
+                    rank, config.get_flag("ps_connect_timeout"))
+                peer = _Peer(rank, addr,
+                             config.get_flag("ps_connect_timeout"),
+                             config.get_flag("ps_timeout"),
+                             on_death=lambda e, r=rank: self._note_death(r))
+            except PSError:
+                # lookup/connect failure: backoff yes, death hooks no —
+                # the rank may simply not be up yet
+                self._note_death(rank, hooks=False)
+                raise
             with self._peers_lock:
+                stale = self._peers.get(rank)
                 self._peers[rank] = peer
+                self._dead_ranks.pop(rank, None)   # fresh incarnation
+            if stale is not None:
+                stale.close()
             return peer
 
     def request(self, rank: int, msg_type: int, meta: Dict,

@@ -322,8 +322,11 @@ class HashShard(RowShard):
     LogisticRegression/src/util/sparse_table.h:1-306 hash-stored
     SparseServerTable; util/ftrl_sparse_table.h:1-90 FTRL z/n payloads,
     which arrive here as updater state on the row axis). The slot buffer
-    doubles on demand; a Get of a never-added key allocates its slot and
-    returns the initial row (zeros — exactly FTRL's w for empty z/n)."""
+    doubles on demand; a plain Get of a never-added key returns the
+    initial row (zeros — exactly FTRL's w for empty z/n) WITHOUT
+    allocating, so dense sweeps over a huge key space cost no server
+    memory. Adds, set_rows, and sparse (dirty-bit) gets allocate — those
+    are keys the workload actually touches."""
 
     def __init__(self, num_col: int, dtype, updater: Updater, name: str,
                  capacity: int = 1024, num_workers: int = 0):
@@ -414,6 +417,18 @@ class HashShard(RowShard):
                     raise IndexError(f"{self.name}: empty key batch")
                 if np.any(keys < 0):
                     raise IndexError(f"{self.name}: negative keys")
+                if msg_type == svc.MSG_GET_ROWS and not meta.get("sparse"):
+                    # allocation-free read: unknown keys gather the scratch
+                    # row, which is invariantly zeros (padded adds apply
+                    # zero deltas to it)
+                    slots = np.array(
+                        [self._slot_of.get(k, self.n)
+                         for k in keys.tolist()], np.int64)
+                    padded = self._pad_to_bucket(slots)
+                    rows = np.asarray(self._get_fn(padded.size)(
+                        self._data, padded))[: keys.size]
+                    return {}, [wire.to_wire(rows,
+                                             meta.get("wire", "none"))]
                 slots = self._slots_for(keys)
                 arrays = [slots] + list(arrays[1:])
             return super().handle(msg_type, meta, arrays)
@@ -442,7 +457,7 @@ class HashShard(RowShard):
             else:
                 leaves.append(arr)
             axes.append(axis)
-        return ({"axes": axes}, [keys, rows] + leaves)
+        return ({}, [keys, rows] + leaves)
 
     def _restore(self, arrays: Sequence[np.ndarray]
                  ) -> Tuple[Dict, List[np.ndarray]]:

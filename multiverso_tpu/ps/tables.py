@@ -405,6 +405,38 @@ class AsyncMatrixTable(_AsyncBase):
                                          {"table": self.name}, leaves),
                 timeout, f"table[{self.name}] state to {r}")
 
+    def load_local(self, stream) -> None:
+        """Restore ONLY this rank's owned row range (+ its updater state)
+        from a full-table checkpoint stream — elastic shard recovery: a
+        restarted owner reloads its shard without touching the peers'
+        NEWER live state (a full load() would roll everyone back)."""
+        data = np.load(stream)
+        if data.shape != self.shape:
+            raise ValueError(f"checkpoint shape {data.shape} != {self.shape}")
+        me = self.ctx.rank
+        for r, a, b in self._ranges:
+            if r == me:
+                self.set_rows(np.arange(a, b), data[a:b])
+        try:
+            header = np.load(stream)
+        except (EOFError, OSError, ValueError):
+            log.warning("table[%s]: checkpoint has no updater state; "
+                        "local shard accumulators reset", self.name)
+            return
+        if (header.size != 2 or int(header[0]) != self._STATE_MARKER
+                or int(header[1]) != len(self._ranges)):
+            raise ValueError(f"table[{self.name}]: unrecognized or "
+                             "mismatched checkpoint trailer")
+        timeout = config.get_flag("ps_timeout")
+        for r, _, _ in self._ranges:
+            n = int(np.load(stream)[0])
+            leaves = [np.load(stream) for _ in range(n)]
+            if r == me:
+                svc.await_reply(
+                    self.ctx.service.request(r, svc.MSG_SET_STATE,
+                                             {"table": self.name}, leaves),
+                    timeout, f"table[{self.name}] state to {r}")
+
 
 class _SparseGetMixin:
     """Worker-side half of the stale-row protocol, shared by the range-
@@ -639,6 +671,13 @@ class AsyncSparseKVTable(_SparseGetMixin, _AsyncBase):
                 np.save(stream, a, allow_pickle=False)
 
     def load(self, stream) -> None:
+        self._load(stream, only_local=False)
+
+    def load_local(self, stream) -> None:
+        """Elastic shard recovery: restore only this rank's hash shard."""
+        self._load(stream, only_local=True)
+
+    def _load(self, stream, only_local: bool) -> None:
         world = int(np.load(stream)[0])
         if world != self.ctx.world:
             raise ValueError(
@@ -648,6 +687,8 @@ class AsyncSparseKVTable(_SparseGetMixin, _AsyncBase):
         for r in range(self.ctx.world):
             n = int(np.load(stream)[0])
             arrays = [np.load(stream) for _ in range(n)]
+            if only_local and r != self.ctx.rank:
+                continue
             svc.await_reply(
                 self.ctx.service.request(
                     r, svc.MSG_SET_STATE, {"table": self.name, "dump": True},
@@ -709,6 +750,9 @@ class AsyncArrayTable(_AsyncBase):
         if data.ndim == 1:   # legacy 1-D array-table stream stays loadable
             data = data.reshape(self.size, 1)
         self._m.load(stream, _data=data)
+
+    def load_local(self, stream) -> None:
+        self._m.load_local(stream)
 
 
 class AsyncMatrixTableOption:

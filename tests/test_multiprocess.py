@@ -76,6 +76,8 @@ def test_process_cluster(nprocs):
         # sparse dirty bits cover the union: every rank added 1.0 to its own
         # row, and every rank must observe ALL of them fresh
         assert r["sparse_union"] == [1.0] * nprocs + [0.0]
+        # the multi-host rendezvous path was actually taken
+        assert r["rendezvous"] == "JaxRendezvous"
         # async plane over the coordinator KV store: rank p pushed its 8
         # disjoint rows (value 1) p+1 times -> sum = 8*4*tri
         assert r["async_row_sum"] == 8 * 4 * tri

@@ -6,7 +6,8 @@ fixed duration — uncoordinated, so the measured rate is the plane's
 (serialization + TCP + shard update) throughput, not a collective's.
 
 Invoked as: python tools/bench_async_ps.py <rdv> <world> <rank> <seconds>
-Prints "RESULT {...}" with ops and rows moved.
+           [wire]
+Prints "RESULT {...}" with ops, rows moved, and get-latency percentiles.
 """
 
 import json
@@ -18,6 +19,7 @@ import time
 def main():
     rdv_dir, world, rank, seconds = (sys.argv[1], int(sys.argv[2]),
                                      int(sys.argv[3]), float(sys.argv[4]))
+    wire = sys.argv[5] if len(sys.argv) > 5 else "none"
     import jax
     jax.config.update("jax_platforms", "cpu")
     import numpy as np
@@ -32,7 +34,8 @@ def main():
     ctx = PSContext(rank, world,
                     PSService(rank, world, FileRendezvous(rdv_dir)))
     rows, dim, batch = 100_000, 128, 1024
-    t = AsyncMatrixTable(rows, dim, name="bench_async", ctx=ctx)
+    t = AsyncMatrixTable(rows, dim, name="bench_async", wire=wire,
+                         ctx=ctx)
     rng = np.random.default_rng(rank)
     # this worker's ids: strided so every batch spans BOTH shards (half
     # the traffic crosses the socket, half short-circuits — the realistic
@@ -45,12 +48,14 @@ def main():
 
     ops = 0
     start = time.monotonic()
-    mids = []
+    mids, get_lat = [], []
     while time.monotonic() - start < seconds:
         mids.append(t.add_rows_async(ids, vals))
         if len(mids) >= 4:      # bounded pipeline depth
             t.wait(mids.pop(0))
+        g0 = time.monotonic()
         t.get_rows(ids)
+        get_lat.append(time.monotonic() - g0)
         ops += 2
     for m in mids:
         t.wait(m)
@@ -61,7 +66,9 @@ def main():
         "rank": rank, "ops": ops, "rows": ops * batch, "seconds": dt,
         "rows_per_sec": ops * batch / dt,
         "mb_per_sec": ops * batch * dim * 4 / dt / 1e6,
-        "batch_rows": batch, "dim": dim}), flush=True)
+        "get_p50_ms": float(np.percentile(get_lat, 50) * 1e3),
+        "get_p99_ms": float(np.percentile(get_lat, 99) * 1e3),
+        "batch_rows": batch, "dim": dim, "wire": wire}), flush=True)
 
 
 if __name__ == "__main__":
