@@ -225,6 +225,8 @@ def _run_async_ps_world(world: int, wire: str, seconds: float):
             [r["get_p50_ms"] for r in results])), 2),
         "get_p99_ms": round(float(np.max(
             [r["get_p99_ms"] for r in results])), 2),
+        "batch_rows": results[0]["batch_rows"],   # worker-reported truth
+        "dim": results[0]["dim"],
     }
 
 
@@ -232,8 +234,7 @@ def bench_async_ps(seconds: float = 4.0):
     """Uncoordinated-plane scaling curve (ref dense-perf harness intent,
     Test/main.cpp:340-495): throughput + request latency at np=2/4/8,
     plus the bf16 wire variant (the SparseFilter-analogue compression)."""
-    out = {"batch_rows": 1024, "dim": 128,
-           "note": "real CPU processes, add+get interleaved, loopback TCP; "
+    out = {"note": "real CPU processes, add+get interleaved, loopback TCP; "
                    f"host has {os.cpu_count()} cores (np8 oversubscribes)"}
     for world in (2, 4, 8):
         out[f"np{world}"] = _run_async_ps_world(world, "none", seconds)

@@ -107,16 +107,25 @@ def mark_failed(directory: str, rank: int) -> None:
     """Tombstone ``rank`` as failed NOW — the PS plane's socket-death
     signal feeding the heartbeat view (see :func:`bind_ps`), so a peer
     death is visible immediately instead of after a heartbeat timeout.
-    A beacon newer than the tombstone clears it (the rank rejoined)."""
+
+    The tombstone records the rank's LAST-SEEN beacon timestamp (the
+    subject's own clock): it clears as soon as a beacon newer than that
+    appears. Comparing subject-clock to subject-clock keeps the verdict
+    immune to cross-host wall-clock skew — an observer's clock being
+    minutes ahead must not keep a rejoined rank 'dead'."""
     os.makedirs(directory, exist_ok=True)
+    beacon = peers(directory).get(int(rank))
+    seen_ts = float(beacon["ts"]) if beacon else float("-inf")
     path = os.path.join(directory, f"failed.{int(rank)}.json")
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
-        json.dump({"rank": int(rank), "ts": time.time()}, f)
+        json.dump({"rank": int(rank), "ts": time.time(),
+                   "beacon_ts": seen_ts}, f)
     os.replace(tmp, path)
 
 
 def _tombstones(directory: str) -> Dict[int, float]:
+    """rank -> last-seen beacon ts (subject clock) at tombstone time."""
     out: Dict[int, float] = {}
     if not os.path.isdir(directory):
         return out
@@ -126,7 +135,8 @@ def _tombstones(directory: str) -> Dict[int, float]:
         try:
             with open(os.path.join(directory, name)) as f:
                 entry = json.load(f)
-            out[int(entry["rank"])] = float(entry["ts"])
+            out[int(entry["rank"])] = float(
+                entry.get("beacon_ts", entry["ts"]))
         except (ValueError, KeyError, TypeError, json.JSONDecodeError,
                 OSError):
             continue
@@ -136,13 +146,14 @@ def _tombstones(directory: str) -> Dict[int, float]:
 def failed(directory: str, timeout: float = 30.0) -> List[int]:
     """Ranks considered dead: beacon older than ``timeout`` seconds, OR
     tombstoned by a PS-plane death (:func:`mark_failed`) with no beacon
-    newer than the tombstone."""
+    newer than the one the tombstone recorded (both timestamps are the
+    subject's own clock — cross-host skew cannot pin a rejoined rank)."""
     now = time.time()
     beacons = peers(directory)
     out = {r for r, e in beacons.items() if now - float(e["ts"]) > timeout}
-    for rank, ts in _tombstones(directory).items():
+    for rank, seen_ts in _tombstones(directory).items():
         beacon = beacons.get(rank)
-        if beacon is None or float(beacon["ts"]) <= ts:
+        if beacon is None or float(beacon["ts"]) <= seen_ts:
             out.add(rank)
     return sorted(out)
 

@@ -434,15 +434,17 @@ class PSService:
             peer = self._peers.get(rank)
             if peer is not None and peer._dead is None:
                 return peer
+            # known-dead rank (cached dead peer OR a recent failed
+            # lookup/connect with nothing cached): fail fast inside the
+            # backoff window, else re-resolve below — a RESTARTED rank
+            # republished its address, so a fresh rendezvous lookup finds
+            # the new incarnation (recovery path)
+            last = self._dead_ranks.get(rank)
+            if (last is not None and time.monotonic() - last
+                    < config.get_flag("ps_reconnect_backoff")):
+                raise (peer._dead if peer is not None else PSPeerError(
+                    f"rank {rank} unreachable (in reconnect backoff)"))
             if peer is not None:
-                # dead connection: fail fast inside the backoff window,
-                # else drop it and re-resolve below — a RESTARTED rank
-                # republished its address, so a fresh rendezvous lookup
-                # finds the new incarnation (recovery path)
-                last = self._dead_ranks.get(rank, 0.0)
-                if (time.monotonic() - last
-                        < config.get_flag("ps_reconnect_backoff")):
-                    raise peer._dead
                 del self._peers[rank]
                 peer.close()   # release the dead socket fd now, not at GC
             lock = self._peer_locks.setdefault(rank, threading.Lock())

@@ -446,17 +446,14 @@ class HashShard(RowShard):
                 self._data, padded))[: keys.size]
         else:
             rows = np.zeros((0, self.num_col), self.dtype)
-        leaves, axes = [], []
+        leaves = []
         for leaf in jax.tree.leaves(self._ustate):
             axis = self._state_row_axis(leaf)
             arr = np.asarray(leaf)
-            if axis >= 0 and keys.size:
+            if axis >= 0:
                 leaves.append(np.take(arr, slots, axis=axis))
-            elif axis >= 0:
-                leaves.append(np.take(arr, np.empty(0, np.int64), axis=axis))
             else:
                 leaves.append(arr)
-            axes.append(axis)
         return ({}, [keys, rows] + leaves)
 
     def _restore(self, arrays: Sequence[np.ndarray]

@@ -374,11 +374,24 @@ class AsyncMatrixTable(_AsyncBase):
                 np.save(stream, leaf, allow_pickle=False)
 
     def load(self, stream, _data: Optional[np.ndarray] = None) -> None:
+        self._load(stream, only_local=False, _data=_data)
+
+    def load_local(self, stream) -> None:
+        """Restore ONLY this rank's owned row range (+ its updater state)
+        from a full-table checkpoint stream — elastic shard recovery: a
+        restarted owner reloads its shard without touching the peers'
+        NEWER live state (a full load() would roll everyone back)."""
+        self._load(stream, only_local=True)
+
+    def _load(self, stream, only_local: bool,
+              _data: Optional[np.ndarray] = None) -> None:
         data = np.load(stream) if _data is None else _data
         if data.shape != self.shape:
             raise ValueError(f"checkpoint shape {data.shape} != {self.shape}")
+        me = self.ctx.rank
         for r, a, b in self._ranges:
-            self.set_rows(np.arange(a, b), data[a:b])
+            if not only_local or r == me:
+                self.set_rows(np.arange(a, b), data[a:b])
         try:
             header = np.load(stream)
         except (EOFError, OSError, ValueError):
@@ -400,42 +413,12 @@ class AsyncMatrixTable(_AsyncBase):
         for r, _, _ in self._ranges:
             n = int(np.load(stream)[0])
             leaves = [np.load(stream) for _ in range(n)]
+            if only_local and r != me:
+                continue
             svc.await_reply(
                 self.ctx.service.request(r, svc.MSG_SET_STATE,
                                          {"table": self.name}, leaves),
                 timeout, f"table[{self.name}] state to {r}")
-
-    def load_local(self, stream) -> None:
-        """Restore ONLY this rank's owned row range (+ its updater state)
-        from a full-table checkpoint stream — elastic shard recovery: a
-        restarted owner reloads its shard without touching the peers'
-        NEWER live state (a full load() would roll everyone back)."""
-        data = np.load(stream)
-        if data.shape != self.shape:
-            raise ValueError(f"checkpoint shape {data.shape} != {self.shape}")
-        me = self.ctx.rank
-        for r, a, b in self._ranges:
-            if r == me:
-                self.set_rows(np.arange(a, b), data[a:b])
-        try:
-            header = np.load(stream)
-        except (EOFError, OSError, ValueError):
-            log.warning("table[%s]: checkpoint has no updater state; "
-                        "local shard accumulators reset", self.name)
-            return
-        if (header.size != 2 or int(header[0]) != self._STATE_MARKER
-                or int(header[1]) != len(self._ranges)):
-            raise ValueError(f"table[{self.name}]: unrecognized or "
-                             "mismatched checkpoint trailer")
-        timeout = config.get_flag("ps_timeout")
-        for r, _, _ in self._ranges:
-            n = int(np.load(stream)[0])
-            leaves = [np.load(stream) for _ in range(n)]
-            if r == me:
-                svc.await_reply(
-                    self.ctx.service.request(r, svc.MSG_SET_STATE,
-                                             {"table": self.name}, leaves),
-                    timeout, f"table[{self.name}] state to {r}")
 
 
 class _SparseGetMixin:
