@@ -96,6 +96,15 @@ class WEConfig:
         # single-controller case); "0" forces the host Get/Add plane (the
         # multi-worker wire path); "1" asserts the device plane.
         self.ps_device_plane = str(kw.get("ps_device_plane", "auto"))
+        # compute dtype INSIDE the block scan (both planes): "bf16" casts
+        # the pulled rows for the scan (the table stays f32; deltas are
+        # measured against the bf16-rounded baseline so untrained rows get
+        # exactly-zero deltas). Default f32 — the block step is
+        # gather-bound; measured bf16 gain on-chip is ~2%.
+        self.ps_block_dtype = str(kw.get("ps_block_dtype", "f32"))
+        if self.ps_block_dtype not in ("f32", "bf16"):
+            raise ValueError(
+                f"unknown ps_block_dtype {self.ps_block_dtype!r}")
         self.data_presplit = str(kw.get("data_presplit", "0")) in (
             "1", "true", "True")
         self.max_vocab = kw.get("max_vocab")
@@ -322,28 +331,6 @@ class WordEmbedding:
     # ------------------------------------------------------------------ #
     # PS block path (reference block pipeline; multi-worker capable)
     # ------------------------------------------------------------------ #
-    def _block_step_fn(self):
-        """Jitted per-minibatch step for the active (cbow, hs) mode; the
-        PS-block path supports all four variants like the reference's
-        distributed trainer (ref wordembedding.cpp FeedForward/HS/NS
-        branches)."""
-        if not hasattr(self, "_block_jit"):
-            cfg = self.cfg
-            if cfg.cbow and cfg.hs:
-                fn = lambda a, b, w, m, c, p, pm: w2v.cbow_hs_step(
-                    a, b, w, m, c, p, pm, cfg.alpha)
-            elif cfg.cbow:
-                fn = lambda a, b, w, m, t, n: w2v.cbow_ns_step(
-                    a, b, w, m, t, n, cfg.alpha)
-            elif cfg.hs:
-                fn = lambda a, b, c, cd, p, pm: w2v.skipgram_hs_step(
-                    a, b, c, cd, p, pm, cfg.alpha)
-            else:
-                fn = lambda a, b, c, x, n: w2v.skipgram_ns_step(
-                    a, b, c, x, n, cfg.alpha)
-            self._block_jit = jax.jit(fn)
-        return self._block_jit
-
     def _use_device_plane(self, num_workers: int) -> bool:
         """The single-worker sync case fuses each block's pull+train+push
         into ONE device program (see :meth:`_fused_block_fn`); multi-worker
@@ -506,116 +493,12 @@ class WordEmbedding:
             seen[np.asarray(a).reshape(-1)] = True
         return np.flatnonzero(seen)
 
-    def _prepare_block(self, block: np.ndarray, rng) -> Dict:
-        """Host-plane block prep + *dispatch* of the row pulls
-        (ref RequestParameter, communicator.cpp:104-142)."""
-        cfg = self.cfg
-        with monitor("we.prepare"):
-            prep = self._block_arrays(block, rng)
-            vocab = prep["vocab"]
-            if cfg.hs:
-                hs_rows = prep["hs_rows"]
-                # remap path points into the pulled hs block; padded path
-                # slots route to a dummy extra row (their grads are masked
-                # to zero, the scatter just needs a valid index)
-                remap_hs = np.full(self.table_hs.shape[0] + 1,
-                                   hs_rows.size, np.int64)
-                remap_hs[hs_rows] = np.arange(hs_rows.size)
-                prep.update(remap_hs=remap_hs,
-                            pull_hs=self.table_hs.get_rows_async(hs_rows))
-            remap = np.full(len(self.dict), -1, np.int64)
-            remap[vocab] = np.arange(vocab.size)
-            prep.update(
-                remap=remap,
-                pull_in=self.table_in.get_rows_async(vocab))
-            if not cfg.hs:
-                prep["pull_out"] = self.table_out.get_rows_async(vocab)
-            return prep
-
-    def _read_pull(self, table, msg_id):
-        return jnp.asarray(table.wait(msg_id))
-
-    def _train_prepared(self, prep: Dict, num_workers: int) -> float:
-        cfg = self.cfg
-        with monitor("we.block"):
-            win_l = self._read_pull(self.table_in, prep["pull_in"])
-            examples = (prep["targets"] if cfg.cbow
-                        else prep["centers"])
-            if examples.size == 0:
-                return 0.0
-            old_in = win_l
-            if cfg.hs:
-                pulled = self._read_pull(self.table_hs, prep["pull_hs"])
-                # one dummy extra row catches padded path slots (their
-                # grads are masked to zero; the scatter needs a valid id)
-                wsec_l = jnp.concatenate(
-                    [pulled, jnp.zeros((1, pulled.shape[1]),
-                                       pulled.dtype)])
-            else:
-                wsec_l = self._read_pull(self.table_out, prep["pull_out"])
-            old_sec = wsec_l
-            step = self._block_step_fn()
-            remap = prep["remap"]
-            b = cfg.batch_size
-            n = max((examples.size // b) * b, 0)
-            # loss accumulates ON DEVICE; one host readback per block, not
-            # one per minibatch (each readback is a full dispatch round-trip)
-            loss_acc, nb = jnp.zeros(()), 0
-            for i in range(0, n, b):
-                sl = slice(i, i + b)
-                if cfg.cbow:
-                    head = (jnp.asarray(remap[prep["windows"][sl]],
-                                        jnp.int32),
-                            jnp.asarray(prep["masks"][sl]))
-                else:
-                    head = (jnp.asarray(remap[prep["centers"][sl]],
-                                        jnp.int32),)
-                if cfg.hs:
-                    tail = (jnp.asarray(prep["codes"][sl], jnp.int32),
-                            jnp.asarray(prep["remap_hs"][prep["points"][sl]],
-                                        jnp.int32),
-                            jnp.asarray(prep["pmask"][sl]))
-                elif cfg.cbow:
-                    tail = (jnp.asarray(remap[prep["targets"][sl]],
-                                        jnp.int32),
-                            jnp.asarray(remap[prep["negs"][sl]], jnp.int32))
-                else:
-                    tail = (jnp.asarray(remap[prep["contexts"][sl]],
-                                        jnp.int32),
-                            jnp.asarray(remap[prep["negs"][sl]], jnp.int32))
-                win_l, wsec_l, loss = step(win_l, wsec_l, *head, *tail)
-                loss_acc, nb = loss_acc + loss, nb + 1
-            # AddDeltaParameter: (new - old) / workers, pushed ASYNC like
-            # the reference (ref communicator.cpp:144-236 AddAsync) — the
-            # push overlaps the next block's prep/compute. Ordering is
-            # safe: sync tables dispatch in program order, and on the
-            # async plane arrival-order accumulation is the semantics.
-            with monitor("we.push"):
-                d_in = np.asarray(win_l - old_in) / num_workers
-                self.table_in.add_rows_async(prep["vocab"], d_in)
-                d_sec = np.asarray(wsec_l - old_sec) / num_workers
-                if cfg.hs:
-                    self.table_hs.add_rows_async(prep["hs_rows"],
-                                                 d_sec[:-1])  # drop dummy
-                else:
-                    self.table_out.add_rows_async(prep["vocab"], d_sec)
-            return float(loss_acc) / max(nb, 1)
-
-    # ------------------------------------------------------------------ #
-    # PS block path, device plane (single-worker sync): ONE program per
-    # block
-    # ------------------------------------------------------------------ #
-    def _sec_table(self):
-        return self.table_hs if self.cfg.hs else self.table_out
-
-    def _prepare_block_device(self, block: np.ndarray, rng) -> Optional[Dict]:
-        """Pack the block's training arrays into bucketed device-resident
-        batches. Index spaces: table row ids are remapped into the block's
-        pulled-row array; the bucket's pad slots and padded minibatches
-        point at a dummy extra row appended after the pulled rows, so their
-        (masked) garbage never touches real rows. ONE pytree device_put =
-        one host->device transfer per block, overlapped with the previous
-        block's compute by JAX async dispatch."""
+    def _prepare_block(self, block: np.ndarray, rng) -> Optional[Dict]:
+        """Host-plane block prep: *dispatch* the row pulls
+        (ref RequestParameter, communicator.cpp:104-142) and pack the
+        batch arrays for the local-train scan. Compute is the SAME packed
+        ``lax.scan`` as the device plane — only pull/push differ (table
+        Get/Add over the wire here, in-graph gather/scatter there)."""
         cfg = self.cfg
         b = cfg.batch_size
         with monitor("we.prepare"):
@@ -623,11 +506,208 @@ class WordEmbedding:
             n = (prep["examples"].size // b) * b
             if n == 0:
                 return None
-            nb = n // b
+            nbb = -(-(n // b) // 8) * 8
+            vocab = prep["vocab"]
+            k = vocab.size
+            # bucket the pulled-row count so the jitted scan compiles once
+            # per bucket, not once per block's distinct vocab size (the
+            # device plane buckets for the same reason); the pulled rows
+            # are zero-padded to the bucket before the scan
+            kb = _bucket_size(k, 1 << 30)
+            remap_hs, hkb = None, 0
+            if cfg.hs:
+                hs_rows = prep["hs_rows"]
+                hkb = _bucket_size(hs_rows.size, 1 << 30)
+                # remap path points into the pulled hs block; padded path
+                # slots route to a dummy extra row (their grads are masked
+                # to zero, the scatter just needs a valid index)
+                remap_hs = np.full(self.table_hs.shape[0] + 1, hkb, np.int64)
+                remap_hs[hs_rows] = np.arange(hs_rows.size)
+                prep["pull_hs"] = self.table_hs.get_rows_async(hs_rows)
+            remap = np.full(len(self.dict), kb, np.int64)   # default: dummy
+            remap[vocab] = np.arange(k)
+            batch, valid = self._pack_batches(prep, n, nbb, remap, kb,
+                                              remap_hs, hkb)
+            prep.update(batch=batch, valid=valid, kb=kb, hkb=hkb,
+                        pull_in=self.table_in.get_rows_async(vocab))
+            if not cfg.hs:
+                prep["pull_out"] = self.table_out.get_rows_async(vocab)
+            return prep
+
+    def _train_prepared(self, prep: Optional[Dict],
+                        num_workers: int) -> float:
+        """Consume the pulls, run the block's packed scan, push the
+        (new - old)/workers deltas ASYNC like the reference
+        (ref communicator.cpp:144-236 AddAsync) — the push overlaps the
+        next block's prep/compute. Ordering is safe: sync tables dispatch
+        in program order; on the async plane arrival-order accumulation
+        is the semantics."""
+        cfg = self.cfg
+        if prep is None:
+            return 0.0
+        with monitor("we.block"):
+            def padded(rows, kb):
+                return jnp.asarray(np.pad(
+                    rows, [(0, kb - rows.shape[0]), (0, 0)]))
+
+            win_l = padded(self.table_in.wait(prep["pull_in"]), prep["kb"])
+            sec_t = self._sec_table()
+            wsec_l = padded(
+                sec_t.wait(prep["pull_hs" if cfg.hs else "pull_out"]),
+                prep["hkb"] if cfg.hs else prep["kb"])
+            d_in, d_sec, loss = self._local_train_fn()(
+                win_l, wsec_l, jnp.asarray(prep["valid"]),
+                jax.device_put(prep["batch"]))
+            with monitor("we.push"):
+                k = prep["vocab"].size
+                self.table_in.add_rows_async(
+                    prep["vocab"], np.asarray(d_in)[:k] / num_workers)
+                ids_sec = prep["hs_rows"] if cfg.hs else prep["vocab"]
+                sec_t.add_rows_async(
+                    ids_sec,
+                    np.asarray(d_sec)[:ids_sec.size] / num_workers)
+            return float(loss)
+
+    # ------------------------------------------------------------------ #
+    # PS block path: shared packed-scan compute, two pull/push planes
+    # ------------------------------------------------------------------ #
+    def _sec_table(self):
+        return self.table_hs if self.cfg.hs else self.table_out
+
+    @staticmethod
+    def _idt(limit: int):
+        """Smallest index dtype covering [0, limit] — the packed batches
+        cross the host->device wire; int16 halves the bytes."""
+        return np.int16 if limit < (1 << 15) else np.int32
+
+    def _pack_batches(self, prep: Dict, n: int, nbb: int,
+                      remap: np.ndarray, dummy_in: int,
+                      remap_hs: Optional[np.ndarray], dummy_hs: int,
+                      dev_negs: bool = False
+                      ) -> Tuple[Tuple[np.ndarray, ...], np.ndarray]:
+        """Remap + pack the block's training arrays into the (nbb, B, ...)
+        scan layout shared by BOTH planes. Index spaces: ids are remapped
+        into the pulled-row array; pad slots and padded minibatches point
+        at the dummy extra row appended after the pulled rows, so their
+        (masked) garbage never touches real rows."""
+        cfg = self.cfg
+        b = cfg.batch_size
+        nb = n // b
+
+        def pack(x, fill, dtype):
+            out = np.full((nbb, b) + x.shape[1:], fill, dtype)
+            out[:nb] = x[:n].reshape((nb, b) + x.shape[1:])
+            return out
+
+        din = self._idt(dummy_in)
+        if cfg.hs:
+            dhs = self._idt(dummy_hs)
+            points = remap_hs[prep["points"][:n]]
+            points[~prep["pmask"][:n]] = dummy_hs  # mask off-path garbage
+            sec_batch = (pack(prep["codes"][:n], 0, np.int8),
+                         pack(points, dummy_hs, dhs),
+                         pack(prep["pmask"][:n], False, bool))
+        elif dev_negs:
+            sec_batch = ()  # negatives re-derived in-graph from the seed
+        else:
+            sec_batch = (pack(remap[prep["negs"][:n]], dummy_in, din),)
+        if cfg.cbow:
+            head = (pack(remap[prep["windows"][:n]], dummy_in, din),
+                    pack(prep["masks"][:n], False, bool),
+                    pack(remap[prep["targets"][:n]], dummy_in, din))
+            if cfg.hs:          # cbow_hs_step(w, m, codes, points, pmask)
+                batch = head[:2] + sec_batch
+            else:               # cbow_ns_step(w, m, targets, negs)
+                batch = head + sec_batch
+        else:
+            centers = pack(remap[prep["centers"][:n]], dummy_in, din)
+            if cfg.hs:          # skipgram_hs_step(c, codes, points, pmask)
+                batch = (centers,) + sec_batch
+            else:               # skipgram_ns_step(c, contexts, negs)
+                batch = (centers,
+                         pack(remap[prep["contexts"][:n]], dummy_in, din),
+                         ) + sec_batch
+        valid = np.zeros(nbb, np.float32)
+        valid[:nb] = 1.0
+        return batch, valid
+
+    def _step_fn_raw(self):
+        """Unjitted per-minibatch step for the active (cbow, hs) mode —
+        all four reference variants (ref wordembedding.cpp FeedForward/
+        HS/NS branches); scanned by both PS planes."""
+        cfg = self.cfg
+        alpha = cfg.alpha
+        if cfg.cbow and cfg.hs:
+            return lambda a, s, w, m, c, p, pm: w2v.cbow_hs_step(
+                a, s, w, m, c, p, pm, alpha)
+        if cfg.cbow:
+            return lambda a, s, w, m, t, g: w2v.cbow_ns_step(
+                a, s, w, m, t, g, alpha)
+        if cfg.hs:
+            return lambda a, s, c, cd, p, pm: w2v.skipgram_hs_step(
+                a, s, c, cd, p, pm, alpha)
+        return lambda a, s, c, x, g: w2v.skipgram_ns_step(
+            a, s, c, x, g, alpha)
+
+    def _local_train_fn(self):
+        """Jitted local-train scan for the host plane: pulled rows in,
+        (new - old) deltas + mean loss out — the packed equivalent of the
+        reference's per-block OMP train loop
+        (ref distributed_wordembedding.cpp:178-227), minus the per-
+        minibatch dispatch round-trips."""
+        fn = self._fused_cache.get("ps_local")
+        if fn is not None:
+            return fn
+        step = self._step_fn_raw()
+        cdtype = (jnp.bfloat16 if self.cfg.ps_block_dtype == "bf16"
+                  else None)
+
+        def local(rows_in, rows_sec, valid, batch):
+            def dummy(r):   # padded slots train against this extra row
+                r = r.astype(cdtype) if cdtype is not None else r
+                return jnp.concatenate(
+                    [r, jnp.zeros((1, r.shape[1]), r.dtype)])
+
+            def body(carry, xs):
+                ri, rs = carry
+                w, arrs = xs[0], xs[1:]
+                arrs = tuple(a.astype(jnp.int32)
+                             if a.dtype == jnp.int16 else a for a in arrs)
+                ri, rs, loss = step(ri, rs, *arrs)
+                return (ri, rs), loss * w
+
+            (ri, rs), losses = jax.lax.scan(
+                body, (dummy(rows_in), dummy(rows_sec)), (valid,) + batch)
+            loss = losses.sum().astype(jnp.float32) / jnp.maximum(
+                valid.sum(), 1.0)
+
+            def base(old):   # same baseline the scan started from
+                if cdtype is None:
+                    return old
+                return old.astype(cdtype).astype(old.dtype)
+
+            d_in = ri[:-1].astype(rows_in.dtype) - base(rows_in)
+            d_sec = rs[:-1].astype(rows_sec.dtype) - base(rows_sec)
+            return d_in, d_sec, loss
+
+        fn = self._fused_cache["ps_local"] = jax.jit(local)
+        return fn
+
+    def _prepare_block_device(self, block: np.ndarray, rng) -> Optional[Dict]:
+        """Device-plane block prep: bucketed table-id lists + packed
+        batches, shipped in ONE pytree device_put per block (overlapped
+        with the previous block's compute by JAX async dispatch)."""
+        cfg = self.cfg
+        b = cfg.batch_size
+        with monitor("we.prepare"):
+            prep = self._block_arrays(block, rng)
+            n = (prep["examples"].size // b) * b
+            if n == 0:
+                return None
             # multiple-of-8 bucket: pair counts per fixed-size block jitter
             # by << 8 minibatches, so this stays on one compiled program
             # while wasting far less upload padding than pow2 would
-            nbb = -(-nb // 8) * 8
+            nbb = -(-(n // b) // 8) * 8
             vocab = prep["vocab"]
             k = vocab.size
             vbb = _bucket_size(k, self.table_in.padded_shape[0])
@@ -637,16 +717,7 @@ class WordEmbedding:
             ids_in[:k] = vocab
             remap = np.full(len(self.dict), vbb, np.int64)  # default: dummy
             remap[vocab] = np.arange(k)
-
-            def idt(limit):
-                return np.int16 if limit < (1 << 15) else np.int32
-
-            def pack(x, fill, dtype):
-                out = np.full((nbb, b) + x.shape[1:], fill, dtype)
-                out[:nb] = x[:n].reshape((nb, b) + x.shape[1:])
-                return out
-
-            din = idt(vbb)
+            remap_hs, hsb = None, 0
             if cfg.hs:
                 hs_rows = prep["hs_rows"]
                 hk = hs_rows.size
@@ -656,43 +727,18 @@ class WordEmbedding:
                 ids_sec[:hk] = hs_rows
                 remap_hs = np.full(self.table_hs.shape[0] + 1, hsb, np.int64)
                 remap_hs[hs_rows] = np.arange(hk)
-                dhs = idt(hsb)
-                points = remap_hs[prep["points"][:n]]
-                points[~prep["pmask"][:n]] = hsb  # mask off-path garbage
-                sec_batch = (pack(prep["codes"][:n], 0, np.int8),
-                             pack(points, hsb, dhs),
-                             pack(prep["pmask"][:n], False, bool))
-            elif self._dev_negs:
-                ids_sec = ids_in
-                sec_batch = ()  # negatives re-derived in-graph from the seed
             else:
                 ids_sec = ids_in
-                sec_batch = (pack(remap[prep["negs"][:n]], vbb, din),)
-            if cfg.cbow:
-                head = (pack(remap[prep["windows"][:n]], vbb, din),
-                        pack(prep["masks"][:n], False, bool),
-                        pack(remap[prep["targets"][:n]], vbb, din))
-                if cfg.hs:      # cbow_hs_step(w, m, codes, points, pmask)
-                    batch = head[:2] + sec_batch
-                else:           # cbow_ns_step(w, m, targets, negs)
-                    batch = head + sec_batch
-            else:
-                centers = pack(remap[prep["centers"][:n]], vbb, din)
-                if cfg.hs:      # skipgram_hs_step(c, codes, points, pmask)
-                    batch = (centers,) + sec_batch
-                else:           # skipgram_ns_step(c, contexts, negs)
-                    batch = (centers,
-                             pack(remap[prep["contexts"][:n]], vbb, din),
-                             ) + sec_batch
-            valid = np.zeros(nbb, np.float32)
-            valid[:nb] = 1.0
+            batch, valid = self._pack_batches(prep, n, nbb, remap, vbb,
+                                              remap_hs, hsb,
+                                              dev_negs=self._dev_negs)
             payload = {"ids_in": ids_in, "ids_sec": ids_sec, "valid": valid,
                        "batch": batch, "remap": None, "neg_seed": None}
             if self._dev_negs:
                 # in-graph negatives need the step index, the global->local
                 # remap (V small ids), and the block's 4-byte draw seed
                 payload["batch"] = (np.arange(nbb, dtype=np.uint32),) + batch
-                payload["remap"] = remap.astype(din)
+                payload["remap"] = remap.astype(self._idt(vbb))
                 payload["neg_seed"] = np.uint32(prep["neg_seed"])
             return jax.device_put(
                 payload,
@@ -712,25 +758,14 @@ class WordEmbedding:
             return fn
         cfg = self.cfg
         t_in, t_sec = self.table_in, self._sec_table()
-        alpha = cfg.alpha
-        if cfg.cbow and cfg.hs:
-            step = lambda a, s, w, m, c, p, pm: w2v.cbow_hs_step(
-                a, s, w, m, c, p, pm, alpha)
-        elif cfg.cbow:
-            step = lambda a, s, w, m, t, g: w2v.cbow_ns_step(
-                a, s, w, m, t, g, alpha)
-        elif cfg.hs:
-            step = lambda a, s, c, cd, p, pm: w2v.skipgram_hs_step(
-                a, s, c, cd, p, pm, alpha)
-        else:
-            step = lambda a, s, c, x, g: w2v.skipgram_ns_step(
-                a, s, c, x, g, alpha)
-
+        step = self._step_fn_raw()
         dev_negs = self._dev_negs
         bsz, k = cfg.batch_size, cfg.negative
         if dev_negs and self._neg_host is None:
             self._host_negs(1, 1, np.random.default_rng(0))  # build table
         tbl_mask = (self._neg_host.size - 1) if dev_negs else 0
+
+        cdtype = jnp.bfloat16 if cfg.ps_block_dtype == "bf16" else None
 
         def fused(din, uin, dsec, usec, ids_in, ids_sec, valid, batch,
                   remap, neg_seed, neg_table):
@@ -739,6 +774,7 @@ class WordEmbedding:
             dummy_id = ids_in.shape[0]
 
             def dummy(r):   # padded slots train against this extra row
+                r = r.astype(cdtype) if cdtype is not None else r
                 return jnp.concatenate(
                     [r, jnp.zeros((1, r.shape[1]), r.dtype)])
 
@@ -765,11 +801,22 @@ class WordEmbedding:
 
             (ri, rs), losses = jax.lax.scan(
                 body, (dummy(old_in), dummy(old_sec)), (valid,) + batch)
-            loss = losses.sum() / jnp.maximum(valid.sum(), 1.0)
+            loss = losses.sum().astype(jnp.float32) / jnp.maximum(
+                valid.sum(), 1.0)
+            # deltas against the SAME baseline the scan started from (the
+            # bf16-rounded rows in bf16 mode) — an untrained row must get
+            # an exactly-zero delta, never the f32-vs-bf16 rounding gap
+            def base(old):
+                if cdtype is None:
+                    return old
+                return old.astype(cdtype).astype(old.dtype)
+
+            d_in = ri[:-1].astype(old_in.dtype) - base(old_in)
+            d_sec = rs[:-1].astype(old_sec.dtype) - base(old_sec)
             s_in = t_in.functional_add_rows(
-                {"data": din, "ustate": uin}, ids_in, ri[:-1] - old_in)
+                {"data": din, "ustate": uin}, ids_in, d_in)
             s_sec = t_sec.functional_add_rows(
-                {"data": dsec, "ustate": usec}, ids_sec, rs[:-1] - old_sec)
+                {"data": dsec, "ustate": usec}, ids_sec, d_sec)
             return (s_in["data"], s_in["ustate"],
                     s_sec["data"], s_sec["ustate"], loss)
 

@@ -333,6 +333,24 @@ class TestModesAndRegressions:
         np.testing.assert_allclose(emb["0"][0], emb["1"][0], atol=1e-3)
         np.testing.assert_allclose(emb["0"][1], emb["1"][1], atol=1e-3)
 
+    def test_ps_block_dtype_bf16_trains_close_to_f32(self):
+        # bf16 scan mode: same draws, loss lands near the f32 run (deltas
+        # are measured against the bf16-rounded baseline, so untrained
+        # rows get exactly-zero deltas — regression for the phantom-delta
+        # bug) and bad values are a typed config error
+        tokens = self._tokens()
+        losses = {}
+        for dt in ("f32", "bf16"):
+            cfg = WEConfig(size=16, min_count=5, batch_size=128, negative=3,
+                           data_block_size=4000, seed=9, ps_block_dtype=dt)
+            d = Dictionary.build(tokens, cfg.min_count)
+            we = WordEmbedding(cfg, d)
+            st = we.train_ps_blocks(we.prepare_ids(tokens), epochs=1)
+            losses[dt] = st["loss"]
+        assert abs(losses["bf16"] - losses["f32"]) < 0.15, losses
+        with pytest.raises(ValueError, match="ps_block_dtype"):
+            WEConfig(ps_block_dtype="bf61")
+
     def test_words_per_sec_counts_tokens(self):
         tokens = self._tokens()
         cfg = WEConfig(size=16, min_count=5, batch_size=256, negative=3)
