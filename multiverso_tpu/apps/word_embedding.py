@@ -526,12 +526,14 @@ class WordEmbedding:
                 prep["pull_hs"] = self.table_hs.get_rows_async(hs_rows)
             remap = np.full(len(self.dict), kb, np.int64)   # default: dummy
             remap[vocab] = np.arange(k)
-            batch, valid = self._pack_batches(prep, n, nbb, remap, kb,
-                                              remap_hs, hkb)
-            prep.update(batch=batch, valid=valid, kb=kb, hkb=hkb,
-                        pull_in=self.table_in.get_rows_async(vocab))
+            # dispatch the pulls BEFORE the ~35 ms packing work so the
+            # wire/gather latency hides under it
+            prep["pull_in"] = self.table_in.get_rows_async(vocab)
             if not cfg.hs:
                 prep["pull_out"] = self.table_out.get_rows_async(vocab)
+            batch, valid = self._pack_batches(prep, n, nbb, remap, kb,
+                                              remap_hs, hkb)
+            prep.update(batch=batch, valid=valid, kb=kb, hkb=hkb)
             return prep
 
     def _train_prepared(self, prep: Optional[Dict],
@@ -613,12 +615,12 @@ class WordEmbedding:
             sec_batch = (pack(remap[prep["negs"][:n]], dummy_in, din),)
         if cfg.cbow:
             head = (pack(remap[prep["windows"][:n]], dummy_in, din),
-                    pack(prep["masks"][:n], False, bool),
-                    pack(remap[prep["targets"][:n]], dummy_in, din))
+                    pack(prep["masks"][:n], False, bool))
             if cfg.hs:          # cbow_hs_step(w, m, codes, points, pmask)
-                batch = head[:2] + sec_batch
-            else:               # cbow_ns_step(w, m, targets, negs)
                 batch = head + sec_batch
+            else:               # cbow_ns_step(w, m, targets, negs)
+                batch = head + (pack(remap[prep["targets"][:n]],
+                                     dummy_in, din),) + sec_batch
         else:
             centers = pack(remap[prep["centers"][:n]], dummy_in, din)
             if cfg.hs:          # skipgram_hs_step(c, codes, points, pmask)
@@ -649,48 +651,61 @@ class WordEmbedding:
         return lambda a, s, c, x, g: w2v.skipgram_ns_step(
             a, s, c, x, g, alpha)
 
+    def _compute_dtype(self):
+        return jnp.bfloat16 if self.cfg.ps_block_dtype == "bf16" else None
+
+    def _run_block_scan(self, step, rows_in, rows_sec, valid, batch,
+                        neg_fn=None):
+        """THE block-train scan, traced inside both planes' jits: pulled
+        rows in, (new - old) deltas + mean loss out. ``neg_fn(w, stp)``
+        appends in-graph negatives (device plane's dev-negs mode; batch[0]
+        is then the step-index array). Deltas are measured against the
+        SAME baseline the scan started from — in bf16 mode the rounded
+        rows — so a pulled-but-untrained row gets an exactly-zero delta."""
+        cdtype = self._compute_dtype()
+
+        def dummy(r):   # padded slots train against this extra row
+            r = r.astype(cdtype) if cdtype is not None else r
+            return jnp.concatenate(
+                [r, jnp.zeros((1, r.shape[1]), r.dtype)])
+
+        def body(carry, xs):
+            ri, rs = carry
+            w, arrs = xs[0], xs[1:]
+            if neg_fn is not None:
+                stp, arrs = arrs[0], arrs[1:]
+            arrs = tuple(a.astype(jnp.int32)
+                         if a.dtype == jnp.int16 else a for a in arrs)
+            if neg_fn is not None:
+                arrs = arrs + (neg_fn(w, stp),)
+            ri, rs, loss = step(ri, rs, *arrs)
+            return (ri, rs), loss * w
+
+        (ri, rs), losses = jax.lax.scan(
+            body, (dummy(rows_in), dummy(rows_sec)), (valid,) + batch)
+        loss = losses.sum().astype(jnp.float32) / jnp.maximum(
+            valid.sum(), 1.0)
+
+        def base(old):
+            if cdtype is None:
+                return old
+            return old.astype(cdtype).astype(old.dtype)
+
+        d_in = ri[:-1].astype(rows_in.dtype) - base(rows_in)
+        d_sec = rs[:-1].astype(rows_sec.dtype) - base(rows_sec)
+        return d_in, d_sec, loss
+
     def _local_train_fn(self):
-        """Jitted local-train scan for the host plane: pulled rows in,
-        (new - old) deltas + mean loss out — the packed equivalent of the
-        reference's per-block OMP train loop
+        """Jitted local-train scan for the host plane — the packed
+        equivalent of the reference's per-block OMP train loop
         (ref distributed_wordembedding.cpp:178-227), minus the per-
         minibatch dispatch round-trips."""
         fn = self._fused_cache.get("ps_local")
         if fn is not None:
             return fn
         step = self._step_fn_raw()
-        cdtype = (jnp.bfloat16 if self.cfg.ps_block_dtype == "bf16"
-                  else None)
-
-        def local(rows_in, rows_sec, valid, batch):
-            def dummy(r):   # padded slots train against this extra row
-                r = r.astype(cdtype) if cdtype is not None else r
-                return jnp.concatenate(
-                    [r, jnp.zeros((1, r.shape[1]), r.dtype)])
-
-            def body(carry, xs):
-                ri, rs = carry
-                w, arrs = xs[0], xs[1:]
-                arrs = tuple(a.astype(jnp.int32)
-                             if a.dtype == jnp.int16 else a for a in arrs)
-                ri, rs, loss = step(ri, rs, *arrs)
-                return (ri, rs), loss * w
-
-            (ri, rs), losses = jax.lax.scan(
-                body, (dummy(rows_in), dummy(rows_sec)), (valid,) + batch)
-            loss = losses.sum().astype(jnp.float32) / jnp.maximum(
-                valid.sum(), 1.0)
-
-            def base(old):   # same baseline the scan started from
-                if cdtype is None:
-                    return old
-                return old.astype(cdtype).astype(old.dtype)
-
-            d_in = ri[:-1].astype(rows_in.dtype) - base(rows_in)
-            d_sec = rs[:-1].astype(rows_sec.dtype) - base(rows_sec)
-            return d_in, d_sec, loss
-
-        fn = self._fused_cache["ps_local"] = jax.jit(local)
+        fn = self._fused_cache["ps_local"] = jax.jit(
+            lambda ri, rs, v, b: self._run_block_scan(step, ri, rs, v, b))
         return fn
 
     def _prepare_block_device(self, block: np.ndarray, rng) -> Optional[Dict]:
@@ -765,54 +780,28 @@ class WordEmbedding:
             self._host_negs(1, 1, np.random.default_rng(0))  # build table
         tbl_mask = (self._neg_host.size - 1) if dev_negs else 0
 
-        cdtype = jnp.bfloat16 if cfg.ps_block_dtype == "bf16" else None
-
         def fused(din, uin, dsec, usec, ids_in, ids_sec, valid, batch,
                   remap, neg_seed, neg_table):
             old_in = jnp.take(din, ids_in, axis=0)
             old_sec = jnp.take(dsec, ids_sec, axis=0)
-            dummy_id = ids_in.shape[0]
+            neg_fn = None
+            if dev_negs:
+                dummy_id = ids_in.shape[0]
 
-            def dummy(r):   # padded slots train against this extra row
-                r = r.astype(cdtype) if cdtype is not None else r
-                return jnp.concatenate(
-                    [r, jnp.zeros((1, r.shape[1]), r.dtype)])
-
-            def body(carry, xs):
-                ri, rs = carry
-                w, arrs = xs[0], xs[1:]
-                if dev_negs:
-                    stp, arrs = arrs[0], arrs[1:]
-                arrs = tuple(a.astype(jnp.int32)
-                             if a.dtype == jnp.int16 else a for a in arrs)
-                if dev_negs:
-                    # same splitmix32 counter stream the host used to build
-                    # the pull set — only the 4-byte seed crossed the wire
+                def neg_fn(w, stp):
+                    # same splitmix32 counter stream the host used to
+                    # build the pull set — only the 4-byte seed crossed
+                    # the wire
                     base = neg_seed + stp * jnp.uint32(bsz * k)
                     slots = w2v.counter_negs(base, bsz * k, tbl_mask)
                     ng = jnp.take(neg_table, slots).reshape(bsz, k)
                     nl = jnp.take(remap, ng).astype(jnp.int32)
                     # padded steps: their counters weren't in the host's
                     # vocab pass, so point them at the dummy row
-                    nl = jnp.where(w > 0, nl, jnp.int32(dummy_id))
-                    arrs = arrs + (nl,)
-                ri, rs, loss = step(ri, rs, *arrs)
-                return (ri, rs), loss * w
+                    return jnp.where(w > 0, nl, jnp.int32(dummy_id))
 
-            (ri, rs), losses = jax.lax.scan(
-                body, (dummy(old_in), dummy(old_sec)), (valid,) + batch)
-            loss = losses.sum().astype(jnp.float32) / jnp.maximum(
-                valid.sum(), 1.0)
-            # deltas against the SAME baseline the scan started from (the
-            # bf16-rounded rows in bf16 mode) — an untrained row must get
-            # an exactly-zero delta, never the f32-vs-bf16 rounding gap
-            def base(old):
-                if cdtype is None:
-                    return old
-                return old.astype(cdtype).astype(old.dtype)
-
-            d_in = ri[:-1].astype(old_in.dtype) - base(old_in)
-            d_sec = rs[:-1].astype(old_sec.dtype) - base(old_sec)
+            d_in, d_sec, loss = self._run_block_scan(
+                step, old_in, old_sec, valid, batch, neg_fn)
             s_in = t_in.functional_add_rows(
                 {"data": din, "ustate": uin}, ids_in, d_in)
             s_sec = t_sec.functional_add_rows(

@@ -40,8 +40,13 @@ from multiverso_tpu.updaters import AddOption
 from multiverso_tpu.utils import config
 from multiverso_tpu.utils.dashboard import monitor
 
-config.define_bool("pallas", True, "use Pallas TPU kernels for row-sparse "
-                   "table traffic where shapes allow")
+config.define_bool("pallas", False,
+                   "use the hand-written Pallas TPU kernels for row-sparse "
+                   "table traffic where shapes allow. Default OFF: measured "
+                   "on-chip (r3), XLA's native gather/scatter beats the "
+                   "kernels at every bucket size tried (375 vs 408 us row "
+                   "add at 4k rows; 1.1 vs 3.2 ms scatter at 49k) — the "
+                   "kernels remain for toolchains where that flips")
 
 
 def _bucket_size(k: int, cap: int) -> int:
