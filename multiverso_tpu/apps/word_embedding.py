@@ -9,17 +9,21 @@ src/util.cpp — argv config). Capability parity:
 * skipgram / CBOW, negative sampling / hierarchical softmax
 * min_count vocab pruning, frequent-word subsampling, dynamic window
 * block pipeline: per data block, pull the block's vocabulary rows from the
-  parameter tables, train the block, push deltas — with the pull of block
-  N+1 overlapped with training block N (ref :178-227 OMP overlap) via
-  AsyncBuffer
+  parameter tables, train the block as ONE packed ``lax.scan``, push deltas
+  — block N+1's prep/pull overlaps training block N (ref :178-227 OMP
+  overlap; here prefetch threads on the device plane, the async-dispatch
+  pull on the host plane)
 * KVTable word-count aggregation across workers (ref communicator.cpp:17-31)
 * words/sec per chip reporting
 
 Two execution paths:
 * ``train_fused``: the whole corpus trains on device via a jitted scan — the
   TPU-first path used for the headline words/sec benchmark.
-* ``train_ps_blocks``: the reference's block Get/Add flow against
-  MatrixTables — the semantics-parity path (and the multi-process one).
+* ``train_ps_blocks``: the reference's block Get/Add flow — the
+  semantics-parity path. Single-worker sync runs fuse each block's
+  pull/train/push into one device program (``ps_device_plane``);
+  multi-worker and async runs pull/push through the table wire with the
+  same packed-scan compute.
 
 Usage: ``python -m multiverso_tpu.apps.word_embedding -train_file f.txt
 -output vec.txt -size 128 ...`` (argv keys mirror ref util.cpp ParseArgs).

@@ -9,7 +9,8 @@ np=4, ref deploy/docker/Dockerfile). Here the same battery runs as::
     python -m multiverso_tpu.harness <cmd> [-key=value ...]
 
 with cmd in {kv, array, net, ip, matrix, checkpoint, restore, allreduce,
-dense_perf, sparse_perf, all}. ``-nprocs=N`` relaunches the chosen test as N
+async, ftrl_sparse, dense_perf, sparse_perf, all}. ``-nprocs=N``
+relaunches the chosen test as N
 coordinated JAX processes on this host (the ``mpirun -np N`` analogue used by
 tests/test_multiprocess.py); inside each process the battery is identical, so
 single- and multi-process behavior are asserted by the same code.
@@ -287,6 +288,32 @@ def test_async() -> None:
     mv.shutdown()
 
 
+def test_ftrl_sparse() -> None:
+    """Hash-sharded sparse keys + FTRL z/n on the uncoordinated plane (ref
+    Applications/LogisticRegression/src/util/{sparse_table,
+    ftrl_sparse_table}.h; no Test/main.cpp analogue — the reference never
+    exercised its sparse tables outside the LR app)."""
+    mv = _init()
+    rank, world = mv.rank(), mv.size()
+    from multiverso_tpu.ps.tables import AsyncSparseKVTable
+    t = AsyncSparseKVTable(4, updater="ftrl", name="harness_ftrl")
+    keys = np.array([7, 1_000_003, 1_000 + rank])  # shared + per-rank keys
+    for _ in range(10):
+        t.add_rows(keys, np.full((3, 4), 0.5, np.float32))
+    t.flush()
+    mv.barrier()   # determinism fence for the asserts, not the plane
+    w = t.get_rows([7, 1_000_003])
+    # steady +g gradients push the FTRL weight negative once |z| > lambda1
+    assert np.all(w < 0) and np.all(np.isfinite(w)), w
+    per_rank = t.get_rows([1_000 + r for r in range(world)])
+    assert np.all(per_rank < 0), per_rank
+    fresh = t.get_rows([555])
+    np.testing.assert_allclose(fresh, 0.0)   # untouched key = empty state
+    log.info("ftrl_sparse: %d workers, shared w[0]=%.4f", world,
+             float(w[0, 0]))
+    mv.shutdown()
+
+
 def test_dense_perf() -> None:
     _perf(sparse=False)
 
@@ -305,12 +332,13 @@ _TESTS = {
     "restore": lambda: test_checkpoint(True),
     "allreduce": test_allreduce,
     "async": test_async,
+    "ftrl_sparse": test_ftrl_sparse,
     "dense_perf": test_dense_perf,
     "sparse_perf": test_sparse_perf,
 }
 # the Docker CI battery order (deploy/docker/Dockerfile) + the async plane
 _ALL = ["kv", "array", "net", "ip", "matrix", "checkpoint", "restore",
-        "allreduce", "async"]
+        "allreduce", "async", "ftrl_sparse"]
 
 
 def _spawn_cluster(cmd: str, nprocs: int, extra: List[str]) -> int:
