@@ -122,7 +122,9 @@ class WEConfig:
         i = 0
         while i < len(argv):
             a = argv[i]
-            if a.startswith("-") and i + 1 < len(argv):
+            if a.startswith("-") and "=" in a:
+                i += 1   # "-key=value" runtime flag: mv.init's to parse
+            elif a.startswith("-") and i + 1 < len(argv):
                 kw[a.lstrip("-")] = argv[i + 1]
                 i += 2
             else:
@@ -920,8 +922,25 @@ def load_corpus(cfg: WEConfig):
 
 
 def main(argv=None) -> int:
+    # honor JAX_PLATFORMS/XLA_FLAGS even under a site-registered
+    # accelerator plugin (same contract as the harness): multi-process
+    # runs on one host set JAX_PLATFORMS=cpu per worker, since only one
+    # process can hold the accelerator
+    from multiverso_tpu.utils.platform import apply_platform_env
+    apply_platform_env()
     argv = argv if argv is not None else sys.argv[1:]
     cfg = WEConfig.from_argv(argv)
+    # "-key=value" entries flow into the runtime flag registry exactly like
+    # the reference's MV_Init(&argc, argv) (ref src/multiverso.cpp:10) —
+    # e.g. -ps_rank=0 -ps_world=4 -ps_rendezvous=/dir launches the
+    # uncoordinated plane straight from the app command line. Unknown
+    # "=" entries are warned about and kept (ref configure.cpp:9-54) —
+    # a typo like -size=16 must not silently train with defaults.
+    from multiverso_tpu.utils import config as config_lib
+    for a in config_lib.parse_cmd_flags(
+            [a for a in argv if a.startswith("-") and "=" in a]):
+        log.error("unknown runtime flag %s (ignored; app keys use "
+                  "'-key value' form)", a)
     mv.init()
     dictionary, ids = load_corpus(cfg)
     log.info("vocab %d words, %d training tokens (native=%s)",

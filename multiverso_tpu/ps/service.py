@@ -90,6 +90,13 @@ config.define_float("ps_reconnect_backoff", 5.0,
                     "before trying a fresh rendezvous lookup + reconnect "
                     "(lets a RESTARTED rank rejoin without every request "
                     "to a still-dead one stalling a connect timeout)")
+config.define_float("ps_shutdown_grace", 60.0,
+                    "seconds a rank keeps its shards served at shutdown "
+                    "while waiting for peers to ALSO reach shutdown (the "
+                    "reference's MV_ShutDown barrier, src/zoo.cpp:103 — "
+                    "without it a fast rank's teardown kills peers still "
+                    "pulling from its shard); observed-dead ranks are "
+                    "skipped, timeout proceeds with a warning")
 
 
 class PSError(RuntimeError):
@@ -142,6 +149,19 @@ class FileRendezvous:
         raise PSPeerError(f"rank {rank} never published an address "
                           f"({path} missing after {timeout}s)")
 
+    def mark(self, rank: int, tag: str) -> None:
+        """Publish a liveness-free marker (shutdown quiesce handshake)."""
+        open(os.path.join(self._dir, f"{tag}.{rank}"), "w").close()
+
+    def wait_mark(self, rank: int, tag: str, timeout: float) -> bool:
+        path = os.path.join(self._dir, f"{tag}.{rank}")
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if os.path.exists(path):
+                return True
+            time.sleep(0.02)
+        return False
+
 
 class JaxRendezvous:
     """Rendezvous over the jax.distributed coordinator's KV store — the
@@ -166,6 +186,17 @@ class JaxRendezvous:
         except Exception as e:
             raise PSPeerError(f"rank {rank} not in coordinator KV store: "
                               f"{e}") from e
+
+    def mark(self, rank: int, tag: str) -> None:
+        self._client.key_value_set(f"{self._ns}/{tag}/{rank}", "1")
+
+    def wait_mark(self, rank: int, tag: str, timeout: float) -> bool:
+        try:
+            self._client.blocking_key_value_get(
+                f"{self._ns}/{tag}/{rank}", int(max(timeout, 0.001) * 1000))
+            return True
+        except Exception:
+            return False
 
 
 # ---------------------------------------------------------------------- #
@@ -546,7 +577,34 @@ class PSContext:
     def __init__(self, rank: int, world: int, service: PSService):
         self.rank, self.world, self.service = rank, world, service
 
-    def close(self) -> None:
+    def quiesce(self) -> None:
+        """Shutdown handshake (the reference's MV_ShutDown barrier,
+        src/zoo.cpp:103-115): mark this rank done through the rendezvous
+        and keep serving until every live peer is done too — a fast rank's
+        teardown must not kill peers still pulling from its shard.
+        Observed-dead ranks are skipped; timing out proceeds with a
+        warning (an unobserved crash must not wedge shutdown forever)."""
+        rdv = self.service._rendezvous
+        if self.world <= 1 or rdv is None or not hasattr(rdv, "mark"):
+            return
+        # reserved tag: must not collide with user/harness markers in the
+        # same rendezvous dir (utils/filesync.file_barrier writes
+        # "<tag>.<rank>" files there too)
+        rdv.mark(self.rank, "ps_quiesce")
+        deadline = time.monotonic() + config.get_flag("ps_shutdown_grace")
+        for r in range(self.world):
+            if r == self.rank or r in self.service.dead_ranks():
+                continue
+            remaining = deadline - time.monotonic()
+            if remaining <= 0 or not rdv.wait_mark(r, "ps_quiesce",
+                                                   remaining):
+                log.error("ps shutdown: rank %d never reached shutdown "
+                            "within ps_shutdown_grace; closing anyway", r)
+                return
+
+    def close(self, quiesce: bool = False) -> None:
+        if quiesce:
+            self.quiesce()
         self.service.close()
 
 
@@ -579,5 +637,8 @@ def reset_default_context() -> None:
     global _default_ctx
     with _default_lock:
         if _default_ctx is not None:
-            _default_ctx.close()
+            # the default (app-flow) context quiesces: every rank got here
+            # via mv.shutdown, so the handshake converges quickly; test
+            # fixtures closing explicit contexts sequentially skip it
+            _default_ctx.close(quiesce=True)
             _default_ctx = None

@@ -395,7 +395,7 @@ class AsyncMatrixTable(_AsyncBase):
         try:
             header = np.load(stream)
         except (EOFError, OSError, ValueError):
-            log.warning("table[%s]: checkpoint predates updater-state "
+            log.info("table[%s]: checkpoint predates updater-state "
                         "persistence; optimizer accumulators keep their "
                         "current values", self.name)
             return

@@ -1,0 +1,55 @@
+"""App CLI entry points (ref src/main.cpp one-arg/argv shapes): the mains
+parse their own keys AND route ``-key=value`` runtime flags through
+mv.init, exactly the reference's MV_Init(&argc, argv) compaction
+(ref src/multiverso.cpp:10, src/util/configure.cpp:9-54)."""
+
+import numpy as np
+
+from multiverso_tpu.utils import config
+
+
+def _tiny_corpus(path, n=3000, vocab=50):
+    rng = np.random.default_rng(0)
+    toks = [f"w{t}" for t in rng.integers(0, vocab, n)]
+    path.write_text(" ".join(toks))
+
+
+def test_we_main_routes_runtime_flags(tmp_path):
+    from multiverso_tpu.apps import word_embedding as we_app
+    corpus = tmp_path / "corpus.txt"
+    _tiny_corpus(corpus)
+    out = tmp_path / "vec.txt"
+    rc = we_app.main(["-train_file", str(corpus), "-size", "16",
+                      "-epoch", "1", "-batch_size", "128",
+                      "-min_count", "1", "-sample", "0",
+                      "-output", str(out),
+                      "-ps_timeout=33.5"])       # runtime flag, = form
+    assert rc == 0
+    header = out.read_text().splitlines()[0].split()
+    assert int(header[1]) == 16
+    # the "=" entry reached the flag registry, not the app config
+    assert config.get_flag("ps_timeout") == 33.5
+
+
+def test_lr_main_routes_runtime_flags(tmp_path):
+    from multiverso_tpu.apps import logistic_regression as lr_app
+    from multiverso_tpu.models import logreg as model_lib
+    x, y = model_lib.synthetic_dataset(256, 8, 2, seed=3)
+    train = tmp_path / "train.svm"
+    with open(train, "w") as f:
+        for xi, yi in zip(x, y):
+            feats = " ".join(f"{j}:{v:.5f}" for j, v in enumerate(xi))
+            f.write(f"{yi} {feats}\n")
+    cfg = tmp_path / "lr.config"
+    cfg.write_text(f"input_size=8\noutput_size=2\nminibatch_size=64\n"
+                   f"learning_rate=0.5\ntrain_epoch=2\n"
+                   f"train_file={train}\ntest_file={train}\n")
+    rc = lr_app.main([str(cfg), "-ps_timeout=44.0"])
+    assert rc == 0
+    assert config.get_flag("ps_timeout") == 44.0
+
+
+def test_lr_main_usage_error_without_config():
+    from multiverso_tpu.apps import logistic_regression as lr_app
+    assert lr_app.main(["-ps_timeout=44.0"]) == 2
+    assert lr_app.main([]) == 2

@@ -58,3 +58,41 @@ def test_we_ps_blocks_np4(tmp_path):
     l1 = np.mean([r["loss"] for r in results.values()])
     l2 = np.mean([r["loss_epoch2"] for r in results.values()])
     assert l2 < 0.9 * l1, (l1, l2)
+
+
+def test_we_cli_async_np2(tmp_path):
+    """The app's own CLI entry point runs the uncoordinated plane end to
+    end: -ps_* runtime flags flow through mv.init (ref MV_Init argv), and
+    a fast-finishing rank keeps serving until peers reach shutdown
+    (ps_shutdown_grace quiesce — the reference's MV_ShutDown barrier;
+    without it the slow rank dies with PSPeerError mid-pull)."""
+    rng = np.random.default_rng(1)
+    corpus = tmp_path / "c.txt"
+    corpus.write_text(" ".join(f"w{t}" for t in rng.integers(0, 80, 30_000)))
+    rdv = tmp_path / "rdv"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"   # two processes cannot share the chip
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "multiverso_tpu.apps.word_embedding",
+             "-train_file", str(corpus), "-size", "16", "-epoch", "1",
+             "-batch_size", "128", "-min_count", "1", "-sample", "0",
+             "-use_ps", "1", "-async_ps", "1", "-data_block_size", "5000",
+             "-output", str(tmp_path / f"vec{r}.txt"),
+             f"-ps_rank={r}", "-ps_world=2", f"-ps_rendezvous={rdv}",
+             "-ps_timeout=60"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+            text=True)
+        for r in range(2)
+    ]
+    for r, p in enumerate(procs):
+        try:
+            _, stderr = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            pytest.fail(f"CLI rank {r} hung (shutdown quiesce broken?)")
+        assert p.returncode == 0, f"rank {r} rc={p.returncode}\n{stderr[-1500:]}"
+        out = tmp_path / f"vec{r}.txt"
+        assert out.exists()
+        assert int(out.read_text().split(None, 1)[0]) > 0
