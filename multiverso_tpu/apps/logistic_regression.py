@@ -381,17 +381,12 @@ def main(argv=None) -> int:
     # "-key=value" entries are runtime flags routed through mv.init exactly
     # like the reference's MV_Init argv flow (ref src/multiverso.cpp:10,
     # src/util/configure.cpp:9-54) — e.g. -ps_rank=0 -ps_world=4
-    mv_flags = [a for a in argv if a.startswith("-") and "=" in a]
-    rest = [a for a in argv if not (a.startswith("-") and "=" in a)]
+    rest = config_lib.consume_runtime_flags(argv)
     if len(rest) != 1:
         print("usage: python -m multiverso_tpu.apps.logistic_regression "
               "<config file> [-flag=value ...]", file=sys.stderr)
         return 2
     cfg = LogRegConfig.from_file(rest[0])
-    for a in config_lib.parse_cmd_flags(mv_flags):
-        # the reference warns and keeps unknown flags (configure.cpp:9-54)
-        log.error("unknown runtime flag %s (ignored; app keys use "
-                  "key=value in the config file)", a)
     mv.init()
     if cfg.mnist_dir:
         # BASELINE config 1 (ref example/run.sh): mnist_dir=<idx dir> uses

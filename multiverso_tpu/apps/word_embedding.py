@@ -929,18 +929,13 @@ def main(argv=None) -> int:
     from multiverso_tpu.utils.platform import apply_platform_env
     apply_platform_env()
     argv = argv if argv is not None else sys.argv[1:]
-    cfg = WEConfig.from_argv(argv)
     # "-key=value" entries flow into the runtime flag registry exactly like
     # the reference's MV_Init(&argc, argv) (ref src/multiverso.cpp:10) —
     # e.g. -ps_rank=0 -ps_world=4 -ps_rendezvous=/dir launches the
-    # uncoordinated plane straight from the app command line. Unknown
-    # "=" entries are warned about and kept (ref configure.cpp:9-54) —
-    # a typo like -size=16 must not silently train with defaults.
+    # uncoordinated plane straight from the app command line
     from multiverso_tpu.utils import config as config_lib
-    for a in config_lib.parse_cmd_flags(
-            [a for a in argv if a.startswith("-") and "=" in a]):
-        log.error("unknown runtime flag %s (ignored; app keys use "
-                  "'-key value' form)", a)
+    argv = config_lib.consume_runtime_flags(argv)
+    cfg = WEConfig.from_argv(argv)
     mv.init()
     dictionary, ids = load_corpus(cfg)
     log.info("vocab %d words, %d training tokens (native=%s)",

@@ -149,16 +149,28 @@ class FileRendezvous:
         raise PSPeerError(f"rank {rank} never published an address "
                           f"({path} missing after {timeout}s)")
 
-    def mark(self, rank: int, tag: str) -> None:
-        """Publish a liveness-free marker (shutdown quiesce handshake)."""
-        open(os.path.join(self._dir, f"{tag}.{rank}"), "w").close()
+    def mark(self, rank: int, tag: str, value: str = "1") -> None:
+        """Publish a marker (shutdown quiesce handshake). ``value`` stamps
+        the marker with this incarnation's identity (the published addr),
+        so a REUSED rendezvous directory's stale markers from a previous
+        run never satisfy the current run's barrier."""
+        tmp = os.path.join(self._dir, f".{tag}.{rank}.tmp")
+        with open(tmp, "w") as f:
+            f.write(value)
+        os.replace(tmp, os.path.join(self._dir, f"{tag}.{rank}"))
 
-    def wait_mark(self, rank: int, tag: str, timeout: float) -> bool:
+    def wait_mark(self, rank: int, tag: str, timeout: float,
+                  expect: Optional[str] = None) -> bool:
         path = os.path.join(self._dir, f"{tag}.{rank}")
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            if os.path.exists(path):
-                return True
+            try:
+                with open(path) as f:
+                    got = f.read()
+                if expect is None or got == expect:
+                    return True
+            except OSError:
+                pass
             time.sleep(0.02)
         return False
 
@@ -187,10 +199,14 @@ class JaxRendezvous:
             raise PSPeerError(f"rank {rank} not in coordinator KV store: "
                               f"{e}") from e
 
-    def mark(self, rank: int, tag: str) -> None:
-        self._client.key_value_set(f"{self._ns}/{tag}/{rank}", "1")
+    def mark(self, rank: int, tag: str, value: str = "1") -> None:
+        self._client.key_value_set(f"{self._ns}/{tag}/{rank}", value)
 
-    def wait_mark(self, rank: int, tag: str, timeout: float) -> bool:
+    def wait_mark(self, rank: int, tag: str, timeout: float,
+                  expect: Optional[str] = None) -> bool:
+        # the coordinator KV store dies with the job, so stale cross-run
+        # markers cannot exist here; ``expect`` is accepted for interface
+        # parity but a present key is sufficient
         try:
             self._client.blocking_key_value_get(
                 f"{self._ns}/{tag}/{rank}", int(max(timeout, 0.001) * 1000))
@@ -587,24 +603,39 @@ class PSContext:
         rdv = self.service._rendezvous
         if self.world <= 1 or rdv is None or not hasattr(rdv, "mark"):
             return
-        # reserved tag: must not collide with user/harness markers in the
-        # same rendezvous dir (utils/filesync.file_barrier writes
-        # "<tag>.<rank>" files there too)
-        rdv.mark(self.rank, "ps_quiesce")
+        # reserved tag (must not collide with user/harness markers in the
+        # same rendezvous dir — utils/filesync.file_barrier writes
+        # "<tag>.<rank>" files there too); the marker VALUE is this
+        # incarnation's published address, so a reused rendezvous dir's
+        # stale markers never satisfy the current run's barrier
+        rdv.mark(self.rank, "ps_quiesce", self.service.addr)
         deadline = time.monotonic() + config.get_flag("ps_shutdown_grace")
         for r in range(self.world):
             if r == self.rank or r in self.service.dead_ranks():
                 continue
             remaining = deadline - time.monotonic()
-            if remaining <= 0 or not rdv.wait_mark(r, "ps_quiesce",
-                                                   remaining):
-                log.error("ps shutdown: rank %d never reached shutdown "
-                            "within ps_shutdown_grace; closing anyway", r)
-                return
+            try:
+                expect = rdv.lookup(r, min(max(remaining, 0.001), 5.0))
+            except PSError:
+                continue   # never published: the rank never came up
+            if remaining <= 0 or not rdv.wait_mark(
+                    r, "ps_quiesce", remaining, expect=expect):
+                # keep waiting on the REMAINING ranks — one laggard (or a
+                # transient KV error reading its marker) must not collapse
+                # the barrier for everyone after it
+                log.error("ps shutdown: rank %d did not reach shutdown "
+                          "within ps_shutdown_grace; not waiting for it", r)
 
     def close(self, quiesce: bool = False) -> None:
         if quiesce:
-            self.quiesce()
+            try:
+                self.quiesce()
+            except Exception as e:
+                # the handshake is best-effort: a vanished rendezvous dir
+                # or dead coordinator must not abort shutdown and leak the
+                # service's sockets/threads
+                log.error("ps shutdown quiesce failed (%s: %s); closing "
+                          "anyway", type(e).__name__, e)
         self.service.close()
 
 

@@ -144,6 +144,22 @@ def parse_cmd_flags(argv: Optional[List[str]] = None) -> List[str]:
     return remainder
 
 
+def consume_runtime_flags(argv: Optional[List[str]]) -> List[str]:
+    """App-CLI preamble: ``-key=value`` entries are runtime flags — parsed
+    into the registry, unknown ones warned about (the reference 'warns and
+    keeps', src/util/configure.cpp:9-54) — and everything else (the app's
+    own ``-key value`` pairs / positionals) is returned. One definition of
+    the MV_Init argv contract for every app entry point."""
+    argv = list(argv or [])
+    flags = [a for a in argv if a.startswith("-") and "=" in a]
+    rest = [a for a in argv if not (a.startswith("-") and "=" in a)]
+    for a in parse_cmd_flags(flags):
+        from multiverso_tpu.utils import log   # lazy: log reads flags
+        log.error("unknown runtime flag %s (ignored; app keys use "
+                  "'-key value' or config-file form)", a)
+    return rest
+
+
 def parse_config_file(path: str) -> Dict[str, str]:
     """Parse a ``key=value`` config file (LR-app style, ref configure.cpp).
 

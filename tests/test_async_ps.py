@@ -729,3 +729,57 @@ class TestPipelineSparseGets:
         again = t0.get_rows_sparse([1], worker_id=0)
         assert t0.last_transfer_rows == 0        # cache kept the newer row
         np.testing.assert_allclose(again[0], 2.0)
+
+
+class TestShutdownQuiesce:
+    """The MV_ShutDown-barrier analogue (ref src/zoo.cpp:103-115): a rank
+    keeps serving until live peers also reach shutdown."""
+
+    def test_both_ranks_converge_quickly(self, two_ranks, tmp_path):
+        from multiverso_tpu.utils import config
+        config.set_flag("ps_shutdown_grace", 30.0)
+        t0 = time.monotonic()
+        th = threading.Thread(target=lambda: two_ranks[0].quiesce())
+        th.start()
+        time.sleep(0.15)            # rank 0 waits on rank 1's mark
+        two_ranks[1].quiesce()
+        th.join(timeout=10)
+        assert not th.is_alive()
+        assert time.monotonic() - t0 < 10
+
+    def test_timeout_proceeds_without_peer(self, two_ranks):
+        from multiverso_tpu.utils import config
+        config.set_flag("ps_shutdown_grace", 0.4)
+        t0 = time.monotonic()
+        two_ranks[0].quiesce()      # rank 1 never marks
+        dt = time.monotonic() - t0
+        assert 0.3 < dt < 5.0       # bounded by the grace, no hang
+
+    def test_observed_dead_peer_skipped(self, two_ranks):
+        from multiverso_tpu.utils import config
+        config.set_flag("ps_shutdown_grace", 30.0)
+        t0_ctx, t1_ctx = two_ranks
+        t = AsyncMatrixTable(8, 2, name="qd", ctx=t0_ctx)
+        AsyncMatrixTable(8, 2, name="qd", ctx=t1_ctx)
+        t.add_rows([7], np.ones((1, 2), np.float32))  # rank-1-owned: connect
+        t1_ctx.service.close()      # rank 1 "dies"
+        config.set_flag("ps_timeout", 3.0)
+        with pytest.raises(Exception):
+            t.get_rows([7])         # observe the death -> dead_ranks
+        assert 1 in t0_ctx.service.dead_ranks()
+        t0 = time.monotonic()
+        t0_ctx.quiesce()            # dead peer skipped, returns immediately
+        assert time.monotonic() - t0 < 5.0
+
+    def test_stale_markers_from_previous_run_ignored(self, tmp_path):
+        """A reused rendezvous dir's leftover quiesce markers must not
+        satisfy the current run's barrier: markers are stamped with the
+        incarnation's published address."""
+        rdv = FileRendezvous(str(tmp_path / "r"))
+        rdv.mark(1, "ps_quiesce", "127.0.0.1:1111")   # previous run
+        rdv.publish(1, "127.0.0.1:2222")              # current incarnation
+        assert not rdv.wait_mark(1, "ps_quiesce", 0.2,
+                                 expect="127.0.0.1:2222")
+        rdv.mark(1, "ps_quiesce", "127.0.0.1:2222")   # current run quiesces
+        assert rdv.wait_mark(1, "ps_quiesce", 1.0,
+                             expect="127.0.0.1:2222")
