@@ -113,6 +113,11 @@ class WEConfig:
             "1", "true", "True")
         self.max_vocab = kw.get("max_vocab")
         self.train_file = kw.get("train_file", "")
+        # pre-counted vocabulary file ("word count" lines, the
+        # tools/word_count.py output; ref -read_vocab consuming the
+        # preprocess/word_count.cpp output) and its writer twin
+        self.read_vocab = kw.get("read_vocab", "")
+        self.save_vocab = kw.get("save_vocab", "")
         self.output = kw.get("output", "")
         self.seed = int(kw.get("seed", 0))
 
@@ -896,15 +901,59 @@ def synthetic_corpus(num_tokens: int = 200_000, vocab: int = 2000,
     return [f"w{t}" for t in out]
 
 
+def read_vocab_file(path: str, min_count: int,
+                    max_vocab: Optional[int] = None) -> Dictionary:
+    """Adopt a pre-counted vocabulary ("word count" lines, any order —
+    re-sorted count-desc like the reference's loader, capped at
+    ``max_vocab`` like Dictionary.build; ref
+    distributed_wordembedding.cpp:415-446 consuming the
+    preprocess/word_count.cpp output)."""
+    items = []
+    with open(path) as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) < 2:
+                continue
+            c = int(parts[-1])
+            if c >= min_count:
+                items.append((" ".join(parts[:-1]), c))
+    if not items:
+        raise ValueError(f"vocab file {path} has no words >= min_count")
+    items.sort(key=lambda wc: (-wc[1], wc[0]))
+    if max_vocab is not None:
+        items = items[:max_vocab]
+    return Dictionary.from_counts([w for w, _ in items],
+                                  np.array([c for _, c in items], np.int64),
+                                  min_count)
+
+
 def load_corpus(cfg: WEConfig):
     """Build (Dictionary, encoded ids) for cfg.train_file, preferring the
-    native C++ loader (mv_data.cpp: tokenize+count+prune+encode in one pass)."""
+    native C++ loader (mv_data.cpp: tokenize+count+prune+encode in one
+    pass); -read_vocab adopts a pre-counted vocabulary instead of
+    re-scanning, -save_vocab writes one (ref word_count preprocess)."""
     max_vocab = int(cfg.max_vocab) if cfg.max_vocab else None
-    if cfg.train_file and native.available():
+    dictionary = None
+    if cfg.read_vocab:
+        dictionary = read_vocab_file(cfg.read_vocab, cfg.min_count,
+                                     max_vocab)
+        if cfg.train_file and native.available():
+            # keep the native one-pass tokenizer: encode under ITS vocab,
+            # then remap native ids onto the adopted vocabulary (ids not
+            # in it drop, same OOV rule as Dictionary.encode)
+            corpus = native.NativeCorpus(cfg.train_file, 1, None)
+            remap = np.array(
+                [dictionary.word2id.get(w, -1) for w in corpus.words()],
+                np.int64)
+            ids = remap[corpus.ids().astype(np.int64)]
+            _maybe_save_vocab(cfg, dictionary)
+            return dictionary, prepare_ids(dictionary, ids[ids >= 0], cfg)
+    if cfg.train_file and dictionary is None and native.available():
         corpus = native.NativeCorpus(cfg.train_file, cfg.min_count,
                                      max_vocab)
         dictionary = Dictionary.from_counts(corpus.words(), corpus.counts(),
                                             cfg.min_count)
+        _maybe_save_vocab(cfg, dictionary)
         return dictionary, prepare_ids(dictionary,
                                        corpus.ids().astype(np.int64), cfg)
     if cfg.train_file:
@@ -917,8 +966,18 @@ def load_corpus(cfg: WEConfig):
     else:
         log.info("no -train_file given; using synthetic corpus")
         tokens = synthetic_corpus()
-    dictionary = Dictionary.build(tokens, cfg.min_count, max_vocab)
+    if dictionary is None:
+        dictionary = Dictionary.build(tokens, cfg.min_count, max_vocab)
+    _maybe_save_vocab(cfg, dictionary)
     return dictionary, prepare_ids(dictionary, dictionary.encode(tokens), cfg)
+
+
+def _maybe_save_vocab(cfg: WEConfig, dictionary: Dictionary) -> None:
+    if not cfg.save_vocab:
+        return
+    with open(cfg.save_vocab, "w") as f:
+        for w, c in zip(dictionary.words, dictionary.counts.tolist()):
+            f.write(f"{w} {c}\n")
 
 
 def main(argv=None) -> int:

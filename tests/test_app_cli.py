@@ -3,9 +3,13 @@ parse their own keys AND route ``-key=value`` runtime flags through
 mv.init, exactly the reference's MV_Init(&argc, argv) compaction
 (ref src/multiverso.cpp:10, src/util/configure.cpp:9-54)."""
 
+import os
+
 import numpy as np
 
 from multiverso_tpu.utils import config
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _tiny_corpus(path, n=3000, vocab=50):
@@ -53,3 +57,59 @@ def test_lr_main_usage_error_without_config():
     from multiverso_tpu.apps import logistic_regression as lr_app
     assert lr_app.main(["-ps_timeout=44.0"]) == 2
     assert lr_app.main([]) == 2
+
+
+def test_we_vocab_preprocess_roundtrip(tmp_path):
+    """tools/word_count.py -> -read_vocab: the preprocess tool's vocab
+    file drives training without re-counting (ref preprocess/
+    word_count.cpp + -read_vocab, distributed_wordembedding.cpp:415-446),
+    and -save_vocab writes the same format back."""
+    import subprocess
+    import sys
+    from multiverso_tpu.apps import word_embedding as we_app
+
+    corpus = tmp_path / "c.txt"
+    _tiny_corpus(corpus, n=5000, vocab=40)
+    vocab = tmp_path / "vocab.txt"
+    rc = subprocess.run(
+        [sys.executable, "tools/word_count.py", "-train_file", str(corpus),
+         "-save_vocab", str(vocab), "-min_count", "2"],
+        cwd=_REPO_ROOT, capture_output=True, text=True).returncode
+    assert rc == 0
+    lines = vocab.read_text().splitlines()
+    assert len(lines) > 10
+    counts = [int(l.split()[-1]) for l in lines]
+    assert counts == sorted(counts, reverse=True)   # count-desc
+
+    out = tmp_path / "vec.txt"
+    vocab2 = tmp_path / "vocab2.txt"
+    rc = we_app.main(["-train_file", str(corpus), "-read_vocab", str(vocab),
+                      "-size", "8", "-epoch", "1", "-batch_size", "64",
+                      "-min_count", "2", "-sample", "0",
+                      "-save_vocab", str(vocab2), "-output", str(out)])
+    assert rc == 0
+    assert int(out.read_text().split(None, 1)[0]) == len(lines)
+    assert vocab2.read_text().splitlines() == lines   # format round-trip
+
+
+def test_word_count_chunk_boundaries_and_max_vocab(tmp_path):
+    """Tokens straddling read-chunk boundaries count once (carry-tail),
+    and -read_vocab honors -max_vocab like Dictionary.build."""
+    import collections
+    import sys
+    sys.path.insert(0, os.path.join(_REPO_ROOT, "tools"))
+    import word_count as wc
+
+    corpus = tmp_path / "c.txt"
+    _tiny_corpus(corpus, n=4000, vocab=30)
+    whole = collections.Counter(corpus.read_text().split())
+    for chunk in (7, 64, 1 << 22):   # tiny chunks force mid-token splits
+        assert wc.count_file(str(corpus), chunk_bytes=chunk) == whole
+
+    from multiverso_tpu.apps.word_embedding import read_vocab_file
+    vocab = tmp_path / "v.txt"
+    wc.write_vocab(whole, str(vocab), min_count=1)
+    d = read_vocab_file(str(vocab), min_count=1, max_vocab=10)
+    assert len(d.words) == 10
+    top = sorted(whole.items(), key=lambda kv: (-kv[1], kv[0]))[:10]
+    assert d.words == [w for w, _ in top]   # count-desc cap, like build()

@@ -1,0 +1,68 @@
+"""Word-frequency generator: the WordEmbedding preprocess step
+(ref Applications/WordEmbedding/preprocess/word_count.cpp — count the
+train file's tokens, write ``word count`` lines for words at or above
+min_count; the trainer then loads this via ``-read_vocab`` instead of
+re-scanning the corpus on every run).
+
+Usage:
+    python tools/word_count.py -train_file <corpus> -save_vocab <out>
+                               [-min_count N]
+"""
+
+from __future__ import annotations
+
+import collections
+import sys
+
+
+def count_file(train_file: str, chunk_bytes: int = 1 << 22
+               ) -> collections.Counter:
+    counter: collections.Counter = collections.Counter()
+    tail = b""
+    with open(train_file, "rb") as f:
+        for chunk in iter(lambda: f.read(chunk_bytes), b""):
+            chunk = tail + chunk
+            parts = chunk.split()
+            # a token (or multi-byte char) straddling the chunk boundary
+            # must not be counted as two fragments: carry the trailing
+            # partial token into the next chunk
+            if parts and not chunk[-1:].isspace():
+                tail = parts.pop()
+            else:
+                tail = b""
+            counter.update(
+                t.decode("utf-8", errors="replace") for t in parts)
+    if tail:
+        counter[tail.decode("utf-8", errors="replace")] += 1
+    return counter
+
+
+def write_vocab(counter, save_vocab: str, min_count: int) -> int:
+    """count-desc order (the word2vec vocab convention the Dictionary
+    adopts as word ids; the reference wrote map order, which its own
+    reader immediately re-sorted)."""
+    items = sorted(((w, c) for w, c in counter.items() if c >= min_count),
+                   key=lambda wc: (-wc[1], wc[0]))
+    with open(save_vocab, "w") as f:
+        for w, c in items:
+            f.write(f"{w} {c}\n")
+    return len(items)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    kw = {argv[i].lstrip("-"): argv[i + 1]
+          for i in range(0, len(argv) - 1, 2) if argv[i].startswith("-")}
+    train_file = kw.get("train_file")
+    save_vocab = kw.get("save_vocab")
+    if not train_file or not save_vocab:
+        print(__doc__, file=sys.stderr)
+        return 2
+    n = write_vocab(count_file(train_file), save_vocab,
+                    int(kw.get("min_count", "5")))
+    print(f"wrote {n} words to {save_vocab}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
