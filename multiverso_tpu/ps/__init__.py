@@ -27,7 +27,14 @@ wire is a host-side RPC service instead:
 
 No barrier, no allgather: a straggler or dead worker never blocks peers —
 requests to its shard fail with :class:`PSPeerError` after a timeout while
-traffic to live shards proceeds (the elastic story the reference lacked).
+traffic to live shards proceeds. The failure story goes further than the
+reference ever did: socket deaths tombstone the rank into
+``elastic.failed()`` immediately (``elastic.bind_ps``), a RESTARTED rank
+republishes through the rendezvous and reloads only its shard from the
+last checkpoint (``load_local``), surviving clients re-resolve after
+``ps_reconnect_backoff``, and ``mv.shutdown`` quiesces (each rank keeps
+serving until live peers are done — the MV_ShutDown barrier,
+ref src/zoo.cpp:103, rebuilt for an uncoordinated world).
 """
 
 from multiverso_tpu.ps.service import (PSContext, PSError, PSPeerError,
