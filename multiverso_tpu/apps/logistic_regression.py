@@ -191,18 +191,33 @@ class LogReg:
         # possible key for nothing
         if not cfg.sparse:
             self._sync_model()
+        # pipelined SPARSE pulls need overlapped-sparse-get support (the
+        # async plane's _SparseGetMixin); the sync sparse table's pull is
+        # a device op with no wire to hide, so it stays blocking
+        sparse_pipeline = (cfg.sparse and cfg.pipeline
+                           and hasattr(self.sparse_table,
+                                       "get_rows_sparse_async"))
         for epoch in range(cfg.train_epoch):
             reader = SampleReader(cfg.train_file, cfg.input_size,
                                   cfg.minibatch_size, fmt=cfg.reader_type)
-            for batch_idx, (x, y, keys) in enumerate(reader):
-                if cfg.sparse:
+            batches = (self._sparse_lookahead(reader) if sparse_pipeline
+                       else reader)
+            for batch_idx, item in enumerate(batches):
+                if sparse_pipeline:
+                    y_len = len(item["y"])
+                    loss = self._train_sparse_prepared(item)
+                elif cfg.sparse:
+                    x, y, keys = item
+                    y_len = len(y)
                     loss = self._train_minibatch_sparse(x, y, keys)
                 else:
+                    x, y, keys = item
+                    y_len = len(y)
                     loss = self._train_minibatch(x, y, batch_idx, pull_buffer)
                 losses.append(float(loss))
                 if ssp_clock is not None:
                     ssp_clock.tick()
-                seen += len(y)
+                seen += y_len
                 if seen % cfg.show_time_per_sample < cfg.minibatch_size:
                     log.info("epoch %d, samples %d, loss %.4f",
                              epoch, seen, losses[-1])
@@ -264,15 +279,16 @@ class LogReg:
             fn = self._sparse_grad_jit[k] = jax.jit(_g)
         return fn
 
-    def _train_minibatch_sparse(self, x: np.ndarray, y: np.ndarray,
-                                keys: Optional[np.ndarray]) -> float:
-        """Sparse push/pull minibatch: pull only the batch's active feature
-        rows (stale-row protocol), compute on the submatrix, push row deltas.
-        FTRL receives the raw gradient (its alpha owns the step size,
-        ref app updater.cpp FTRL branch); other updaters get lr*grad."""
+    def _prep_sparse(self, x: np.ndarray, y: np.ndarray,
+                     keys: Optional[np.ndarray], dispatch: bool) -> Dict:
+        """Build the padded key set + feature submatrix for one sparse
+        minibatch; with ``dispatch``, also START the stale-only pull (the
+        is_pipeline overlap — ref src/table/matrix.cpp:407-418; safe here
+        because overlapped sparse pulls are first-class on the async
+        plane, ps/tables._SparseGetMixin)."""
         cfg = self.cfg
         D = cfg.input_size
-        with monitor("logreg.sparse_minibatch"):
+        with monitor("logreg.sparse_prep"):
             if keys is None:
                 keys = np.nonzero(np.any(x != 0, axis=0))[0]
             keys = np.asarray(keys, dtype=np.int64).reshape(-1)
@@ -282,21 +298,74 @@ class LogReg:
             while kb < k:
                 kb *= 2
             pad = kb - k
-            # pad with the bias row; its padded xa columns are zero, so the
-            # padded slots contribute exactly zero gradient
             keys_p = np.concatenate([keys_b, np.full(pad, D, np.int64)])
+            wid = None if cfg.async_ps else mv.worker_id()
+            # dispatch BEFORE the xa build so the wire round-trip hides
+            # under the submatrix host work
+            pull = (self.sparse_table.get_rows_sparse_async(keys_p,
+                                                            worker_id=wid)
+                    if dispatch else None)
+            # pad with the bias row; its padded xa columns are zero, so
+            # the padded slots contribute exactly zero gradient
             xa = np.concatenate(
                 [x[:, keys], np.ones((len(y), 1), np.float32),
                  np.zeros((len(y), pad), np.float32)], axis=1)
-            wid = None if cfg.async_ps else mv.worker_id()
-            wsub = self.sparse_table.get_rows_sparse(keys_p, worker_id=wid)
-            loss, grad = self._sparse_grad_fn(kb)(
-                jnp.asarray(wsub), jnp.asarray(xa), jnp.asarray(y))
+        return {"keys_p": keys_p, "xa": xa, "y": y, "kb": kb, "wid": wid,
+                "pull": pull}
+
+    def _train_sparse_prepared(self, prep: Dict) -> float:
+        """Consume a prepared sparse minibatch: pull (or collect the
+        overlapped pull), compute on the submatrix, push row deltas.
+        FTRL receives the raw gradient (its alpha owns the step size,
+        ref app updater.cpp FTRL branch); other updaters get lr*grad."""
+        cfg = self.cfg
+        with monitor("logreg.sparse_minibatch"):
+            if prep["pull"] is not None:
+                wsub = self.sparse_table.wait(prep["pull"])
+            else:
+                wsub = self.sparse_table.get_rows_sparse(
+                    prep["keys_p"], worker_id=prep["wid"])
+            loss, grad = self._sparse_grad_fn(prep["kb"])(
+                jnp.asarray(wsub), jnp.asarray(prep["xa"]),
+                jnp.asarray(prep["y"]))
             grad = np.asarray(grad)
             if self.sparse_table.updater.name != "ftrl":
                 grad = grad * cfg.learning_rate
-            self.sparse_table.add_rows(keys_p, grad)
+            self.sparse_table.add_rows(prep["keys_p"], grad)
         return float(loss)
+
+    def _train_minibatch_sparse(self, x: np.ndarray, y: np.ndarray,
+                                keys: Optional[np.ndarray]) -> float:
+        return self._train_sparse_prepared(
+            self._prep_sparse(x, y, keys, dispatch=False))
+
+    def _sparse_lookahead(self, reader):
+        """One-batch lookahead: dispatch batch N+1's sparse pull before
+        training batch N (ref ps_model.cpp GetPipelineTable's double
+        buffer, applied to the SPARSE path). The pull can miss batch N's
+        own push — the same one-step staleness the reference's pipeline
+        accepted."""
+        prev = None
+        try:
+            for x, y, keys in reader:
+                cur = self._prep_sparse(x, y, keys, dispatch=True)
+                if prev is not None:
+                    out, prev = prev, cur
+                    yield out
+                else:
+                    prev = cur
+            if prev is not None:
+                out, prev = prev, None
+                yield out
+        finally:
+            # consumer raised/abandoned us with a pull in flight: drain it
+            # so the msg id doesn't sit in the table's pending map forever
+            # (a later flush() would otherwise block on a pull nobody owns)
+            if prev is not None and prev["pull"] is not None:
+                try:
+                    self.sparse_table.wait(prev["pull"])
+                except Exception:
+                    pass
 
     def train_arrays(self, x: np.ndarray, y: np.ndarray,
                      epochs: Optional[int] = None) -> Dict[str, float]:

@@ -72,8 +72,11 @@ def test_async_ps_path_converges(tmp_path):
         lr.train_arrays(x, y)
 
 
-@pytest.mark.parametrize("updater", ["sgd", "ftrl"])
-def test_async_sparse_lr_converges(tmp_path, updater):
+@pytest.mark.parametrize("updater,pipeline", [("sgd", "false"),
+                                              ("sgd", "true"),
+                                              ("ftrl", "false"),
+                                              ("ftrl", "true")])
+def test_async_sparse_lr_converges(tmp_path, updater, pipeline):
     """sparse=true + async_ps=true: hash-sharded keys with the updater
     (incl. FTRL z/n) living on the uncoordinated shard — the reference's
     flagship sparse-LR workload (ref model/ps_model.cpp:24-41,
@@ -87,6 +90,7 @@ def test_async_sparse_lr_converges(tmp_path, updater):
     cfg = _cfg(input_size=10, output_size=2, train_file=str(train),
                test_file=str(train), train_epoch=3, sync_frequency=1,
                async_ps="true", sparse="true", updater_type=updater,
+               pipeline=pipeline,   # "true" overlaps the sparse pulls
                learning_rate="0.5" if updater == "sgd" else "0.1")
     lr = LogReg(cfg)
     lr.train_file()
