@@ -783,3 +783,29 @@ class TestShutdownQuiesce:
         rdv.mark(1, "ps_quiesce", "127.0.0.1:2222")   # current run quiesces
         assert rdv.wait_mark(1, "ps_quiesce", 1.0,
                              expect="127.0.0.1:2222")
+
+
+class TestMultiHostBind:
+    def test_wildcard_bind_publishes_routable_addr(self, tmp_path):
+        """-ps_host 0.0.0.0 (the multi-host setting) must publish a
+        ROUTABLE address, never the wildcard itself — peers connect to
+        what the rendezvous says."""
+        from multiverso_tpu.ps.service import _routable_ip
+        rdv = FileRendezvous(str(tmp_path / "rdv"))
+        s0 = PSService(0, 2, rdv, host="0.0.0.0")
+        s1 = PSService(1, 2, rdv, host="0.0.0.0")
+        try:
+            host0 = s0.addr.rsplit(":", 1)[0]
+            assert host0 not in ("0.0.0.0", "", "::")
+            assert host0 == _routable_ip()
+            assert rdv.lookup(0, 5.0) == s0.addr
+            # a real connection works through the published address
+            c0 = PSContext(0, 2, s0)
+            c1 = PSContext(1, 2, s1)
+            t0 = AsyncMatrixTable(8, 2, name="wb", ctx=c0)
+            AsyncMatrixTable(8, 2, name="wb", ctx=c1)
+            t0.add_rows([6], np.ones((1, 2), np.float32))  # rank-1-owned
+            np.testing.assert_allclose(t0.get_rows([6])[0], 1.0)
+        finally:
+            s0.close()
+            s1.close()
