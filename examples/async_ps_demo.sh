@@ -6,6 +6,10 @@
 # Mirrors tests/we_async_worker.py, runnable by hand.
 set -e
 cd "$(dirname "$0")/.."
+# the workers live under tests/, so python's script-dir sys.path entry is
+# tests/ — the repo root must come from PYTHONPATH
+PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+export PYTHONPATH
 RDV=$(mktemp -d)
 PIDS=""
 # kill stragglers before deleting their rendezvous dir (a crashed rank

@@ -90,6 +90,17 @@ config.define_float("ps_reconnect_backoff", 5.0,
                     "before trying a fresh rendezvous lookup + reconnect "
                     "(lets a RESTARTED rank rejoin without every request "
                     "to a still-dead one stalling a connect timeout)")
+config.define_bool("ps_coalesce", True,
+                   "server-side request coalescing: Adds queued for the "
+                   "same shard while an update is in flight are merged "
+                   "(deltas summed) into ONE batched jitted update instead "
+                   "of one serialized update per message — aggregate "
+                   "throughput then rises with worker count instead of "
+                   "collapsing on the shard lock (the reference server "
+                   "applied strictly per-message, src/server.cpp:36-58). "
+                   "Merged adds apply as if their deltas arrived in a "
+                   "single message: exact for default/sgd updaters, within "
+                   "the ASGD contract for the stateful ones")
 config.define_float("ps_shutdown_grace", 60.0,
                     "seconds a rank keeps its shards served at shutdown "
                     "while waiting for peers to ALSO reach shutdown (the "
@@ -523,12 +534,16 @@ class PSService:
             return peer
 
     def request(self, rank: int, msg_type: int, meta: Dict,
-                arrays: Sequence[np.ndarray] = ()) -> cf.Future:
+                arrays: Sequence[np.ndarray] = (),
+                meta_b: Optional[bytes] = None) -> cf.Future:
         """Uncoordinated request to ``rank``; local rank short-circuits the
         socket but keeps async dispatch order via the local executor.
-        NEVER raises: a dead/unreachable rank yields a future carrying
-        PSPeerError, so fire-and-forget callers stay fire-and-forget and
-        multi-owner ops keep their live-shard futures."""
+        ``meta_b`` (wire.pack_meta) lets a fan-out op serialize its meta
+        once instead of once per remote peer; the local path always uses
+        the dict. NEVER raises: a dead/unreachable rank yields a future
+        carrying PSPeerError, so fire-and-forget callers stay
+        fire-and-forget and multi-owner ops keep their live-shard
+        futures."""
         if rank == self.rank:
             fut: cf.Future = cf.Future()
 
@@ -542,7 +557,8 @@ class PSService:
             self._local_exec.submit(_run)
             return fut
         try:
-            return self._peer(rank).request(msg_type, meta, arrays)
+            return self._peer(rank).request(
+                msg_type, meta if meta_b is None else meta_b, arrays)
         except PSError as e:
             fut = cf.Future()
             fut.set_exception(e if isinstance(e, PSPeerError)

@@ -46,17 +46,35 @@ def _dedupe_batch(row_ids, num_col: int, dtype,
                   bound: Optional[int], values=None):
     """Validate + dedupe a row/key batch, accumulating duplicate values in
     float64 (one implementation for range-sharded rows and hash keys).
-    Returns (unique_ids, summed_vals | None, inverse)."""
+    Returns (unique_ids, vals | None, inverse) where ``inverse=None``
+    means the ids were already unique and kept in caller order — the
+    overwhelmingly common case (one minibatch touches each row once),
+    which skips the sort-ordering, the float64 accumulate, and the
+    caller's ``out[inv]`` re-expansion copy (measured ~1 ms of client CPU
+    per 1024x128 add on the old always-dedupe path — the single biggest
+    per-op cost on the async plane)."""
     raw = np.asarray(row_ids)
     if raw.size == 0:
         raise ValueError("empty row_ids")
     if not np.issubdtype(raw.dtype, np.integer):
         raise TypeError(f"row_ids must be integers, got {raw.dtype}")
-    ids = raw.astype(np.int64).reshape(-1)
-    if np.any(ids < 0):
+    ids = np.asarray(raw, np.int64).reshape(-1)   # no copy if already i64
+    if ids.min() < 0:
         raise IndexError("row ids/keys must be non-negative")
-    if bound is not None and np.any(ids >= bound):
+    if bound is not None and ids.max() >= bound:
         raise IndexError(f"row id out of range [0, {bound})")
+    s = np.sort(ids)
+    if ids.size == 1 or not np.any(s[1:] == s[:-1]):
+        vals = (None if values is None
+                else np.asarray(values, dtype).reshape(ids.size, num_col))
+        # own the ids: np.asarray above is zero-copy for int64 input, but
+        # async gets re-read these AFTER the reply lands (finalize
+        # closures) — a caller refilling a reused id buffer between
+        # dispatch and wait() must not corrupt them. (vals need no copy:
+        # every consumer slices per-owner with a boolean mask, which
+        # always copies.)
+        return (ids.copy() if ids.base is not None or ids is raw
+                else ids), vals, None
     uids, inv = np.unique(ids, return_inverse=True)
     if values is None:
         return uids, None, inv
@@ -235,10 +253,12 @@ class AsyncMatrixTable(_AsyncBase):
         with monitor(f"table[{self.name}].add_rows"):
             uids, vals, _ = self._prep(row_ids, values)
             meta = {"table": self.name, "opt": opt._asdict()}
+            meta_b = wire_mod.pack_meta(meta)   # once, not per owner
             futs = [self.ctx.service.request(
                         r, svc.MSG_ADD_ROWS, meta,
                         [uids[m], wire_mod.to_wire(vals[m],
-                                                   self._wire_for(r))])
+                                                   self._wire_for(r))],
+                        meta_b=meta_b)
                     for r, m in self._by_owner(uids)]
         return self._track(futs)
 
@@ -250,17 +270,22 @@ class AsyncMatrixTable(_AsyncBase):
         with monitor(f"table[{self.name}].get_rows"):
             uids, _, inv = self._prep(row_ids)
             parts = list(self._by_owner(uids))
+            # remote peers share one packed meta (with the table's wire
+            # codec); the local short-circuit keeps its uncompressed dict
+            meta_b = wire_mod.pack_meta(
+                {"table": self.name, "wire": self._wire})
             futs = [self.ctx.service.request(
                         r, svc.MSG_GET_ROWS,
-                        {"table": self.name, "wire": self._wire_for(r)},
-                        [uids[m]])
+                        {"table": self.name, "wire": "none"},
+                        [uids[m]], meta_b=meta_b)
                     for r, m in parts]
 
             def _assemble(results):
                 out = np.empty((uids.size, self.num_col), self.dtype)
                 for (r, m), (_, arrays) in zip(parts, results):
                     out[m] = arrays[0]
-                return out[inv]   # re-expand duplicates, original order
+                # re-expand duplicates to original order (None = no dups)
+                return out if inv is None else out[inv]
 
         return self._track(futs, _assemble)
 
@@ -465,6 +490,7 @@ class _SparseGetMixin:
             parts = list(self._by_owner(uids))
             meta = {"table": self.name, "sparse": True,
                     "worker_id": int(worker_id)}
+            meta_b = wire_mod.pack_meta(meta)
             with cache_lock:
                 # seq is allocated AND the requests are sent under the
                 # cache lock, so per worker: seq order == wire send order
@@ -472,7 +498,7 @@ class _SparseGetMixin:
                 # the ordering the version filter below relies on
                 seq = self._next_seq()
                 futs = [self.ctx.service.request(r, svc.MSG_GET_ROWS, meta,
-                                                 [uids[m]])
+                                                 [uids[m]], meta_b=meta_b)
                         for r, m in parts]
 
         def _finalize(results):
@@ -512,7 +538,7 @@ class _SparseGetMixin:
                     transferred += int(missing.size)
                     out = cache.take(uids)
             self.last_transfer_rows = transferred
-            return out[inv]
+            return out if inv is None else out[inv]
 
         return self._track(futs, _finalize)
 
@@ -600,8 +626,10 @@ class AsyncSparseKVTable(_SparseGetMixin, _AsyncBase):
         with monitor(f"table[{self.name}].add_rows"):
             uids, vals, _ = self._prep(keys, values)
             meta = {"table": self.name, "opt": opt._asdict()}
+            meta_b = wire_mod.pack_meta(meta)
             futs = [self.ctx.service.request(r, svc.MSG_ADD_ROWS, meta,
-                                             [uids[m], vals[m]])
+                                             [uids[m], vals[m]],
+                                             meta_b=meta_b)
                     for r, m in self._by_owner(uids)]
         return self._track(futs)
 
@@ -613,16 +641,18 @@ class AsyncSparseKVTable(_SparseGetMixin, _AsyncBase):
         with monitor(f"table[{self.name}].get_rows"):
             uids, _, inv = self._prep(keys)
             parts = list(self._by_owner(uids))
+            meta = {"table": self.name}
+            meta_b = wire_mod.pack_meta(meta)
             futs = [self.ctx.service.request(
-                        r, svc.MSG_GET_ROWS, {"table": self.name},
-                        [uids[m]])
+                        r, svc.MSG_GET_ROWS, meta,
+                        [uids[m]], meta_b=meta_b)
                     for r, m in parts]
 
             def _assemble(results):
                 out = np.empty((uids.size, self.num_col), self.dtype)
                 for (r, m), (_, arrays) in zip(parts, results):
                     out[m] = arrays[0]
-                return out[inv]
+                return out if inv is None else out[inv]
 
         return self._track(futs, _assemble)
 

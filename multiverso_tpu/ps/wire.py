@@ -18,11 +18,18 @@ Frame layout (little-endian)::
     msg_id  i64  request/reply correlation id
     metalen u32  length of the UTF-8 JSON meta dict
     narr    u32  number of numpy blobs
+    paylen  i64  total bytes after the header (meta + all blobs)
     meta    bytes[metalen]
     narr x: dlen u8, dtype bytes[dlen], ndim u8, shape i64[ndim], raw bytes
 
-Safety: reads are bounded (MAX_META, MAX_BLOB) so a garbage or malicious
-peer can't OOM the process with one header.
+``paylen`` exists so a frame body reads in ONE ``recv_into`` — under GIL
+contention every socket read pays a GIL reacquisition (measured ~100 us
+with a saturated core), so per-field reads made small messages 3-4x more
+expensive than their bytes. Arrays decode as zero-copy views into the
+frame buffer.
+
+Safety: reads are bounded (MAX_META, MAX_BLOB, MAX_FRAME) so a garbage or
+malicious peer can't OOM the process with one header.
 """
 
 from __future__ import annotations
@@ -35,9 +42,14 @@ from typing import Dict, List, Sequence, Tuple
 import numpy as np
 
 MAGIC = b"MVPS"
-_HEADER = struct.Struct("<4sHHqII")
+_HEADER = struct.Struct("<4sHHqIIq")
+_U8 = struct.Struct("<B")
 MAX_META = 64 << 20
 MAX_BLOB = 4 << 30
+# total-frame sanity bound: must admit legitimate multi-blob frames (a
+# checkpoint dump is [keys, rows, every updater-state leaf] in ONE frame),
+# so it bounds garbage headers, not real payloads
+MAX_FRAME = MAX_META + 8 * MAX_BLOB
 
 
 class WireError(RuntimeError):
@@ -61,7 +73,10 @@ def _recv_exact(sock: socket.socket, n: int, *, sof: bool = False
     ZERO bytes consumed is an idle socket and re-raises as TimeoutError so
     callers may keep the connection; any timeout after bytes were consumed
     desyncs the framing and is fatal (WireError)."""
-    buf = bytearray(n)
+    try:
+        buf = bytearray(n)
+    except MemoryError:
+        raise WireError(f"cannot buffer {n}-byte frame") from None
     view = memoryview(buf)
     got = 0
     while got < n:
@@ -77,16 +92,23 @@ def _recv_exact(sock: socket.socket, n: int, *, sof: bool = False
     return memoryview(buf)
 
 
-def encode(msg_type: int, msg_id: int, meta: Dict,
-           arrays: Sequence[np.ndarray] = ()) -> bytes:
-    meta_b = json.dumps(meta).encode()
-    parts: List[bytes] = [
-        _HEADER.pack(MAGIC, msg_type, 0, msg_id, len(meta_b), len(arrays)),
-        meta_b,
-    ]
+def pack_meta(meta: Dict) -> bytes:
+    """Pre-serialize a meta dict. Ops that fan one logical request out to
+    many owners serialize the (identical) meta once, not once per peer."""
+    return json.dumps(meta).encode()
+
+
+def _frame_parts(msg_type: int, msg_id: int, meta,
+                 arrays: Sequence[np.ndarray]) -> List:
+    """Frame as a buffer list (header+meta+per-array header, array bodies
+    interleaved as zero-copy memoryviews where the layout allows)."""
+    meta_b = meta if isinstance(meta, (bytes, bytearray)) else \
+        json.dumps(meta).encode()
+    parts: List = [None, meta_b]   # header patched once paylen is known
+    paylen = len(meta_b)
     for a in arrays:
         # asarray, not ascontiguousarray: the latter promotes 0-d to 1-d,
-        # and tobytes() already linearizes non-contiguous layouts
+        # and the non-contiguous fallback below linearizes via tobytes()
         a = np.asarray(a)
         # custom dtypes (bfloat16 etc.) stringify as '<V2' which does NOT
         # round-trip; their registered NAME does
@@ -94,41 +116,81 @@ def encode(msg_type: int, msg_id: int, meta: Dict,
         if np.dtype(ds) != a.dtype:
             ds = a.dtype.name
         dt = ds.encode()
-        parts.append(struct.pack("<B", len(dt)))
-        parts.append(dt)
-        parts.append(struct.pack("<B", a.ndim))
-        parts.append(struct.pack(f"<{a.ndim}q", *a.shape))
-        parts.append(a.tobytes())
-    return b"".join(parts)
+        head = struct.pack(f"<B{len(dt)}sB{a.ndim}q",
+                           len(dt), dt, a.ndim, *a.shape)
+        try:   # custom dtypes (bfloat16) and 0-d views can't always export
+            body = (a.data.cast("B") if a.flags.c_contiguous
+                    else memoryview(a.tobytes()))
+        except (ValueError, TypeError):
+            body = memoryview(a.tobytes())
+        parts.append(head)
+        parts.append(body)
+        paylen += len(head) + a.nbytes
+    parts[0] = _HEADER.pack(MAGIC, msg_type, 0, msg_id, len(meta_b),
+                            len(arrays), paylen)
+    return parts
 
 
-def send(sock: socket.socket, msg_type: int, msg_id: int, meta: Dict,
+def encode(msg_type: int, msg_id: int, meta,
+           arrays: Sequence[np.ndarray] = ()) -> bytes:
+    return b"".join(bytes(p) if isinstance(p, memoryview) else p
+                    for p in _frame_parts(msg_type, msg_id, meta, arrays))
+
+
+def send(sock: socket.socket, msg_type: int, msg_id: int, meta,
          arrays: Sequence[np.ndarray] = ()) -> None:
-    sock.sendall(encode(msg_type, msg_id, meta, arrays))
+    """Send one frame with ``sendmsg`` scatter-gather: array payloads go
+    to the kernel straight from their own buffers — no join/tobytes copy
+    of the (dominant) data bytes. ``meta`` may be a dict or pre-packed
+    ``pack_meta`` bytes."""
+    views = [p if isinstance(p, memoryview) else memoryview(p)
+             for p in _frame_parts(msg_type, msg_id, meta, arrays)]
+    while views:
+        sent = sock.sendmsg(views)
+        while views and sent >= len(views[0]):   # drop fully-sent parts
+            sent -= len(views[0])
+            views.pop(0)
+        if views and sent:                        # resume mid-part
+            views[0] = views[0][sent:]
 
 
 def recv(sock: socket.socket) -> Tuple[int, int, Dict, List[np.ndarray]]:
     """Read one message; returns (msg_type, msg_id, meta, arrays).
     Raises TimeoutError (connection still usable) only when the socket was
-    idle — i.e. the timeout hit before any byte of a frame arrived."""
+    idle — i.e. the timeout hit before any byte of a frame arrived.
+    Arrays are zero-copy views into the frame buffer (each frame owns its
+    buffer, so views never alias across messages)."""
     head = _recv_exact(sock, _HEADER.size, sof=True)
-    magic, msg_type, _flags, msg_id, metalen, narr = _HEADER.unpack(head)
+    magic, msg_type, _flags, msg_id, metalen, narr, paylen = \
+        _HEADER.unpack(head)
     if magic != MAGIC:
         raise WireError(f"bad magic {bytes(magic)!r}")
     if metalen > MAX_META:
         raise WireError(f"meta too large ({metalen} bytes)")
-    meta = json.loads(bytes(_recv_exact(sock, metalen)) or b"{}")
+    if paylen < metalen or paylen > MAX_FRAME:
+        raise WireError(f"frame length out of bounds ({paylen} bytes)")
+    body = _recv_exact(sock, paylen)
+    meta = json.loads(bytes(body[:metalen]) or b"{}")
     arrays: List[np.ndarray] = []
-    for _ in range(narr):
-        (dlen,) = struct.unpack("<B", _recv_exact(sock, 1))
-        dtype = np.dtype(bytes(_recv_exact(sock, dlen)).decode())
-        (ndim,) = struct.unpack("<B", _recv_exact(sock, 1))
-        shape = struct.unpack(f"<{ndim}q",
-                              _recv_exact(sock, 8 * ndim)) if ndim else ()
-        nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize if ndim \
-            else dtype.itemsize
-        if nbytes > MAX_BLOB:
-            raise WireError(f"blob too large ({nbytes} bytes)")
-        raw = _recv_exact(sock, nbytes)
-        arrays.append(np.frombuffer(raw, dtype=dtype).reshape(shape).copy())
+    off = metalen
+    try:
+        for _ in range(narr):
+            (dlen,) = _U8.unpack_from(body, off)
+            off += 1
+            dtype = np.dtype(bytes(body[off:off + dlen]).decode())
+            off += dlen
+            (ndim,) = _U8.unpack_from(body, off)
+            off += 1
+            shape = struct.unpack_from(f"<{ndim}q", body, off) if ndim else ()
+            off += 8 * ndim
+            count = int(np.prod(shape, dtype=np.int64)) if ndim else 1
+            nbytes = count * dtype.itemsize
+            if nbytes > MAX_BLOB or off + nbytes > paylen:
+                raise WireError(f"blob out of bounds ({nbytes} bytes)")
+            arrays.append(np.frombuffer(body, dtype=dtype, count=count,
+                                        offset=off).reshape(shape))
+            off += nbytes
+    except (struct.error, ValueError, TypeError) as e:
+        # TypeError: np.dtype() on a garbage dtype string
+        raise WireError(f"malformed frame: {e}") from None
     return msg_type, msg_id, meta, arrays

@@ -206,6 +206,10 @@ def main():
                 assert time.monotonic() < deadline
                 time.sleep(0.2)
             out["tombstone_cleared"] = True
+            # survivors-only barrier: every survivor must OBSERVE the
+            # restored checkpoint value before anyone's step-5 add bumps
+            # it past world (a fast peer used to race slower pollers)
+            _sync_point(rdv_dir, world - 1, rank, "recovered")
             # 5) training continues against the recovered shard
             t.add_rows([vrow], np.ones((1, 2), np.float32))
             t.flush()

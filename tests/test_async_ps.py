@@ -44,7 +44,7 @@ class TestWire:
     def test_bad_magic_raises(self):
         import socket
         a, b = socket.socketpair()
-        a.sendall(b"XXXX" + bytes(20))
+        a.sendall(b"XXXX" + bytes(wire._HEADER.size - 4))
         with pytest.raises(wire.WireError):
             wire.recv(b)
         a.close(), b.close()
@@ -65,7 +65,11 @@ class TestWire:
                 wire.encode(0x11, 1, {"table": "g"})[:10],  # cut mid-frame
                 # huge meta length field: must be rejected, not allocated
                 wire._HEADER.pack(wire.MAGIC, 0x11, 0, 1,
-                                  wire.MAX_META + 1, 0),
+                                  wire.MAX_META + 1, 0, wire.MAX_META + 1),
+                # huge/negative frame length: rejected before allocation
+                wire._HEADER.pack(wire.MAGIC, 0x11, 0, 1, 4, 0,
+                                  wire.MAX_FRAME + 1),
+                wire._HEADER.pack(wire.MAGIC, 0x11, 0, 1, 4, 0, -8),
         ):
             s = socket.create_connection((host, int(port)), timeout=5)
             s.sendall(payload)
@@ -204,6 +208,146 @@ class TestAsyncMatrixTable:
             t0.get_rows([0.5])
         with pytest.raises(ValueError):
             t0.get_rows([])
+
+
+class TestCoalescing:
+    """Server-side request coalescing (ps_coalesce): concurrent adds to a
+    shard merge into batched jitted updates, with per-message results
+    identical to sequential application for linear updaters — the server-
+    side scaling fix the reference never had (its server applied strictly
+    per-message, src/server.cpp:36-58)."""
+
+    def _shard(self, n=32, cols=4, updater=None, num_workers=0):
+        from multiverso_tpu.ps.shard import RowShard
+        from multiverso_tpu.updaters import Updater
+        return RowShard(0, n, cols, np.float32, updater or Updater(),
+                        "coal", num_workers=num_workers)
+
+    @staticmethod
+    def _block_applier_and_queue(shard, requests):
+        """Deterministic merge setup: while holding the shard lock, start a
+        zero-delta dummy add (it becomes the applier and blocks on the
+        lock), then start ``requests``, which all queue behind it. On lock
+        release the dummy applies alone and the rest drain as one batch."""
+        import multiverso_tpu.ps.service as svc
+        cols = shard.num_col
+        zero = np.zeros((1, cols), np.float32)
+        threads = []
+        with shard._lock:
+            dummy = threading.Thread(
+                target=shard.handle,
+                args=(svc.MSG_ADD_ROWS, {"table": shard.name},
+                      [np.array([0]), zero]))
+            dummy.start()
+            threads.append(dummy)
+            deadline = time.monotonic() + 5
+            # the dummy is draining (popped its own entry) once the flag is
+            # up and the queue is empty again
+            while ((not shard._addq_draining or shard._addq)
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            for meta, arrays in requests:
+                t = threading.Thread(target=shard.handle,
+                                     args=(svc.MSG_ADD_ROWS, meta, arrays))
+                t.start()
+                threads.append(t)
+            while (len(shard._addq) < len(requests)
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            assert len(shard._addq) == len(requests)
+        for t in threads:
+            t.join(timeout=10)
+
+    def test_queued_adds_merge_into_one_update(self):
+        """Adds queued behind a blocked applier must apply as ONE merged
+        update, summing exactly."""
+        shard = self._shard()
+        ids = np.arange(8)
+        one = np.ones((8, 4), np.float32)
+        self._block_applier_and_queue(
+            shard, [({"table": "coal"}, [ids, one]) for _ in range(6)])
+        assert shard.stat_adds == 7             # dummy + 6
+        assert shard.stat_applies == 2          # dummy + one merged batch
+        got = np.asarray(shard._data)[:8]
+        np.testing.assert_allclose(got, 6 * one)    # sum is exact
+        assert shard._dirty is None
+
+    def test_distinct_opts_stay_separate_updates(self):
+        """Per-worker AdaGrad state keys on opt.worker_id — merged applies
+        must group by opt so each worker's g² accumulates its own deltas."""
+        from multiverso_tpu.updaters import AdaGradUpdater
+        shard = self._shard(updater=AdaGradUpdater(num_workers=2,
+                                                   per_worker=True))
+        ids = np.arange(4)
+        one = np.ones((4, 4), np.float32)
+        self._block_applier_and_queue(
+            shard,
+            [({"table": "coal", "opt": {"worker_id": wid,
+                                        "learning_rate": 1.0}}, [ids, one])
+             for wid in (0, 0, 1)])
+        g2 = np.asarray(shard._ustate["g_sqr"])
+        # worker 0's two adds merged (delta 2 -> g2 += 4), worker 1's one
+        # add stayed its own group (g2 += 1): buffers stayed per-worker
+        np.testing.assert_allclose(g2[0, :4], 4.0)
+        np.testing.assert_allclose(g2[1, :4], 1.0)
+
+    def test_disabled_flag_applies_per_message(self):
+        from multiverso_tpu.utils import config
+        import multiverso_tpu.ps.service as svc
+        config.set_flag("ps_coalesce", False)
+        shard = self._shard()
+        ids = np.arange(4)
+        one = np.ones((4, 4), np.float32)
+        for _ in range(3):
+            shard.handle(svc.MSG_ADD_ROWS, {"table": "coal"}, [ids, one])
+        assert shard.stat_adds == shard.stat_applies == 3
+        np.testing.assert_allclose(np.asarray(shard._data)[:4], 3 * one)
+
+    def test_concurrent_hammer_sums_exactly(self, two_ranks):
+        """End-to-end over the sockets: many client threads adding random
+        disjoint-and-overlapping batches; the grand total must be exact
+        (linear updater) — coalescing must never drop or double a delta."""
+        t0 = AsyncMatrixTable(64, 8, name="hammer", ctx=two_ranks[0])
+        t1 = AsyncMatrixTable(64, 8, name="hammer", ctx=two_ranks[1])
+        rng = np.random.default_rng(7)
+        batches = [(rng.choice(64, size=16, replace=False),
+                    rng.integers(-3, 4, size=(16, 8)).astype(np.float32))
+                   for _ in range(24)]
+        expect = np.zeros((64, 8), np.float32)
+        for ids, vals in batches:
+            np.add.at(expect, ids, vals)
+
+        def work(table, chunk):
+            for ids, vals in chunk:
+                table.add_rows(ids, vals)
+
+        threads = [threading.Thread(target=work,
+                                    args=(t, batches[i::4]))
+                   for i, t in enumerate([t0, t1, t0, t1])]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        np.testing.assert_allclose(t0.get_rows(np.arange(64)), expect,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_hash_shard_coalesces_outside_lock(self, two_ranks):
+        """AsyncSparseKVTable adds (HashShard) ride the same queue; key->
+        slot translation must not deadlock against a blocked applier."""
+        from multiverso_tpu.ps.tables import AsyncSparseKVTable
+        t0 = AsyncSparseKVTable(4, name="kvcoal", updater="default",
+                                ctx=two_ranks[0])
+        AsyncSparseKVTable(4, name="kvcoal", updater="default",
+                           ctx=two_ranks[1])
+        keys = np.array([5, 1000003, 17, 2**40 + 3])
+        one = np.ones((4, 4), np.float32)
+        threads = [threading.Thread(target=t0.add_rows, args=(keys, one))
+                   for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        np.testing.assert_allclose(t0.get_rows(keys), 8 * one)
 
 
 class TestWireBf16:
