@@ -232,7 +232,8 @@ class JaxRendezvous:
 class _Peer:
     def __init__(self, rank: int, addr: str, connect_timeout: float,
                  io_timeout: float,
-                 on_death: Optional[Callable[[Exception], None]] = None):
+                 on_death: Optional[Callable[["_Peer", Exception],
+                                             None]] = None):
         self.rank = rank
         self._on_death = on_death
         host, port = addr.rsplit(":", 1)
@@ -290,7 +291,7 @@ class _Peer:
                 if not fut.done():
                     fut.set_exception(err)
             if self._on_death is not None:
-                self._on_death(err)
+                self._on_death(self, err)
 
     def request(self, msg_type: int, meta: Dict,
                 arrays: Sequence[np.ndarray]) -> cf.Future:
@@ -312,7 +313,7 @@ class _Peer:
                     self._pending.pop(msg_id, None)
                 fut.set_exception(err)
                 if self._on_death is not None:
-                    self._on_death(err)
+                    self._on_death(self, err)
                 return fut
         # the recv loop may have died BETWEEN the entry _dead check and the
         # _pending insert (it fails only futures it saw in _pending when it
@@ -468,13 +469,23 @@ class PSService:
         with self._peers_lock:
             return sorted(self._dead_ranks)
 
-    def _note_death(self, rank: int, hooks: bool = True) -> None:
+    def _note_death(self, rank: int, hooks: bool = True,
+                    peer: Optional[_Peer] = None) -> None:
         """``hooks=False`` records the failure for reconnect backoff only:
         a rendezvous-lookup/connect timeout may just mean the rank has not
         STARTED yet — only an established socket dying is a death signal
         worth tombstoning (a supervisor keying restarts off elastic.failed
-        must not kill a rank that was never up)."""
+        must not kill a rank that was never up). ``peer`` identifies the
+        reporting incarnation: a LATE callback from a superseded peer
+        (e.g. its recv loop dying only when the reconnect path closes the
+        stale socket) must not re-tombstone a rank whose fresh connection
+        is already healthy — that would make dead_ranks()/quiesce skip a
+        live rank forever."""
         with self._peers_lock:
+            cur = self._peers.get(rank)
+            if (peer is not None and cur is not None and cur is not peer
+                    and cur._dead is None):
+                return   # stale incarnation reporting after replacement
             self._dead_ranks[rank] = time.monotonic()
         if not hooks:
             return
@@ -491,6 +502,10 @@ class PSService:
         with self._peers_lock:
             peer = self._peers.get(rank)
             if peer is not None and peer._dead is None:
+                # belt to the incarnation check in _note_death: a healthy
+                # peer proves the rank is alive, so any lingering
+                # tombstone is stale
+                self._dead_ranks.pop(rank, None)
                 return peer
             # known-dead rank (cached dead peer OR a recent failed
             # lookup/connect with nothing cached): fail fast inside the
@@ -519,7 +534,8 @@ class PSService:
                 peer = _Peer(rank, addr,
                              config.get_flag("ps_connect_timeout"),
                              config.get_flag("ps_timeout"),
-                             on_death=lambda e, r=rank: self._note_death(r))
+                             on_death=lambda p, e, r=rank:
+                                 self._note_death(r, peer=p))
             except PSError:
                 # lookup/connect failure: backoff yes, death hooks no —
                 # the rank may simply not be up yet

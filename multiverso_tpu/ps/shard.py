@@ -39,6 +39,14 @@ from multiverso_tpu.utils import config as _config
 # match only — a user subclass overriding apply() must keep the jit path.
 _LINEAR_SIGN = {Updater: 1.0, SGDUpdater: -1.0}
 
+# updaters whose apply() never reads the AddOption: their queued adds all
+# coalesce into one group regardless of per-worker opt values (the client
+# default stamps worker_id=rank, which would otherwise split the merge by
+# sender — defeating coalescing for exactly the cross-worker case it
+# exists for). EXACT type match, same reasoning as _LINEAR_SIGN.
+from multiverso_tpu.updaters import FTRLUpdater as _FTRLUpdater
+_OPT_INSENSITIVE = {Updater, SGDUpdater, _FTRLUpdater}
+
 
 class _PendingAdd:
     """One queued row-add awaiting the shard's applier (coalescing path)."""
@@ -284,6 +292,12 @@ class RowShard:
                       np.concatenate([e.vals for e in entries])
                       .astype(np.float64))
             vals = acc.astype(self.dtype)
+        self._apply_rows(local, vals, opt)
+
+    def _apply_rows(self, local: np.ndarray, vals: np.ndarray,
+                    opt: AddOption) -> None:
+        """One merged, deduped row-delta batch -> the updater (under
+        ``self._lock``)."""
         if self._np_mode:
             sign = _LINEAR_SIGN[type(self.updater)]
             if sign > 0:
@@ -348,13 +362,18 @@ class RowShard:
                     self._handoff_pool().submit(self._drain_adds)
                     normal_exit = True
                     return
-                groups: Dict[AddOption, List[_PendingAdd]] = {}
+                # opt-insensitive updaters merge across senders (one
+                # group); the rest group by the full AddOption so e.g.
+                # per-worker AdaGrad g2 stays per-worker
+                merge_all = type(self.updater) in _OPT_INSENSITIVE
+                groups: Dict[Any, List[_PendingAdd]] = {}
                 for e in batch:
-                    groups.setdefault(e.opt, []).append(e)
+                    groups.setdefault(
+                        None if merge_all else e.opt, []).append(e)
                 with self._lock:
-                    for opt, entries in groups.items():
+                    for entries in groups.values():
                         try:
-                            self._apply_add_group(entries, opt)
+                            self._apply_add_group(entries, entries[0].opt)
                         except Exception as err:
                             for e in entries:
                                 e.error = err
@@ -589,6 +608,14 @@ class HashShard(RowShard):
                 lambda l: jnp.asarray(l) if isinstance(l, np.ndarray) else l,
                 ustate)
 
+    def _apply_rows(self, keys: np.ndarray, vals: np.ndarray,
+                    opt) -> None:
+        """Queued add entries carry KEYS; translate to slots here, under
+        the same lock hold as the update itself (allocation, grow, and
+        apply stay atomic — a restore rebuilding the slot map can never
+        interleave between translation and apply)."""
+        super()._apply_rows(self._slots_for(keys), vals, opt)
+
     def _slots_for(self, keys: np.ndarray) -> np.ndarray:
         """key -> slot, allocating unseen keys (under the caller's lock)."""
         out = np.empty(keys.size, np.int64)
@@ -611,11 +638,12 @@ class HashShard(RowShard):
                 f"{self.name}: hash-sharded table has no dense whole-table "
                 "plane; use row/key ops")
         if msg_type == svc.MSG_ADD_ROWS:
-            # key->slot stays atomic with grow under the lock, but the
-            # apply itself goes through the coalescing queue OUTSIDE it (a
-            # waiter holding the RLock would deadlock the applier). Slots
-            # survive _grow (it only extends), so a queued entry's slots
-            # stay valid until applied.
+            # adds ride the coalescing queue OUTSIDE the lock (a waiter
+            # holding the RLock would deadlock the applier); entries carry
+            # KEYS and _apply_rows translates key->slot at APPLY time,
+            # atomic with the update — slots resolved at enqueue time
+            # could go stale if a checkpoint restore rebuilds the slot map
+            # in between
             keys = np.asarray(arrays[0], np.int64)
             if keys.size == 0:
                 raise IndexError(f"{self.name}: empty key batch")
@@ -623,9 +651,7 @@ class HashShard(RowShard):
                 raise IndexError(f"{self.name}: negative keys")
             opt = AddOption(**meta.get("opt", {}))
             vals = np.asarray(arrays[1], self.dtype)[: keys.size]
-            with self._lock:
-                slots = self._slots_for(keys)
-            self._add_rows(slots, vals, opt)
+            self._add_rows(keys, vals, opt)
             return {}, []
         with self._lock:   # reentrant: key->slot stays atomic w/ the update
             if msg_type == svc.MSG_GET_STATE and meta.get("dump"):

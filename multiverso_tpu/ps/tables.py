@@ -419,7 +419,12 @@ class AsyncMatrixTable(_AsyncBase):
                 self.set_rows(np.arange(a, b), data[a:b])
         try:
             header = np.load(stream)
-        except (EOFError, OSError, ValueError):
+        except EOFError:
+            # ONLY a clean end-of-stream means "legacy checkpoint without
+            # updater state" (np.load raises EOFError at a clean boundary,
+            # ValueError/OSError mid-read) — a truncated or corrupt
+            # trailer must fail the restore, not silently keep stale
+            # optimizer accumulators
             log.info("table[%s]: checkpoint predates updater-state "
                         "persistence; optimizer accumulators keep their "
                         "current values", self.name)
@@ -491,6 +496,17 @@ class _SparseGetMixin:
             meta = {"table": self.name, "sparse": True,
                     "worker_id": int(worker_id)}
             meta_b = wire_mod.pack_meta(meta)
+            # resolve peers BEFORE taking the cache lock: a down owner's
+            # rendezvous lookup + connect can take ps_connect_timeout
+            # (30 s default), and holding the lock across it would stall
+            # every other pull and wait() for this worker — including the
+            # training thread — instead of just traffic to that owner
+            for r, _ in parts:
+                if r != self.ctx.rank:
+                    try:
+                        self.ctx.service._peer(r)
+                    except svc.PSError:
+                        pass   # request() below fails fast via backoff
             with cache_lock:
                 # seq is allocated AND the requests are sent under the
                 # cache lock, so per worker: seq order == wire send order

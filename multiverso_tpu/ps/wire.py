@@ -183,6 +183,11 @@ def recv(sock: socket.socket) -> Tuple[int, int, Dict, List[np.ndarray]]:
             off += 1
             shape = struct.unpack_from(f"<{ndim}q", body, off) if ndim else ()
             off += 8 * ndim
+            if any(d < 0 for d in shape):
+                # a negative dim would make count=-1, which frombuffer
+                # reads as "the rest of the buffer" — garbage accepted
+                # silently and the cursor walked backwards
+                raise WireError(f"negative dim in blob shape {shape}")
             count = int(np.prod(shape, dtype=np.int64)) if ndim else 1
             nbytes = count * dtype.itemsize
             if nbytes > MAX_BLOB or off + nbytes > paylen:

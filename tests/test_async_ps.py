@@ -41,6 +41,21 @@ class TestWire:
             np.testing.assert_array_equal(x, y)
         a.close(), b.close()
 
+    def test_negative_dim_rejected(self):
+        """A frame claiming a negative shape dim must raise WireError —
+        np.frombuffer would read count=-1 as 'the rest of the buffer' and
+        the cursor would walk backwards."""
+        import socket
+        import struct as st
+        a, b = socket.socketpair()
+        payload = (b"{}" + st.pack("<B", 3) + b"<i8" + st.pack("<B", 1)
+                   + st.pack("<q", -1) + bytes(24))
+        a.sendall(wire._HEADER.pack(wire.MAGIC, 0x11, 0, 1, 2, 1,
+                                    len(payload)) + payload)
+        with pytest.raises(wire.WireError, match="negative dim"):
+            wire.recv(b)
+        a.close(), b.close()
+
     def test_bad_magic_raises(self):
         import socket
         a, b = socket.socketpair()
@@ -271,6 +286,21 @@ class TestCoalescing:
         got = np.asarray(shard._data)[:8]
         np.testing.assert_allclose(got, 6 * one)    # sum is exact
         assert shard._dirty is None
+
+    def test_cross_worker_adds_merge_for_stateless_updaters(self):
+        """The client default opt stamps worker_id=rank; stateless
+        updaters ignore opt, so adds from DIFFERENT workers must still
+        merge into one update — the cross-worker case coalescing exists
+        for."""
+        shard = self._shard()
+        ids = np.arange(8)
+        one = np.ones((8, 4), np.float32)
+        self._block_applier_and_queue(
+            shard,
+            [({"table": "coal", "opt": {"worker_id": w}}, [ids, one])
+             for w in range(6)])
+        assert shard.stat_applies == 2      # dummy + ONE merged batch
+        np.testing.assert_allclose(np.asarray(shard._data)[:8], 6 * one)
 
     def test_distinct_opts_stay_separate_updates(self):
         """Per-worker AdaGrad state keys on opt.worker_id — merged applies
@@ -616,7 +646,53 @@ class TestFailureSemantics:
                 c.close()
 
 
+class TestDeathBookkeeping:
+    def test_stale_incarnation_death_is_ignored(self, two_ranks):
+        """A late on_death from a superseded peer object must not
+        re-tombstone a rank whose fresh connection is healthy (the
+        reconnect race: stale.close() fires its recv-loop death AFTER the
+        new incarnation already cleared the rank)."""
+        import types
+        svc0 = two_ranks[0].service
+        assert svc0.ping(1)
+        cur = svc0._peers[1]
+        svc0._note_death(1, peer=types.SimpleNamespace())   # stale object
+        assert 1 not in svc0.dead_ranks()
+        svc0._note_death(1, peer=cur)   # the live incarnation does count
+        assert 1 in svc0.dead_ranks()
+        # ...and the healthy fast path clears the stale tombstone
+        assert svc0._peer(1) is cur
+        assert 1 not in svc0.dead_ranks()
+
+
 class TestAsyncCheckpoint:
+    def test_corrupt_updater_trailer_fails_loudly(self, two_ranks,
+                                                  tmp_path):
+        """A checkpoint whose updater-state trailer is truncated MID-READ
+        must fail the restore — only a CLEAN end-of-stream means 'legacy
+        checkpoint without updater state'. Silently accepting a torn
+        trailer would leave optimizer accumulators at whatever they were."""
+        import io
+        t0 = AsyncMatrixTable(6, 2, name="ctrl", updater="adagrad",
+                              ctx=two_ranks[0])
+        AsyncMatrixTable(6, 2, name="ctrl", updater="adagrad",
+                         ctx=two_ranks[1])
+        t0.add_rows(np.arange(6), np.ones((6, 2), np.float32))
+        buf = io.BytesIO()
+        t0.store(buf)
+        raw = buf.getvalue()
+        # cut inside the trailer HEADER (the second .npy magic): the exact
+        # window the old code misread as "legacy stream"
+        second_magic = raw.index(b"\x93NUMPY", raw.index(b"\x93NUMPY") + 1)
+        with pytest.raises(ValueError):
+            t0.load(io.BytesIO(raw[: second_magic + 4]))
+        # a clean data-only stream (true legacy) still loads fine
+        legacy = io.BytesIO()
+        np.save(legacy, t0.get(), allow_pickle=False)
+        legacy.seek(0)
+        t0.load(legacy)
+        np.testing.assert_allclose(t0.get_rows(np.arange(6)).shape, (6, 2))
+
     def test_checkpoint_walks_async_tables(self, tmp_path):
         """checkpoint.save/restore covers async tables through the same Zoo
         registry walk as the collective tables (store pulls the whole table

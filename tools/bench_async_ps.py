@@ -62,8 +62,13 @@ def main():
     dt = time.monotonic() - start
     file_barrier(rdv_dir, world, rank, "done", timeout=60)
     ctx.close()
+    shard = t._shard
     print("RESULT " + json.dumps({
         "rank": rank, "ops": ops, "rows": ops * batch, "seconds": dt,
+        # adds this shard received vs. updates actually run: >1 means
+        # server-side coalescing merged concurrent adds (ps_coalesce)
+        "coalesce_ratio": round(shard.stat_adds
+                                / max(shard.stat_applies, 1), 2),
         "rows_per_sec": ops * batch / dt,
         "mb_per_sec": ops * batch * dim * 4 / dt / 1e6,
         "get_p50_ms": float(np.percentile(get_lat, 50) * 1e3),
