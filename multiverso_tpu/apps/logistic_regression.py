@@ -85,7 +85,15 @@ class LogRegConfig:
         # path: workers push/pull at independent rates, no collectives
         self.async_ps = b("async_ps")
         self.fused = b("fused")
-        self.reader_type = g("reader_type", "libsvm")  # libsvm | dense
+        # reader_type accepts BOTH this app's format names (libsvm |
+        # dense) and the reference's reader factory names (ref
+        # reader.cpp:222-237 Get): "weight" = per-sample importance
+        # weights (format follows the sparse flag), "bsparse" = binary
+        # presence-only sparse records
+        rt = g("reader_type", "libsvm")
+        if rt == "weight":
+            rt = "weight" if self.sparse else "weight_dense"
+        self.reader_type = rt
         self.mnist_dir = g("mnist_dir", "")  # BASELINE config 1: idx files
         self.train_file = g("train_file", "")
         self.test_file = g("test_file", "")

@@ -14,6 +14,9 @@ src/util.cpp — argv config). Capability parity:
   overlap; here prefetch threads on the device plane, the async-dispatch
   pull on the host plane)
 * KVTable word-count aggregation across workers (ref communicator.cpp:17-31)
+* stopword filtering (-stopwords 1 -sw_file; ref reader.cpp:11-47) and
+  binary vector output (-binary 1; ref util.h:26 + the WriteToFile .bin
+  layout), with a round-tripping loader (``load_embeddings``)
 * words/sec per chip reporting
 
 Two execution paths:
@@ -59,14 +62,32 @@ def _gen_pairs(ids: np.ndarray, window: int, seed: int):
 
 def prepare_ids(dictionary: Dictionary, ids: np.ndarray,
                 cfg: "WEConfig") -> np.ndarray:
-    """THE subsampling policy — one implementation shared by every entry
-    point (app method, load_corpus, bench) so id streams can't diverge."""
+    """THE training-stream policy — one implementation shared by every
+    entry point (app method, load_corpus, bench) so id streams can't
+    diverge. Order matches the reference reader (reader.cpp:36-57
+    GetSentence): stopword drop first, then frequency subsampling."""
+    if getattr(cfg, "stopwords", False):
+        # O(|sw|) id lookup, not an O(V) scan: the banned set resolves
+        # against word2id once per call (the stopword list is small)
+        banned = np.array(
+            [dictionary.word2id[w] for w in _load_stopwords(cfg.sw_file)
+             if w in dictionary.word2id], np.int64)
+        if banned.size:
+            ids = ids[~np.isin(ids, banned)]
     if cfg.sample <= 0:
         return ids
     if native.available():
         return native.subsample(ids, dictionary.counts, cfg.sample,
                                 seed=cfg.seed).astype(np.int64)
     return dictionary.subsample(ids, cfg.sample, seed=cfg.seed)
+
+
+def _load_stopwords(path: str) -> set:
+    """Whitespace-separated stopword list (ref reader.cpp:11-23 — the
+    table the Reader loads from ``sw_file``)."""
+    with open(path, "rb") as f:
+        return {t.decode("utf-8", errors="replace")
+                for t in f.read().split()}
 
 
 class WEConfig:
@@ -119,6 +140,20 @@ class WEConfig:
         self.read_vocab = kw.get("read_vocab", "")
         self.save_vocab = kw.get("save_vocab", "")
         self.output = kw.get("output", "")
+        # -binary 1: classic word2vec .bin output (ref util.h:26
+        # output_binary, writer distributed_wordembedding.cpp:310-325)
+        self.output_binary = str(kw.get("binary", "0")) in ("1", "true",
+                                                            "True")
+        # -stopwords 1 -sw_file <path>: drop listed words from the
+        # TRAINING stream; the dictionary keeps them (ref reader.cpp:11-47
+        # — stopwords count toward word_count and stay in the vocab, they
+        # are only skipped when building sentences; option defaults
+        # util.cpp:10,24)
+        self.stopwords = str(kw.get("stopwords", "0")) in ("1", "true",
+                                                           "True")
+        self.sw_file = kw.get("sw_file", "")
+        if self.stopwords and not self.sw_file:
+            raise ValueError("-stopwords 1 needs -sw_file (ref util.cpp:75)")
         self.seed = int(kw.get("seed", 0))
 
     @classmethod
@@ -870,17 +905,67 @@ class WordEmbedding:
         ids = w2v.nearest_neighbors(self.embeddings(), wid, k)
         return [self.dict.words[i] for i in ids]
 
-    def save_embeddings(self, path: Optional[str] = None) -> None:
+    def save_embeddings(self, path: Optional[str] = None,
+                        binary: Optional[bool] = None) -> None:
         """ref SaveEmbedding (distributed_wordembedding.cpp:263-306):
-        word2vec text format."""
+        word2vec text format, or the classic .bin layout with -binary 1
+        (ref util.h:26 output_binary; writer WriteToFile
+        distributed_wordembedding.cpp:310-325 — header line, then per row
+        ``word `` + embedding_size raw float32 + newline)."""
         path = path or self.cfg.output
         if not path:
             return
+        binary = self.cfg.output_binary if binary is None else binary
         emb = self.embeddings()
+        if binary:
+            with open(path, "wb") as f:
+                f.write(f"{len(self.dict)} {self.cfg.size}\n".encode())
+                for w, row in zip(self.dict.words, emb):
+                    f.write(w.encode() + b" "
+                            + np.asarray(row, np.float32).tobytes() + b"\n")
+            return
         with open(path, "w") as f:
             f.write(f"{len(self.dict)} {self.cfg.size}\n")
             for w, row in zip(self.dict.words, emb):
                 f.write(w + " " + " ".join(f"{v:.6f}" for v in row) + "\n")
+
+
+def load_embeddings(path: str) -> Tuple[List[str], np.ndarray]:
+    """Read embeddings written by :meth:`WordEmbedding.save_embeddings`,
+    auto-detecting text vs binary (both carry the same ``"V D\\n"``
+    header; the binary body is the classic word2vec .bin row layout).
+    Returns (words, (V, D) float32 matrix) — binary round-trips
+    bit-exact."""
+    with open(path, "rb") as f:
+        head = f.readline().split()
+        v, d = int(head[0]), int(head[1])
+        rest = f.read()
+    # text rows are pure ASCII floats; binary rows embed raw float bytes.
+    # Detect by trying text first (the reference had no marker either).
+    try:
+        text = rest.decode("utf-8", errors="strict")
+        rows = text.splitlines()
+        if len(rows) != v:
+            raise ValueError
+        twords: List[str] = []
+        emb = np.empty((v, d), np.float32)
+        for i, row in enumerate(rows):
+            parts = row.split()
+            twords.append(parts[0])
+            emb[i] = np.asarray(parts[1:], np.float32)
+        return twords, emb
+    except (ValueError, UnicodeDecodeError, IndexError):
+        pass   # not text: fall through with NO partial state kept
+    words: List[str] = []
+    emb = np.empty((v, d), np.float32)
+    off = 0
+    for i in range(v):
+        sp = rest.index(b" ", off)
+        words.append(rest[off:sp].decode("utf-8", errors="replace"))
+        start = sp + 1
+        emb[i] = np.frombuffer(rest, np.float32, count=d, offset=start)
+        off = start + 4 * d + 1   # skip the trailing newline
+    return words, emb
 
 
 def synthetic_corpus(num_tokens: int = 200_000, vocab: int = 2000,

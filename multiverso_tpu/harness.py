@@ -314,6 +314,53 @@ def test_ftrl_sparse() -> None:
     mv.shutdown()
 
 
+def test_readers() -> None:
+    """Weighted + binary-sparse reader variants end-to-end (ref
+    reader.h:96-114 WeightedSampleReader, :118-146 BSparseSampleReader):
+    every rank writes its own weighted-text and binary shard of the same
+    synthetic samples, reads both back through SampleReader, asserts the
+    parsed batches agree bit-for-bit, and pushes its sample mass to a
+    shared async KV table so the asserts span ranks."""
+    import tempfile
+
+    from multiverso_tpu.io.sample_reader import (SampleReader,
+                                                 write_bsparse_sample)
+    mv = _init()
+    rank, world = mv.rank(), mv.size()
+    rng = np.random.default_rng(100 + rank)
+    dim, n = 32, 12
+    samples = [(int(rng.integers(0, 2)),
+                np.unique(rng.integers(0, dim, 5)),
+                float(rng.uniform(0.5, 2.0)))
+               for _ in range(n)]
+    with tempfile.TemporaryDirectory(prefix="mv_readers_") as d:
+        wpath, bpath = f"{d}/w_{rank}.txt", f"{d}/b_{rank}.bin"
+        with open(wpath, "w") as f:
+            for label, keys, w in samples:
+                f.write(f"{label}:{w} "
+                        + " ".join(f"{k}:1.0" for k in keys) + "\n")
+        with open(bpath, "wb") as f:
+            for label, keys, w in samples:
+                write_bsparse_sample(f, label, keys, w)
+        wbatches = list(SampleReader(wpath, dim, 4, fmt="weight"))
+        bbatches = list(SampleReader(bpath, dim, 4, fmt="bsparse"))
+    assert len(wbatches) == len(bbatches) == 3, len(wbatches)
+    mass = 0.0
+    for (wx, wy, wk), (bx, by, bk) in zip(wbatches, bbatches):
+        np.testing.assert_allclose(wx, bx)     # weight folded into values
+        np.testing.assert_array_equal(wy, by)
+        np.testing.assert_array_equal(wk, bk)  # same active-key sets
+        mass += float(wx.sum())
+    kv = mv.AsyncKVTable(name="harness_readers")
+    kv.add([rank], [round(mass, 3)])
+    mv.barrier()
+    counts = kv.get()
+    assert set(counts) == set(range(world)) and all(
+        v > 0 for v in counts.values()), counts
+    log.info("readers: %d ranks, weighted==bsparse, mass %s", world, counts)
+    mv.shutdown()
+
+
 def test_dense_perf() -> None:
     _perf(sparse=False)
 
@@ -333,12 +380,13 @@ _TESTS = {
     "allreduce": test_allreduce,
     "async": test_async,
     "ftrl_sparse": test_ftrl_sparse,
+    "readers": test_readers,
     "dense_perf": test_dense_perf,
     "sparse_perf": test_sparse_perf,
 }
 # the Docker CI battery order (deploy/docker/Dockerfile) + the async plane
 _ALL = ["kv", "array", "net", "ip", "matrix", "checkpoint", "restore",
-        "allreduce", "async", "ftrl_sparse"]
+        "allreduce", "async", "ftrl_sparse", "readers"]
 
 
 def _spawn_cluster(cmd: str, nprocs: int, extra: List[str]) -> int:

@@ -218,6 +218,47 @@ class TestWordEmbeddingApp:
         nbrs = we.nearest(word, k=3)
         assert len(nbrs) == 3 and word not in nbrs
 
+    def test_binary_output_roundtrips_bit_exact(self, tmp_path):
+        """-binary 1 (ref util.h:26, writer
+        distributed_wordembedding.cpp:310-325): classic word2vec .bin —
+        raw float32 rows reload bit-exact; text mode loads too (lossy)."""
+        from multiverso_tpu.apps.word_embedding import load_embeddings
+        we, ids = self._make()
+        we.train_fused(ids, epochs=1)
+        emb = we.embeddings()
+        bpath, tpath = tmp_path / "vec.bin", tmp_path / "vec.txt"
+        we.save_embeddings(str(bpath), binary=True)
+        we.save_embeddings(str(tpath), binary=False)
+        words_b, emb_b = load_embeddings(str(bpath))
+        assert words_b == list(we.dict.words)
+        np.testing.assert_array_equal(emb_b, np.asarray(emb, np.float32))
+        words_t, emb_t = load_embeddings(str(tpath))
+        assert words_t == words_b
+        np.testing.assert_allclose(emb_t, emb_b, atol=1e-6)
+
+    def test_stopwords_dropped_from_training_stream(self, tmp_path):
+        """-stopwords 1 -sw_file (ref reader.cpp:11-47): listed words stay
+        in the vocab but never reach the training stream."""
+        from multiverso_tpu.apps.word_embedding import (WEConfig,
+                                                        load_corpus)
+        corpus = tmp_path / "c.txt"
+        toks = (["the", "cat", "sat"] * 400) + (["dog"] * 100)
+        corpus.write_text(" ".join(toks))
+        sw = tmp_path / "sw.txt"
+        sw.write_text("the\nsat\n")
+        cfg = WEConfig(train_file=str(corpus), min_count=5, sample=0,
+                       stopwords="1", sw_file=str(sw))
+        d, ids = load_corpus(cfg)
+        assert "the" in d.word2id and "sat" in d.word2id   # vocab keeps them
+        banned = {d.word2id["the"], d.word2id["sat"]}
+        assert not banned & set(np.unique(ids).tolist())   # stream drops them
+        assert d.word2id["cat"] in set(np.unique(ids).tolist())
+
+    def test_stopwords_flag_requires_sw_file(self):
+        from multiverso_tpu.apps.word_embedding import WEConfig
+        with pytest.raises(ValueError, match="sw_file"):
+            WEConfig(stopwords="1")
+
 
 class TestModesAndRegressions:
     def _tokens(self):
