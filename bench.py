@@ -186,44 +186,53 @@ def bench_we_real(n_lo: int = 1, n_hi: int = 5):
             "provenance": realtext.provenance()}
 
 
+def _collect_worker_results(cmds, timeout: float = 240):
+    """Spawn one subprocess per argv, harvest their ``RESULT {json}``
+    lines; kill stragglers on the way out (a leaked sibling would skew
+    later benchmarks). Raises if a worker fails or nothing reported — an
+    empty measurement must not masquerade as a recorded one."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True,
+                              env=env) for cmd in cmds]
+    results = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"bench worker rc={p.returncode}: {p.args[-4:]}")
+            for line in out.splitlines():
+                if line.startswith("RESULT "):
+                    results.append(json.loads(line[len("RESULT "):]))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    if not results:
+        raise RuntimeError("bench workers produced no RESULT line")
+    return results
+
+
 def _run_async_ps_world(world: int, wire: str, seconds: float):
     """One configuration of the uncoordinated-plane bench: ``world`` real
     OS processes (CPU) pushing/pulling 1024-row batches against each
     other's shards over loopback TCP (1/world of the traffic
     short-circuits)."""
-    import json as _json
-    import os
-    import subprocess
     import sys
     import tempfile
 
     repo = os.path.dirname(os.path.abspath(__file__))
-    env = dict(os.environ)
-    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
-    results = []
     with tempfile.TemporaryDirectory(prefix="mv_bench_ps_") as rdv:
-        procs = [subprocess.Popen(
-                    [sys.executable, os.path.join(repo, "tools",
-                                                  "bench_async_ps.py"),
-                     rdv, str(world), str(r), str(seconds), wire],
-                    stdout=subprocess.PIPE, text=True, env=env)
-                 for r in range(world)]
-        try:
-            for p in procs:
-                out, _ = p.communicate(timeout=240)
-                if p.returncode != 0:
-                    raise RuntimeError(
-                        f"bench_async_ps worker rc={p.returncode}")
-                for line in out.splitlines():
-                    if line.startswith("RESULT "):
-                        results.append(_json.loads(line[len("RESULT "):]))
-        finally:
-            # never leave a sibling hammering loopback while later
-            # benchmarks run — it would skew their numbers
-            for p in procs:
-                if p.poll() is None:
-                    p.kill()
-                    p.wait()
+        results = _collect_worker_results(
+            [[sys.executable,
+              os.path.join(repo, "tools", "bench_async_ps.py"),
+              rdv, str(world), str(r), str(seconds), wire]
+             for r in range(world)])
     return {
         "rows_per_sec": round(sum(r["rows_per_sec"] for r in results)),
         "mb_per_sec": round(sum(r["mb_per_sec"] for r in results), 1),
@@ -236,6 +245,26 @@ def _run_async_ps_world(world: int, wire: str, seconds: float):
         "batch_rows": results[0]["batch_rows"],   # worker-reported truth
         "dim": results[0]["dim"],
     }
+
+
+def bench_aggregate_path(world: int = 4, mb: float = 16.0):
+    """MV_Aggregate path comparison at np=world (VERDICT r3 item 7): the
+    device-AllReduce process_sum vs the legacy allgather+numpy-sum on the
+    same payload; per-host cost of the new path is O(size), the old one
+    O(world*size)."""
+    import socket
+    import sys
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    out = _collect_worker_results(
+        [[sys.executable, os.path.join(repo, "tools", "bench_aggregate.py"),
+          str(port), str(world), str(r), str(mb)]
+         for r in range(world)], timeout=180)[0]
+    out["world"], out["mb"] = world, mb
+    return out
 
 
 def bench_async_ps(seconds: float = 4.0):
@@ -626,6 +655,10 @@ def main() -> None:
         async_ps_stats = bench_async_ps()
     except Exception as e:
         async_ps_stats = {"error": f"{type(e).__name__}: {e}"[:200]}
+    try:
+        aggregate_stats = bench_aggregate_path()
+    except Exception as e:
+        aggregate_stats = {"error": f"{type(e).__name__}: {e}"[:200]}
     array_stats = bench_array_table()
     try:
         array_cpu_stats = bench_array_table_nontunnel()
@@ -689,6 +722,7 @@ def main() -> None:
         "lr_real_digits": lr_real_stats,
         "host_wire": wire_stats,
         "async_ps_plane": async_ps_stats,
+        "aggregate_np4_16MB": aggregate_stats,
         "array_table_4M_float32": array_stats,
         "array_table_cpu_nontunnel": array_cpu_stats,
         "transformer_lm_bs8_seq512_d256_L4": lm_stats,

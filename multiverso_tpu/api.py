@@ -115,9 +115,10 @@ def aggregate(data: Union[np.ndarray, jax.Array], size: Optional[int] = None
     if zoo.size() == 1:
         out = arr
     else:
-        from jax.experimental import multihost_utils
-        gathered = multihost_utils.process_allgather(arr, tiled=False)
-        out = np.asarray(gathered).sum(axis=0).astype(arr.dtype)
+        # ONE device AllReduce (collectives.process_sum) — not allgather +
+        # numpy: per-host cost must stay O(size) on a pod, not O(world*size)
+        from multiverso_tpu.parallel.collectives import process_sum
+        out = process_sum(arr)
     if isinstance(data, np.ndarray):
         # ndarray.flat assigns through views, so non-contiguous inputs
         # (reshape(-1) would silently copy) still get the in-place write.

@@ -941,12 +941,23 @@ def load_embeddings(path: str) -> Tuple[List[str], np.ndarray]:
         v, d = int(head[0]), int(head[1])
         rest = f.read()
     # text rows are pure ASCII floats; binary rows embed raw float bytes.
-    # Detect by trying text first (the reference had no marker either).
-    try:
-        text = rest.decode("utf-8", errors="strict")
-        rows = text.splitlines()
+    # Decide ONCE from the first row (the reference had no marker either);
+    # after that, parse errors mean a malformed file and must propagate —
+    # falling back would silently reinterpret broken text as binary.
+    def _first_row_is_text() -> bool:
+        try:
+            row = rest.decode("utf-8", errors="strict").splitlines()[0]
+            vals = np.asarray(row.split()[1:], np.float32)
+            return vals.size == d
+        except (ValueError, UnicodeDecodeError, IndexError):
+            return False
+
+    if _first_row_is_text():
+        rows = rest.decode("utf-8").splitlines()
         if len(rows) != v:
-            raise ValueError
+            raise ValueError(
+                f"{path}: malformed text embeddings (header says {v} "
+                f"rows, file has {len(rows)})")
         twords: List[str] = []
         emb = np.empty((v, d), np.float32)
         for i, row in enumerate(rows):
@@ -954,8 +965,6 @@ def load_embeddings(path: str) -> Tuple[List[str], np.ndarray]:
             twords.append(parts[0])
             emb[i] = np.asarray(parts[1:], np.float32)
         return twords, emb
-    except (ValueError, UnicodeDecodeError, IndexError):
-        pass   # not text: fall through with NO partial state kept
     words: List[str] = []
     emb = np.empty((v, d), np.float32)
     off = 0

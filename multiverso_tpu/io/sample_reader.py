@@ -94,8 +94,12 @@ def _iter_bsparse(uri: str, input_dim: int
             if len(head) < _BS_HEAD.size:
                 raise ValueError(f"{uri}: truncated bsparse record header")
             n, label, weight = _BS_HEAD.unpack(head)
-            if n < 0:
-                raise ValueError(f"{uri}: negative key count {n}")
+            # 100M keys/sample (800 MB) is far beyond any real record: a
+            # bigger n means a corrupt/misaligned file, and trusting it
+            # would attempt the allocation before the short-read check
+            if n < 0 or n > 100_000_000:
+                raise ValueError(f"{uri}: implausible key count {n} "
+                                 "(corrupt or non-bsparse file?)")
             raw = s.read(8 * n)
             if len(raw) < 8 * n:
                 raise ValueError(f"{uri}: truncated bsparse key block")

@@ -291,9 +291,10 @@ class Table:
             return jax.device_put(self.pad_delta(delta), self._sharding)
         arr = np.asarray(delta, dtype=self.dtype).reshape(self.shape)
         if self._zoo.size() > 1:
-            from jax.experimental import multihost_utils
-            gathered = multihost_utils.process_allgather(arr, tiled=False)
-            arr = np.asarray(gathered).sum(axis=0).astype(self.dtype)
+            # device AllReduce, not allgather+numpy-sum: per-host transfer
+            # stays O(size) as the world grows (VERDICT r3 item 7)
+            from multiverso_tpu.parallel.collectives import process_sum
+            arr = process_sum(arr)
         padded = np.zeros(self._padded_shape, dtype=self.dtype)
         padded[: self.shape[0]] = arr
         return jax.device_put(padded, self._sharding)
@@ -361,9 +362,8 @@ class Table:
         (1bit) before crossing the wire; decode runs in-graph."""
         arr = np.asarray(delta, dtype=self.dtype).reshape(self.shape)
         if self._zoo.size() > 1:
-            from jax.experimental import multihost_utils
-            gathered = multihost_utils.process_allgather(arr, tiled=False)
-            arr = np.asarray(gathered).sum(axis=0).astype(self.dtype)
+            from multiverso_tpu.parallel.collectives import process_sum
+            arr = process_sum(arr)
         if self._wire == "bf16":
             import ml_dtypes
             padded = np.zeros(self._padded_shape, ml_dtypes.bfloat16)

@@ -1,0 +1,46 @@
+"""Worker for bench.bench_aggregate_path: np=N jax.distributed CPU
+processes timing mv.aggregate through (a) the device process_sum path and
+(b) the legacy allgather+numpy-sum, on the same payload.
+
+Invoked: python tools/bench_aggregate.py <coord_port> <world> <rank> <mb>
+Rank 0 prints "RESULT {...}".
+"""
+import json
+import sys
+import time
+
+
+def main():
+    port, world, rank, mb = (int(sys.argv[1]), int(sys.argv[2]),
+                             int(sys.argv[3]), float(sys.argv[4]))
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(f"127.0.0.1:{port}", world, rank)
+    import numpy as np
+
+    from multiverso_tpu.parallel.collectives import process_sum
+
+    n = int(mb * 1e6 / 4)
+    arr = np.full(n, float(rank + 1), np.float32)
+
+    def legacy(a):
+        from jax.experimental import multihost_utils
+        g = multihost_utils.process_allgather(a, tiled=False)
+        return np.asarray(g).sum(axis=0).astype(a.dtype)
+
+    out = {}
+    for name, fn in (("process_sum", process_sum), ("allgather", legacy)):
+        fn(arr)                     # warm/compile
+        reps, t0 = 5, time.monotonic()
+        for _ in range(reps):
+            got = fn(arr)
+        dt = (time.monotonic() - t0) / reps
+        assert got[0] == world * (world + 1) / 2, got[0]
+        out[name + "_ms"] = round(dt * 1e3, 2)
+    out["speedup"] = round(out["allgather_ms"] / out["process_sum_ms"], 2)
+    if rank == 0:
+        print("RESULT " + json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()
