@@ -256,15 +256,22 @@ def bench_aggregate_path(world: int = 4, mb: float = 16.0):
     import sys
 
     repo = os.path.dirname(os.path.abspath(__file__))
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-    out = _collect_worker_results(
-        [[sys.executable, os.path.join(repo, "tools", "bench_aggregate.py"),
-          str(port), str(world), str(r), str(mb)]
-         for r in range(world)], timeout=180)[0]
-    out["world"], out["mb"] = world, mb
-    return out
+    last = None
+    for _ in range(2):   # bind-then-close port pick is TOCTOU; retry once
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        try:
+            out = _collect_worker_results(
+                [[sys.executable,
+                  os.path.join(repo, "tools", "bench_aggregate.py"),
+                  str(port), str(world), str(r), str(mb)]
+                 for r in range(world)], timeout=180)[0]
+            out["world"], out["mb"] = world, mb
+            return out
+        except RuntimeError as e:
+            last = e
+    raise last
 
 
 def bench_async_ps(seconds: float = 4.0):

@@ -945,8 +945,10 @@ def load_embeddings(path: str) -> Tuple[List[str], np.ndarray]:
     # after that, parse errors mean a malformed file and must propagate —
     # falling back would silently reinterpret broken text as binary.
     def _first_row_is_text() -> bool:
-        try:
-            row = rest.decode("utf-8", errors="strict").splitlines()[0]
+        try:   # probe ONLY the first line — no full-file decode
+            nl = rest.find(b"\n")
+            row = rest[: nl if nl >= 0 else len(rest)].decode(
+                "utf-8", errors="strict")
             vals = np.asarray(row.split()[1:], np.float32)
             return vals.size == d
         except (ValueError, UnicodeDecodeError, IndexError):

@@ -30,22 +30,15 @@ from multiverso_tpu.ps import service as svc
 from multiverso_tpu.ps import wire
 from multiverso_tpu.table import _ceil_to
 from multiverso_tpu.tables.matrix_table import _bucket_size
-from multiverso_tpu.updaters import (AddOption, SGDUpdater, Updater)
+from multiverso_tpu.updaters import AddOption, Updater
 from multiverso_tpu.utils import config as _config
 
-# updaters whose Add is a stateless signed accumulate: on host-backed
-# shards these apply as an in-place numpy scatter (~20 us for a 128-row
-# batch) instead of a jitted donated program (~60 us dispatch). EXACT type
-# match only — a user subclass overriding apply() must keep the jit path.
-_LINEAR_SIGN = {Updater: 1.0, SGDUpdater: -1.0}
-
-# updaters whose apply() never reads the AddOption: their queued adds all
-# coalesce into one group regardless of per-worker opt values (the client
-# default stamps worker_id=rank, which would otherwise split the merge by
-# sender — defeating coalescing for exactly the cross-worker case it
-# exists for). EXACT type match, same reasoning as _LINEAR_SIGN.
-from multiverso_tpu.updaters import FTRLUpdater as _FTRLUpdater
-_OPT_INSENSITIVE = {Updater, SGDUpdater, _FTRLUpdater}
+# updater classification (see updaters.STATELESS_LINEAR /
+# OPT_INSENSITIVE): linear stateless updaters apply with in-place numpy
+# on host-backed shards (~20 us vs ~60 us jit dispatch for a 128-row
+# batch); opt-insensitive ones coalesce across senders.
+from multiverso_tpu.updaters import (OPT_INSENSITIVE as _OPT_INSENSITIVE,
+                                     STATELESS_LINEAR as _LINEAR_SIGN)
 
 
 class _PendingAdd:

@@ -49,6 +49,30 @@ from multiverso_tpu.utils import config, log
 from multiverso_tpu.utils.dashboard import monitor
 from multiverso_tpu.zoo import Zoo
 
+
+class _HostAdd:
+    """One queued client-side add awaiting the coalescing applier."""
+
+    __slots__ = ("arr", "opt", "event", "error", "token")
+
+    def __init__(self, arr: np.ndarray, opt: AddOption):
+        self.arr, self.opt = arr, opt
+        self.event = threading.Event()
+        self.error: Optional[Exception] = None
+        self.token: Optional[jax.Array] = None
+
+    def ready(self) -> bool:
+        """Sweepable: applied and the completion token is device-ready."""
+        return self.event.is_set() and (
+            self.error is not None
+            or (self.token is not None and self.token.is_ready()))
+
+    def result(self):
+        self.event.wait()
+        if self.error is not None:
+            raise self.error
+        return self.token.block_until_ready()
+
 ArrayLike = Union[np.ndarray, jax.Array, Sequence]
 
 
@@ -112,6 +136,19 @@ class Table:
         if wire_filter == "1bit":
             from multiverso_tpu.utils.filters import OneBitsFilter
             self._one_bit = OneBitsFilter(block=1024)
+        if wire_filter != "none":
+            # filters trade encode CPU for wire bytes; on a FAST link that
+            # trade loses (1bit measured ~10x slower than plain off-tunnel)
+            # — warn at creation, when the user can still change the flag
+            from multiverso_tpu.utils import linkprobe
+            ms = linkprobe.device_link_ms()
+            if ms < linkprobe.FAST_LINK_MS:
+                log.error(
+                    "table[%s]: wire_filter=%r but the host<->device link "
+                    "is fast (1 MB upload ~%.1f ms): the filter's encode "
+                    "cost will likely exceed its wire savings — use "
+                    "wire_filter='none' unless this process feeds a slow "
+                    "(tunneled/remote) device", name, wire_filter, ms)
 
         self._pending: Dict[int, Any] = {}
         self._next_msg_id = 0
@@ -121,6 +158,17 @@ class Table:
         # (e.g. an AsyncBuffer prefetch pull) is snapshotting it.
         self._dispatch_lock = threading.RLock()
         self._jit_cache: Dict[Any, Any] = {}
+        # client-side add coalescing (stateless linear updaters, single
+        # controller, uncompressed wire): async host adds queue here and a
+        # background applier merges everything queued into ONE summed
+        # upload — the host->device transfer is the dominant cost on a
+        # tunneled link and transfers do NOT overlap (measured: 4 threaded
+        # 4 MB uploads take ~4x one), so N-deep pipelining must become
+        # 1 upload, not N concurrent ones
+        self._addq: list = []
+        self._addq_cv = threading.Condition()
+        self._addq_inflight = 0
+        self._add_applier: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------------ #
     # construction helpers
@@ -162,14 +210,20 @@ class Table:
             # add whose msg id is never wait()ed (finalize is None and the
             # completion token is already ready) would otherwise pin its
             # device buffer in _pending forever. Swept ids behave exactly
-            # like already-waited ones (wait returns None).
+            # like already-waited ones (wait returns None). Coalesced-add
+            # entries sweep once applied + token-ready.
             done = [mid for mid, (arrs, fin) in self._pending.items()
-                    if fin is None and all(
+                    if (isinstance(arrs, _HostAdd) and arrs.ready())
+                    or (fin is None and not isinstance(arrs, _HostAdd)
+                        and all(
                         hasattr(a, "is_ready") and a.is_ready()
                         for a in jax.tree.leaves(arrs)
-                        if isinstance(a, jax.Array))]
+                        if isinstance(a, jax.Array)))]
             for mid in done:
-                del self._pending[mid]
+                arrs, _ = self._pending.pop(mid)
+                if isinstance(arrs, _HostAdd) and arrs.error is not None:
+                    log.error("table[%s]: fire-and-forget add %d failed: "
+                              "%s", self.name, mid, arrs.error)
             msg_id = self._next_msg_id
             self._next_msg_id += 1
             self._pending[msg_id] = (arrays, finalize)
@@ -189,6 +243,8 @@ class Table:
         if entry is None:
             return None
         arrays, finalize = entry
+        if isinstance(arrays, _HostAdd):
+            return arrays.result()
         arrays = jax.tree.map(
             lambda a: a.block_until_ready() if isinstance(a, jax.Array) else a,
             arrays)
@@ -200,6 +256,7 @@ class Table:
     @property
     def state(self) -> Dict[str, Any]:
         """Current table pytree {data, ustate}; safe to close over in jit."""
+        self._flush_host_adds()
         return {"data": self._data, "ustate": self._ustate}
 
     def functional_add(self, state: Dict[str, Any], delta: jax.Array,
@@ -214,6 +271,7 @@ class Table:
     def adopt(self, state: Dict[str, Any]) -> None:
         """Commit an externally-advanced table state (end of in-graph loop)."""
         self._zoo.mark_dirty(self.table_id)
+        self._flush_host_adds()   # a late-applying add must not overwrite
         self._data = state["data"]
         self._ustate = state["ustate"]
 
@@ -234,6 +292,7 @@ class Table:
 
     def raw(self) -> jax.Array:
         """The live padded, sharded data array (graph-plane read)."""
+        self._flush_host_adds()   # reads see every prior async add
         return self._data
 
     # ------------------------------------------------------------------ #
@@ -344,17 +403,130 @@ class Table:
                 _update, donate_argnums=(0, 1))
         return fn
 
+    # ------------------------------------------------------------------ #
+    # client-side add coalescing
+    # ------------------------------------------------------------------ #
+    def _coalescible(self, delta, opt) -> bool:
+        """Async host adds coalesce when the merge is EXACT: stateless
+        linear updater (sum of deltas == sequence of adds, and opt is
+        never read), single controller (a collective process_sum must
+        keep one per-process issue order), uncompressed wire (the 1bit
+        filter's error feedback is sequence-dependent)."""
+        return (self._wire == "none" and self._zoo.size() == 1
+                and not isinstance(delta, jax.Array)
+                and type(self.updater) in updaters_lib.STATELESS_LINEAR)
+
+    _ADDQ_CAP = 16          # backpressure: each entry is a full host copy
+    _APPLIER_IDLE_S = 5.0   # idle applier threads exit (no table pinning)
+
+    def _enqueue_host_add(self, delta: ArrayLike, opt: AddOption) -> int:
+        entry = _HostAdd(
+            np.array(delta, dtype=self.dtype).reshape(self.shape), opt)
+        with self._addq_cv:
+            while len(self._addq) >= self._ADDQ_CAP:
+                self._addq_cv.wait()   # throttle like the old inline path
+            self._addq.append(entry)
+            self._addq_inflight += 1
+            if self._add_applier is None:
+                self._add_applier = threading.Thread(
+                    target=self._add_applier_loop,
+                    name=f"mv-add-{self.name}", daemon=True)
+                self._add_applier.start()
+            self._addq_cv.notify_all()
+        return self._track(entry)
+
+    def _apply_host_batch(self, batch) -> None:
+        """Merge + upload + apply one drained batch (caller holds the
+        dispatch lock)."""
+        try:
+            if len(batch) == 1:
+                acc = batch[0].arr
+            else:   # float64 accumulate, like every other merge seam
+                acc = np.zeros(self.shape, np.float64)
+                for e in batch:
+                    acc += e.arr
+                acc = acc.astype(self.dtype)
+            delta_dev = self._host_delta(acc)   # ONE upload for all
+            self._data, self._ustate, token = self._full_update_fn()(
+                self._data, self._ustate, delta_dev, batch[0].opt)
+            for e in batch:
+                e.token = token
+        except Exception as err:   # pragma: no cover - device failure
+            for e in batch:
+                e.error = err
+        finally:
+            with self._addq_cv:
+                for e in batch:
+                    e.event.set()
+                self._addq_inflight -= len(batch)
+                self._addq_cv.notify_all()
+
+    def _add_applier_loop(self) -> None:
+        while True:
+            with self._addq_cv:
+                while not self._addq:
+                    if (not self._addq_cv.wait(self._APPLIER_IDLE_S)
+                            and not self._addq):
+                        # idle exit: a parked thread would pin the table
+                        # (and its device buffers) for the process's life
+                        self._add_applier = None
+                        return
+            # dispatch lock FIRST, pop second: entries are only ever held
+            # by a thread that already owns the lock, so a lock-holding
+            # flusher always finds them still queued and drains inline —
+            # no lock-ordering deadlock is possible
+            with self._dispatch_lock:
+                with self._addq_cv:
+                    batch, self._addq = self._addq, []
+                    if batch:
+                        self._addq_cv.notify_all()   # free throttled adds
+                if batch:
+                    self._apply_host_batch(batch)
+
+    def _flush_host_adds(self) -> None:
+        """Reads must observe every prior async add: drain the queue
+        inline. Safe whether or not the caller already holds the dispatch
+        lock (it is reentrant). INVARIANT: entries are only ever popped by
+        a thread holding the dispatch lock, and the inflight decrement
+        happens before that hold is released — so for a dispatch-holder,
+        inflight > 0 implies the entries are still in the queue, and a
+        holder can always drain them itself (no lock-ordering deadlock)."""
+        while self._addq_inflight > 0:
+            with self._dispatch_lock:
+                with self._addq_cv:
+                    batch, self._addq = self._addq, []
+                    if batch:
+                        self._addq_cv.notify_all()   # free throttled adds
+                if batch:
+                    self._apply_host_batch(batch)
+                    continue
+            # empty queue but inflight > 0: another thread is mid-apply
+            # (it held the dispatch lock we just cycled through) — wait
+            # for its completion signal OUTSIDE the dispatch lock
+            with self._addq_cv:
+                while self._addq_inflight > 0 and not self._addq:
+                    self._addq_cv.wait()
+
     def add_async(self, delta: ArrayLike,
                   opt: Optional[AddOption] = None) -> int:
-        """ref WorkerTable::AddAsync — dispatch the update, return a msg id."""
+        """ref WorkerTable::AddAsync — dispatch the update, return a msg id.
+
+        Stateless-linear host adds ride the coalescing queue: N pipelined
+        adds become one summed upload (transfers do not overlap on the
+        tunneled link, so fewer transfers is the only lever). Everything
+        else applies inline under the dispatch lock."""
         opt = opt or AddOption()
         self._zoo.mark_dirty(self.table_id)
-        with monitor(f"table[{self.name}].add"), self._dispatch_lock:
-            if (self._wire != "none" and not isinstance(delta, jax.Array)):
-                return self._add_async_wire(delta, opt)
-            delta_dev = self._host_delta(delta)
-            self._data, self._ustate, token = self._full_update_fn()(
-                self._data, self._ustate, delta_dev, opt)
+        with monitor(f"table[{self.name}].add"):
+            if self._coalescible(delta, opt):
+                return self._enqueue_host_add(delta, opt)
+            with self._dispatch_lock:
+                if (self._wire != "none"
+                        and not isinstance(delta, jax.Array)):
+                    return self._add_async_wire(delta, opt)
+                delta_dev = self._host_delta(delta)
+                self._data, self._ustate, token = self._full_update_fn()(
+                    self._data, self._ustate, delta_dev, opt)
         return self._track(token)
 
     def _add_async_wire(self, delta: ArrayLike, opt: AddOption) -> int:
@@ -385,6 +557,7 @@ class Table:
 
     def get_async(self) -> int:
         """ref WorkerTable::GetAsync — start device->host transfer, return id."""
+        self._flush_host_adds()   # before the lock: the applier needs it
         with monitor(f"table[{self.name}].get"), self._dispatch_lock:
             snap = self._snapshot_fn()(self._data)
             try:
@@ -411,6 +584,7 @@ class Table:
         get_async keeps the snapshot since its read is deferred). With a
         wire filter the download is cast to bf16 on device first (half the
         bytes; ~3 decimal digits, plenty for parameter traffic)."""
+        self._flush_host_adds()   # before the lock: the applier needs it
         with monitor(f"table[{self.name}].get"), self._dispatch_lock:
             if self._wire != "none":
                 host = self._to_host(self._bf16_cast_fn()(self._data))
@@ -444,6 +618,7 @@ class Table:
         """Write raw table + updater state (ref array_table.cpp:143-151).
         Multi-controller: fetching sharded state is a collective, so every
         process must call this together (checkpoint.save does)."""
+        self._flush_host_adds()
         np.save(stream, self._to_host(self._data), allow_pickle=False)
         flat, _ = jax.tree.flatten(self._ustate)
         np.save(stream, np.asarray(len(flat)), allow_pickle=False)
@@ -452,6 +627,7 @@ class Table:
 
     def load(self, stream) -> None:
         self._zoo.mark_dirty(self.table_id)
+        self._flush_host_adds()   # a late-applying add must not overwrite
         data = np.load(stream)
         if data.shape != self._padded_shape:
             raise ValueError(

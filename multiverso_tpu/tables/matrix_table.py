@@ -257,6 +257,7 @@ class MatrixTable(Table):
         self.wait(self.add_rows_async(row_ids, values, opt))
 
     def get_rows_async(self, row_ids) -> int:
+        self._flush_host_adds()   # row reads see prior whole-table adds
         with monitor(f"table[{self.name}].get_rows"), self._dispatch_lock:
             ids, _, k, inv = self._prep_ids(row_ids)
             fn = self._row_get_fn(ids.size)

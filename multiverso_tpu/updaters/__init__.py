@@ -171,6 +171,17 @@ class FTRLUpdater(Updater):
         return w, {"z": z, "n": n}
 
 
+# classification used by the serving/coalescing planes (EXACT type match
+# everywhere: a user subclass overriding apply() must not inherit either
+# property):
+# * STATELESS_LINEAR: Add is a signed accumulate with no state — K adds
+#   merge into one summed add EXACTLY, and host-backed shards may apply
+#   with in-place numpy instead of a jitted program.
+# * OPT_INSENSITIVE: apply() never reads AddOption — queued adds coalesce
+#   across senders regardless of per-worker opt values.
+STATELESS_LINEAR: Dict[type, float] = {Updater: 1.0, SGDUpdater: -1.0}
+OPT_INSENSITIVE = {Updater, SGDUpdater, FTRLUpdater}
+
 _REGISTRY: Dict[str, Callable[..., Updater]] = {
     "default": Updater,
     "sgd": SGDUpdater,

@@ -1,0 +1,51 @@
+"""One-shot host<->device link speed probe.
+
+The host-plane wire filters (bf16/1bit) trade encode CPU for wire bytes —
+a win on a slow link (tunneled/remote PJRT device: ~100 ms/MB), a loss on
+a fast one (local PCIe/ICI: the 1bit filter measured ~10x SLOWER than
+plain off-tunnel, BENCH_EXTRA array_table_cpu_nontunnel). The probe lets
+table creation warn when a configured filter contradicts the measured
+link (VERDICT r3 item 8's guard).
+
+Sync discipline: host READBACK, not ``block_until_ready`` — the tunneled
+PJRT plugin can return from block_until_ready with the transfer still in
+flight (see bench.py / memory: differential timing only).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+_CACHED_MS: Optional[float] = None
+
+# above this, a 1 MB upload is "slow wire" territory where payload
+# compression pays for itself (tunnel uploads measure 100+ ms; local
+# CPU/PCIe measure ~1 ms)
+FAST_LINK_MS = 20.0
+
+
+def device_link_ms(refresh: bool = False) -> float:
+    """Median warm latency (ms) of a 1 MB host->device upload + readback,
+    cached for the process (the wire doesn't change under one run; link
+    WEATHER does, so treat this as an order-of-magnitude signal)."""
+    global _CACHED_MS
+    if _CACHED_MS is not None and not refresh:
+        return _CACHED_MS
+    import jax
+    buf = np.zeros(1 << 20, np.uint8)
+    float(jax.device_put(buf)[0])          # warm the transfer path
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        x = jax.device_put(buf)
+        float(x[0])                        # readback = real sync point
+        times.append(time.perf_counter() - t0)
+    _CACHED_MS = float(np.median(times) * 1e3)
+    return _CACHED_MS
+
+
+def link_is_fast() -> bool:
+    return device_link_ms() < FAST_LINK_MS

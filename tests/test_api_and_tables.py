@@ -63,6 +63,50 @@ class TestArrayTable:
             t.wait(i)
         np.testing.assert_allclose(t.get(), 5.0)
 
+    def test_async_adds_coalesce_into_one_apply(self):
+        """Pipelined host adds on a stateless-linear table merge into one
+        summed upload (transfers do not overlap on a tunneled link, so
+        fewer transfers is the only pipelining lever): all queued entries
+        share one completion token, and the sum is exact."""
+        t = mv.ArrayTable(64, updater="sgd")
+        base = t._m if hasattr(t, "_m") else t
+        delta = np.full(64, 2.0, np.float32)
+        # hold the dispatch lock so the applier can't run: all three adds
+        # queue, then one drain applies them as one batch
+        with base._dispatch_lock:
+            mids = [base.add_async(delta.reshape(base.shape))
+                    for _ in range(3)]
+            assert base._addq_inflight == 3
+        toks = [base.wait(m) for m in mids]
+        assert toks[0] is toks[1] is toks[2]     # ONE merged apply
+        np.testing.assert_allclose(t.get(), -6.0)   # sgd sign, exact sum
+
+    def test_reads_flush_queued_adds_even_under_dispatch_lock(self):
+        """Reading .state/get while holding the dispatch lock (the fused
+        WE path does exactly this) must drain the queue inline, not
+        deadlock against the applier thread."""
+        t = mv.ArrayTable(32, updater="sgd")
+        base = t._m if hasattr(t, "_m") else t
+        delta = np.ones(32, np.float32)
+        with base._dispatch_lock:
+            base.add_async(delta.reshape(base.shape))
+            st = base.state                     # flushes inline
+            host = np.asarray(st["data"]).reshape(-1)[:32]
+        np.testing.assert_allclose(host, -1.0)
+        np.testing.assert_allclose(t.get(), -1.0)
+
+    def test_momentum_adds_do_not_coalesce(self):
+        """Stateful updaters must keep per-add sequencing (N sequential
+        momentum applies != one summed apply)."""
+        t = mv.ArrayTable(16, updater="momentum_sgd")
+        base = t._m if hasattr(t, "_m") else t
+        opt = AddOption(momentum=0.5)
+        for _ in range(3):
+            base.wait(base.add_async(np.ones(base.shape, np.float32), opt))
+        assert base._addq_inflight == 0 and not base._addq
+        # sequential momentum: smooth=.5,.75,.875 -> data = -2.125
+        np.testing.assert_allclose(t.get(), -2.125, rtol=1e-6)
+
     def test_get_out_buffer(self):
         t = mv.ArrayTable(10, init=np.arange(10, dtype=np.float32))
         out = np.zeros(10, np.float32)
