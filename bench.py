@@ -678,9 +678,10 @@ def main() -> None:
     import jax as _jax
     if _jax.devices()[0].platform == "tpu":
         try:
-            # MXU-saturating config: ~100 bf16 TFLOP/s on one chip (wider
-            # models hit the remote-compile size limit in this environment)
-            lm_large_stats = bench_transformer(steps=12, b=2, s=1024,
+            # MXU-saturating config: ~113-124 bf16 TFLOP/s on one chip
+            # (wider models hit the remote-compile size limit in this
+            # environment); steps=24 smooths compute-weather swings
+            lm_large_stats = bench_transformer(steps=24, b=2, s=1024,
                                                dim=2048, layers=8,
                                                vocab=32768, heads=16)
         except Exception as e:

@@ -111,14 +111,19 @@ def _attention(cfg: TransformerConfig, q, k, v):
             raise ValueError("attn='flash' is the single-chip fused kernel; "
                              "use attn='ring' for sequence parallelism")
         from multiverso_tpu.ops.attention_kernels import flash_attention
+        # block size: biggest divisor of S up to 512 — measured on the
+        # 472M LM bench, 512x512 blocks cut the whole-model step ~25-45%
+        # vs 128x128 (fewer grid sweeps re-streaming K/V through VMEM)
+        blk = next((bsz for bsz in (512, 256, 128)
+                    if q.shape[2] % bsz == 0), 128)
         if cfg.batch_axis is None and cfg.tp_axis is None:
-            return flash_attention(q, k, v, True)
+            return flash_attention(q, k, v, True, blk, blk)
         from jax.sharding import PartitionSpec as P
 
         from multiverso_tpu.zoo import Zoo
         spec = P(cfg.batch_axis, cfg.tp_axis, None, None)
         return jax.shard_map(
-            lambda q, k, v: flash_attention(q, k, v, True),
+            lambda q, k, v: flash_attention(q, k, v, True, blk, blk),
             mesh=Zoo.get().mesh(), in_specs=(spec, spec, spec),
             out_specs=spec, check_vma=False)(q, k, v)
     if cfg.attn == "ring":

@@ -16,9 +16,16 @@ strictly above the diagonal skip their compute with ``pl.when`` (the
 block pipeline still streams those K/V blocks — only the MXU/VPU work is
 saved).
 
-The backward pass recomputes attention with plain XLA ops (jax.custom_vjp),
-trading the O(S^2) backward memory for not keeping ``p`` alive; use ring
-attention when S itself is the memory problem.
+The backward pass is Pallas too (FlashAttention-2 style): the forward
+additionally emits the per-row logsumexp, and two blockwise kernels
+recompute ``p = exp(s - lse)`` tile by tile — one walking k-blocks
+innermost to accumulate dQ, one walking q-blocks innermost to accumulate
+dK/dV — so the [S, S] score matrix is never materialized in either
+direction. Measured on the 472M LM bench (b=2, s=1024): full-XLA
+attention 70 ms/step, Pallas fwd + XLA-recompute bwd ~61 ms, Pallas
+fwd+bwd 57.5 ms at the default 128x128 blocks, and 47-54 ms with the
+512x512 blocks the transformer model now auto-selects — in total 97 ->
+113-124 whole-model TFLOP/s depending on tunnel compute weather.
 """
 
 from __future__ import annotations
@@ -32,10 +39,12 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _LANES = 128
+_RES_LANES = 8    # lse residual lane width (smallest legal TPU tile)
 _NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
+                  acc_ref, *,
                   scale: float, causal: bool, block_q: int, block_k: int,
                   nk: int):
     i = pl.program_id(1)
@@ -87,6 +96,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         l = l_ref[...][:, :1]
         l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        # per-row logsumexp, the backward's softmax residual (stored with
+        # a tiny 8-lane trailing dim — TPU blocks need their last dim to
+        # match the array dim or divide 128)
+        lse_ref[0] = jnp.broadcast_to(m_ref[...][:, :1] + jnp.log(l),
+                                      lse_ref.shape[1:])
 
 
 def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
@@ -104,7 +118,7 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
     kernel = functools.partial(
         _flash_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, nk=nk)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
         in_specs=[
@@ -112,8 +126,15 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _RES_LANES),
+                         lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s, _RES_LANES), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),   # running max
             pltpu.VMEM((block_q, _LANES), jnp.float32),   # denominator
@@ -123,7 +144,156 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(flat(q), flat(k), flat(v))
-    return out.reshape(b, h, s, d)
+    return out.reshape(b, h, s, d), lse
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                   dq_ref, acc_ref, *, scale: float, causal: bool,
+                   block_q: int, block_k: int, nk: int):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    live = (j * block_k <= i * block_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _step():
+        qb, kb, vb, dob = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        lse = lse_ref[0][:, :1]
+        # D_i = rowsum(dO * O): recomputed per step in VPU registers —
+        # trivially cheap next to the three matmuls, and it saves
+        # materializing a lane-padded delta array in HBM
+        delta = jnp.sum(dob.astype(jnp.float32)
+                        * o_ref[0].astype(jnp.float32),
+                        axis=-1, keepdims=True)
+        s = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale       # (bq, bk)
+        if causal:
+            qpos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) \
+                + i * block_q
+            kpos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) \
+                + j * block_k
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        p = jnp.exp(s - lse)           # masked entries: exp(-inf-..) = 0
+        dp = jax.lax.dot_general(
+            dob, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)               # (bq, bk)
+        ds = (p * (dp - delta) * scale).astype(kb.dtype)
+        acc_ref[...] += jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # (bq, d)
+
+    @pl.when(j == nk - 1)
+    def _emit():
+        dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
+                    causal: bool, block_q: int, block_k: int, nq: int):
+    j = pl.program_id(1)
+    i = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    live = (i * block_q + block_q - 1 >= j * block_k) if causal else True
+
+    @pl.when(live)
+    def _step():
+        qb, kb, vb, dob = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        lse = lse_ref[0][:, :1]
+        delta = jnp.sum(dob.astype(jnp.float32)
+                        * o_ref[0].astype(jnp.float32),
+                        axis=-1, keepdims=True)
+        s = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale       # (bq, bk)
+        if causal:
+            qpos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) \
+                + i * block_q
+            kpos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) \
+                + j * block_k
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dv_acc[...] += jax.lax.dot_general(
+            p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # (bk, d)
+        dp = jax.lax.dot_general(
+            dob, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)               # (bq, bk)
+        ds = (p * (dp - delta) * scale).astype(qb.dtype)
+        dk_acc[...] += jax.lax.dot_general(
+            ds, qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # (bk, d)
+
+    @pl.when(i == nq - 1)
+    def _emit():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, do, causal: bool, block_q: int,
+                    block_k: int, interpret: bool):
+    b, h, s, d = q.shape
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    bh, nq, nk = b * h, s // block_q, s // block_k
+    scale = 1.0 / (d ** 0.5)
+    flat = lambda t: t.reshape(bh, s, d)
+    qf, kf, vf, of, dof = flat(q), flat(k), flat(v), flat(out), flat(do)
+
+    qspec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    kspec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    rspec = pl.BlockSpec((1, block_q, _RES_LANES),
+                         lambda b, i, j: (b, i, 0))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, nk=nk),
+        grid=(bh, nq, nk),
+        in_specs=[qspec, kspec, kspec, qspec, qspec, rspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qf, kf, vf, of, dof, lse)
+
+    # dK/dV walk q-blocks innermost: grid axis 1 is the K block
+    qspec2 = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
+    kspec2 = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
+    rspec2 = pl.BlockSpec((1, block_q, _RES_LANES),
+                          lambda b, j, i: (b, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, nq=nq),
+        grid=(bh, nk, nq),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, qspec2, rspec2],
+        out_specs=[kspec2, kspec2],
+        out_shape=[jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, s, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qf, kf, vf, of, dof, lse)
+    shape = (b, h, s, d)
+    return dq.reshape(shape), dk.reshape(shape), dv.reshape(shape)
+
+
+def _resolve_interpret(interpret: Optional[bool]) -> bool:
+    """None = interpreter mode off-TPU (tests); one rule for fwd AND bwd
+    (a drift between them would run half the op interpreted)."""
+    return (jax.devices()[0].platform != "tpu" if interpret is None
+            else interpret)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -134,22 +304,21 @@ def flash_attention(q, k, v, causal: bool = False,
     (blocks auto-clamp to S when S < 128). ``interpret=None`` auto-selects
     interpreter mode off-TPU (tests); pass False to force the compiled path.
     """
-    if interpret is None:
-        interpret = jax.devices()[0].platform != "tpu"
-    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    out, _ = _flash_forward(q, k, v, causal, block_q, block_k,
+                            _resolve_interpret(interpret))
+    return out
 
 
 def _fwd(q, k, v, causal, block_q, block_k, interpret):
-    out = flash_attention(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k,
+                              _resolve_interpret(interpret))
+    return out, (q, k, v, out, lse)
 
 
 def _bwd(causal, block_q, block_k, interpret, res, g):
-    from multiverso_tpu.parallel.ring import reference_attention
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: reference_attention(q, k, v, causal=causal), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
+                           _resolve_interpret(interpret))
 
 
 flash_attention.defvjp(_fwd, _bwd)

@@ -289,10 +289,9 @@ def zigzag_ring_attention(q, k, v, axis_name: Optional[str] = None,
 
 
 def reference_attention(q, k, v, causal: bool = False):
-    """Unsharded softmax attention (test oracle; also the recompute
-    backward of ops/attention_kernels.flash_attention). Scores and softmax
-    in f32 regardless of input dtype, output in the input dtype — the same
-    numerics as the flash kernel."""
+    """Unsharded softmax attention (test oracle for the flash and ring
+    kernels). Scores and softmax in f32 regardless of input dtype, output
+    in the input dtype — the same numerics as the flash kernel."""
     scale = 1.0 / (q.shape[-1] ** 0.5)
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale

@@ -72,6 +72,29 @@ class TestFlashAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-3, atol=2e-4)
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grad_matches_reference_multi_block(self, causal):
+        """The Pallas backward's cross-block accumulation (dq over k
+        blocks, dk/dv over q blocks, causal block skipping) against the
+        XLA reference vjp."""
+        q, k, v = _qkv(s=256, d=32, seed=7)
+        g = jnp.asarray(
+            np.random.default_rng(8).normal(size=q.shape), q.dtype)
+
+        def run(fn):
+            out, vjp = jax.vjp(
+                lambda q, k, v: fn(q, k, v), q, k, v)
+            return (out,) + vjp(g)
+
+        with jax.default_matmul_precision("float32"):
+            ff = run(lambda q, k, v: flash_attention(
+                q, k, v, causal, 64, 64))
+            rr = run(lambda q, k, v: reference_attention(
+                q, k, v, causal=causal))
+        for a, b in zip(ff, rr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=3e-4)
+
     def test_rejects_indivisible_seq(self):
         q, k, v = _qkv(s=192, d=32, seed=5)
         with pytest.raises(ValueError, match="not divisible"):
