@@ -1,9 +1,7 @@
+"""Hand-written TPU kernels and quantized-tensor ops."""
 from multiverso_tpu.ops.attention_kernels import flash_attention
-from multiverso_tpu.ops.embedding_kernels import (
-    embedding_gather, embedding_scatter_add, pallas_supported)
 from multiverso_tpu.ops.quantization import (
     QuantizedTensor, dequantize, quantize, quantize_lm_params)
 
-__all__ = ["QuantizedTensor", "dequantize", "embedding_gather",
-           "embedding_scatter_add", "flash_attention", "pallas_supported",
+__all__ = ["QuantizedTensor", "dequantize", "flash_attention",
            "quantize", "quantize_lm_params"]

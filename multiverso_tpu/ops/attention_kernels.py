@@ -43,10 +43,13 @@ _RES_LANES = 8    # lse residual lane width (smallest legal TPU tile)
 _NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
-                  acc_ref, *,
+def _flash_kernel(q_ref, k_ref, v_ref, *refs,
                   scale: float, causal: bool, block_q: int, block_k: int,
-                  nk: int):
+                  nk: int, emit_lse: bool):
+    if emit_lse:
+        o_ref, lse_ref, m_ref, l_ref, acc_ref = refs
+    else:   # inference-only call: skip the residual's VPU work + HBM write
+        (o_ref, m_ref, l_ref, acc_ref), lse_ref = refs, None
     i = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -96,15 +99,16 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
         l = l_ref[...][:, :1]
         l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
-        # per-row logsumexp, the backward's softmax residual (stored with
-        # a tiny 8-lane trailing dim — TPU blocks need their last dim to
-        # match the array dim or divide 128)
-        lse_ref[0] = jnp.broadcast_to(m_ref[...][:, :1] + jnp.log(l),
-                                      lse_ref.shape[1:])
+        if lse_ref is not None:
+            # per-row logsumexp, the backward's softmax residual (stored
+            # with a tiny 8-lane trailing dim — TPU blocks need their last
+            # dim to match the array dim or divide 128)
+            lse_ref[0] = jnp.broadcast_to(m_ref[...][:, :1] + jnp.log(l),
+                                          lse_ref.shape[1:])
 
 
 def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
-                   interpret: bool):
+                   interpret: bool, with_lse: bool):
     b, h, s, d = q.shape
     block_q = min(block_q, s)
     block_k = min(block_k, s)
@@ -117,8 +121,13 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
 
     kernel = functools.partial(
         _flash_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, nk=nk)
-    out, lse = pl.pallas_call(
+        block_q=block_q, block_k=block_k, nk=nk, emit_lse=with_lse)
+    ospec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    oshape = jax.ShapeDtypeStruct((bh, s, d), q.dtype)
+    lspec = pl.BlockSpec((1, block_q, _RES_LANES),
+                         lambda b, i, j: (b, i, 0))
+    lshape = jax.ShapeDtypeStruct((bh, s, _RES_LANES), jnp.float32)
+    res = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
         in_specs=[
@@ -126,15 +135,8 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, _RES_LANES),
-                         lambda b, i, j: (b, i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, s, _RES_LANES), jnp.float32),
-        ],
+        out_specs=[ospec, lspec] if with_lse else [ospec],
+        out_shape=[oshape, lshape] if with_lse else [oshape],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),   # running max
             pltpu.VMEM((block_q, _LANES), jnp.float32),   # denominator
@@ -144,7 +146,37 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(flat(q), flat(k), flat(v))
-    return out.reshape(b, h, s, d), lse
+    out = res[0].reshape(b, h, s, d)
+    return (out, res[1]) if with_lse else (out, None)
+
+
+def _bwd_p_ds(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, i, j, *,
+              scale: float, causal: bool, block_q: int, block_k: int):
+    """Shared backward recompute for ONE (q-block i, k-block j) tile:
+    returns (p, ds) with ds already scale-folded — the one definition of
+    the tile math, so the dQ and dK/dV kernels cannot desynchronize.
+    D_i = rowsum(dO * O) is recomputed per tile in VPU registers:
+    trivially cheap next to the three matmuls, and it saves materializing
+    a lane-padded delta array in HBM."""
+    qb, kb, vb, dob = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+    lse = lse_ref[0][:, :1]
+    delta = jnp.sum(dob.astype(jnp.float32) * o_ref[0].astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    s = jax.lax.dot_general(
+        qb, kb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale           # (bq, bk)
+    if causal:
+        qpos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) \
+            + i * block_q
+        kpos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) \
+            + j * block_k
+        s = jnp.where(qpos >= kpos, s, _NEG_INF)
+    p = jnp.exp(s - lse)               # masked entries: exp(-inf-..) = 0
+    dp = jax.lax.dot_general(
+        dob, vb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)                   # (bq, bk)
+    ds = (p * (dp - delta) * scale).astype(qb.dtype)
+    return p, ds
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
@@ -161,30 +193,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
 
     @pl.when(live)
     def _step():
-        qb, kb, vb, dob = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
-        lse = lse_ref[0][:, :1]
-        # D_i = rowsum(dO * O): recomputed per step in VPU registers —
-        # trivially cheap next to the three matmuls, and it saves
-        # materializing a lane-padded delta array in HBM
-        delta = jnp.sum(dob.astype(jnp.float32)
-                        * o_ref[0].astype(jnp.float32),
-                        axis=-1, keepdims=True)
-        s = jax.lax.dot_general(
-            qb, kb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale       # (bq, bk)
-        if causal:
-            qpos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) \
-                + i * block_q
-            kpos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) \
-                + j * block_k
-            s = jnp.where(qpos >= kpos, s, _NEG_INF)
-        p = jnp.exp(s - lse)           # masked entries: exp(-inf-..) = 0
-        dp = jax.lax.dot_general(
-            dob, vb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)               # (bq, bk)
-        ds = (p * (dp - delta) * scale).astype(kb.dtype)
+        _, ds = _bwd_p_ds(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                          i, j, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k)
         acc_ref[...] += jax.lax.dot_general(
-            ds, kb, (((1,), (0,)), ((), ())),
+            ds, k_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)               # (bq, d)
 
     @pl.when(j == nk - 1)
@@ -207,30 +220,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
 
     @pl.when(live)
     def _step():
-        qb, kb, vb, dob = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
-        lse = lse_ref[0][:, :1]
-        delta = jnp.sum(dob.astype(jnp.float32)
-                        * o_ref[0].astype(jnp.float32),
-                        axis=-1, keepdims=True)
-        s = jax.lax.dot_general(
-            qb, kb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale       # (bq, bk)
-        if causal:
-            qpos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) \
-                + i * block_q
-            kpos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) \
-                + j * block_k
-            s = jnp.where(qpos >= kpos, s, _NEG_INF)
-        p = jnp.exp(s - lse)
+        p, ds = _bwd_p_ds(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                          i, j, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k)
+        dob = do_ref[0]
         dv_acc[...] += jax.lax.dot_general(
             p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)               # (bk, d)
-        dp = jax.lax.dot_general(
-            dob, vb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)               # (bq, bk)
-        ds = (p * (dp - delta) * scale).astype(qb.dtype)
         dk_acc[...] += jax.lax.dot_general(
-            ds, qb, (((0,), (0,)), ((), ())),
+            ds, q_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)               # (bk, d)
 
     @pl.when(i == nq - 1)
@@ -305,13 +303,13 @@ def flash_attention(q, k, v, causal: bool = False,
     interpreter mode off-TPU (tests); pass False to force the compiled path.
     """
     out, _ = _flash_forward(q, k, v, causal, block_q, block_k,
-                            _resolve_interpret(interpret))
+                            _resolve_interpret(interpret), with_lse=False)
     return out
 
 
 def _fwd(q, k, v, causal, block_q, block_k, interpret):
     out, lse = _flash_forward(q, k, v, causal, block_q, block_k,
-                              _resolve_interpret(interpret))
+                              _resolve_interpret(interpret), with_lse=True)
     return out, (q, k, v, out, lse)
 
 

@@ -40,13 +40,12 @@ from multiverso_tpu.updaters import AddOption
 from multiverso_tpu.utils import config
 from multiverso_tpu.utils.dashboard import monitor
 
-config.define_bool("pallas", False,
-                   "use the hand-written Pallas TPU kernels for row-sparse "
-                   "table traffic where shapes allow. Default OFF: measured "
-                   "on-chip (r3), XLA's native gather/scatter beats the "
-                   "kernels at every bucket size tried (375 vs 408 us row "
-                   "add at 4k rows; 1.1 vs 3.2 ms scatter at 49k) — the "
-                   "kernels remain for toolchains where that flips")
+# NOTE: the hand-written Pallas row gather/scatter kernels that once sat
+# behind a "pallas" flag were REMOVED (r4): measured on-chip, XLA's native
+# gather/scatter beat them at every bucket size tried (375 vs 408 us row
+# add at 4k rows; 1.1 vs 3.2 ms scatter at 49k), so they were dead weight.
+# The winning Pallas kernels live in ops/attention_kernels.py (flash
+# attention fwd+bwd, default ON in the transformer).
 
 
 def _bucket_size(k: int, cap: int) -> int:
@@ -89,38 +88,10 @@ class MatrixTable(Table):
             return nd - pd
         return None
 
-    def _use_pallas(self, bucket: int) -> bool:
-        """Pallas row kernels: single-device tables with lane-aligned rows and
-        the plain-accumulation updater (kernels fuse only the += path; other
-        updaters keep the XLA gather/update/scatter program)."""
-        from multiverso_tpu.ops import embedding_kernels as ek
-        return (config.get_flag("pallas")
-                and self._num_shards == 1
-                and self.updater.name == "default"
-                and ek.pallas_supported(int(self.shape[1]), bucket))
-
-    def _pallas_gettable(self, bucket: int) -> bool:
-        from multiverso_tpu.ops import embedding_kernels as ek
-        return (config.get_flag("pallas")
-                and self._num_shards == 1
-                and ek.pallas_supported(int(self.shape[1]), bucket))
-
     def _row_update_fn(self, bucket: int):
         key = ("row_update", bucket)
         fn = self._jit_cache.get(key)
         if fn is not None:
-            return fn
-
-        if self._use_pallas(bucket):
-            from multiverso_tpu.ops import embedding_kernels as ek
-
-            def _update(data, ustate, ids, vals, opt):
-                data = ek.embedding_scatter_add(data, ids, vals)
-                token = jnp.ravel(data)[0]
-                return data, ustate, token
-
-            fn = jax.jit(_update, donate_argnums=(0, 1))
-            self._jit_cache[key] = fn
             return fn
 
         def _update(data, ustate, ids, vals, opt):
@@ -133,16 +104,13 @@ class MatrixTable(Table):
         self._jit_cache[key] = fn
         return fn
 
-    def _row_get_fn(self, bucket: int):
-        key = ("row_get", bucket)
-        fn = self._jit_cache.get(key)
+    def _row_get_fn(self, bucket: int = 0):
+        # one cached fn: jit's own shape-keyed trace cache handles the
+        # per-bucket variation (``bucket`` kept for callsite compatibility)
+        fn = self._jit_cache.get("row_get")
         if fn is None:
-            if self._pallas_gettable(bucket):
-                from multiverso_tpu.ops import embedding_kernels as ek
-                fn = jax.jit(ek.embedding_gather)
-            else:
-                fn = jax.jit(lambda data, ids: jnp.take(data, ids, axis=0))
-            self._jit_cache[key] = fn
+            fn = jax.jit(lambda data, ids: jnp.take(data, ids, axis=0))
+            self._jit_cache["row_get"] = fn
         return fn
 
     def _prep_ids(self, row_ids, values: Optional[np.ndarray] = None

@@ -287,46 +287,3 @@ class TestReviewRegressions:
         assert out.base is mat or out is col
 
 
-def test_matrix_row_ops_route_through_pallas_when_enabled(monkeypatch):
-    """The pallas flag defaults OFF (XLA's scatter measured faster on the
-    current chip), but the table-level wiring must stay correct for the
-    toolchains where that flips: with the flag on and kernels 'supported',
-    row add/get actually route through the kernel entry points and produce
-    the same values."""
-    import jax.numpy as jnp
-    import multiverso_tpu as mv
-    from multiverso_tpu.ops import embedding_kernels as ek
-    from multiverso_tpu.utils import config
-
-    calls = {"scatter": 0, "gather": 0}
-
-    def fake_scatter(data, ids, vals):
-        calls["scatter"] += 1
-        return data.at[ids].add(vals)
-
-    def fake_gather(data, ids):
-        calls["gather"] += 1
-        return jnp.take(data, ids, axis=0)
-
-    monkeypatch.setattr(ek, "pallas_supported", lambda d, b=0: True)
-    monkeypatch.setattr(ek, "embedding_scatter_add", fake_scatter)
-    monkeypatch.setattr(ek, "embedding_gather", fake_gather)
-    import jax
-    from jax.sharding import Mesh
-    # kernels are single-shard only: a 1-device mesh, like the real chip
-    # (shutdown first — an already-started Zoo makes init(mesh=...) a no-op)
-    mv.shutdown()
-    mv.init(mesh=Mesh(np.asarray(jax.devices()[:1]), ("mv",)))
-    config.set_flag("pallas", True)
-    t = mv.MatrixTable(64, 128, name="pallas_route")
-    ids = np.array([1, 5, 9])
-    t.add_rows(ids, np.ones((3, 128), np.float32))
-    got = t.get_rows(ids)
-    assert calls["scatter"] >= 1, "add did not route through the kernel"
-    assert calls["gather"] >= 1, "get did not route through the kernel"
-    np.testing.assert_allclose(got, 1.0)
-    # and the default (flag off) path gives identical results
-    config.set_flag("pallas", False)
-    t2 = mv.MatrixTable(64, 128, name="xla_route")
-    t2.add_rows(ids, np.ones((3, 128), np.float32))
-    np.testing.assert_allclose(t2.get_rows(ids), got)

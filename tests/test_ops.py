@@ -1,34 +1,26 @@
-"""Pallas embedding kernels vs XLA reference (interpret mode on CPU)."""
+"""ops package surface: the surviving hand-written kernels.
 
-import jax.numpy as jnp
-import numpy as np
-import pytest
+The Pallas embedding gather/scatter kernels were REMOVED (r4): XLA's
+native gather/scatter measured faster at every bucket size on-chip, so
+the package no longer carries them. The winning kernels — flash
+attention forward AND backward — are covered in depth by
+tests/test_flash_attention.py; this file pins the public ops surface.
+"""
 
-from multiverso_tpu.ops import embedding_kernels as ek
+import multiverso_tpu.ops as ops
 
 
-class TestEmbeddingKernels:
-    def _data(self, v=64, d=128, b=16, seed=0):
-        rng = np.random.default_rng(seed)
-        table = jnp.asarray(rng.normal(size=(v, d)).astype(np.float32))
-        ids = jnp.asarray(rng.choice(v, size=b, replace=False)
-                          .astype(np.int32))
-        deltas = jnp.asarray(rng.normal(size=(b, d)).astype(np.float32))
-        return table, ids, deltas
+def test_ops_surface():
+    assert set(ops.__all__) == {"QuantizedTensor", "dequantize",
+                                "flash_attention", "quantize",
+                                "quantize_lm_params"}
+    for name in ops.__all__:
+        assert hasattr(ops, name)
 
-    def test_gather_matches_xla(self):
-        table, ids, _ = self._data()
-        out = ek.embedding_gather(table, ids, interpret=True)
-        np.testing.assert_allclose(np.asarray(out),
-                                   np.asarray(ek.gather_reference(table, ids)))
 
-    def test_scatter_add_matches_xla(self):
-        table, ids, deltas = self._data()
-        expect = ek.scatter_add_reference(table, ids, deltas)
-        out = ek.embedding_scatter_add(table.copy(), ids, deltas,
-                                       interpret=True)
-        np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
-                                   rtol=1e-6)
-
-    def test_pallas_supported_gate(self):
-        assert not ek.pallas_supported(100)  # not lane-aligned
+def test_no_embedding_kernels():
+    """The measured-slower kernels must not silently return."""
+    import importlib
+    import pytest
+    with pytest.raises(ImportError):
+        importlib.import_module("multiverso_tpu.ops.embedding_kernels")
