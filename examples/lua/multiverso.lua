@@ -5,11 +5,16 @@
 -- ffi.load('multiverso')). The C ABI here bridges into the JAX/TPU runtime
 -- (see multiverso_tpu/native/mv_capi.cpp); build it with
 --   make -C multiverso_tpu/native capi
--- The build image has no LuaJIT, so this shim cannot run in CI — but the
--- ABI itself is exercised end-to-end by the C driver
--- (multiverso_tpu/native/mv_capi_test.c, `make capi_test`), which calls
--- every symbol in the cdef below with assertions; this file is a thin
--- mirror of that proven surface.
+-- Runtime coverage, in order of strength:
+--   * tests/test_lua_binding.py executes THIS FILE under a real Lua
+--     interpreter (lupa, with an ffi->ctypes bridge) and ports the
+--     reference test battery (binding/lua/test.lua) — it activates
+--     automatically wherever lupa is installed (the zero-egress build
+--     image cannot install it, so it skips there);
+--   * the C driver (multiverso_tpu/native/mv_capi_test.c, `make
+--     capi_test`) calls every symbol below with assertions;
+--   * tests/test_lua_cdef.py pins this cdef to the .so exports AND to
+--     the mv_capi.cpp signatures type-for-type, both directions.
 
 local ffi = require('ffi')
 

@@ -104,9 +104,9 @@ class MatrixTable(Table):
         self._jit_cache[key] = fn
         return fn
 
-    def _row_get_fn(self, bucket: int = 0):
+    def _row_get_fn(self):
         # one cached fn: jit's own shape-keyed trace cache handles the
-        # per-bucket variation (``bucket`` kept for callsite compatibility)
+        # per-bucket variation
         fn = self._jit_cache.get("row_get")
         if fn is None:
             fn = jax.jit(lambda data, ids: jnp.take(data, ids, axis=0))
@@ -228,7 +228,7 @@ class MatrixTable(Table):
         self._flush_host_adds()   # row reads see prior whole-table adds
         with monitor(f"table[{self.name}].get_rows"), self._dispatch_lock:
             ids, _, k, inv = self._prep_ids(row_ids)
-            fn = self._row_get_fn(ids.size)
+            fn = self._row_get_fn()
             rows = fn(self._data, jax.device_put(ids, self._replicated))
             try:
                 rows.copy_to_host_async()

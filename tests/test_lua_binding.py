@@ -1,0 +1,148 @@
+"""Execute the Lua binding under a real Lua interpreter (lupa).
+
+Ports the reference Lua test battery (ref binding/lua/test.lua:1-79 —
+array add/get loops and matrix full+row adds with closed-form expected
+values) to drive the REAL shim (examples/lua/multiverso.lua) against the
+REAL C ABI (native/libmultiverso.so).
+
+This image has no Lua runtime and zero egress (``pip download lupa``
+finds nothing cached), so here the module SKIPS; anywhere lupa is
+installed it runs for real. lupa embeds plain Lua, not LuaJIT, so the
+shim's ``require('ffi')`` is satisfied by a faithful ffi->ctypes bridge
+(cdef/load/new covering exactly the constructs multiverso.lua uses);
+the binding file itself is executed unmodified. The always-on in-image
+guarantees remain: the compiled C driver (native/mv_capi_test.c) calls
+every ABI symbol with assertions, and tests/test_lua_cdef.py pins the
+cdef to the .so exports and the C++ signatures type-for-type.
+"""
+
+import ctypes
+import os
+
+import numpy as np
+import pytest
+
+lupa = pytest.importorskip("lupa")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_LUA = os.path.join(_REPO, "examples", "lua", "multiverso.lua")
+_SO = os.path.join(_REPO, "multiverso_tpu", "native", "libmultiverso.so")
+
+pytestmark = pytest.mark.skipif(not os.path.exists(_SO),
+                                reason="libmultiverso.so not built "
+                                       "(make -C multiverso_tpu/native capi)")
+
+
+class _CLib:
+    """ctypes stand-in for LuaJIT's ``ffi.load`` result: typed MV_*
+    callables (argtypes mirror tests/test_bindings.py's proven setup)."""
+
+    def __init__(self, path: str):
+        lib = ctypes.CDLL(path)
+        fp = ctypes.POINTER(ctypes.c_float)
+        ip = ctypes.POINTER(ctypes.c_int)
+        hp = ctypes.POINTER(ctypes.c_void_p)
+        lib.MV_NewArrayTable.argtypes = [ctypes.c_int, hp]
+        lib.MV_GetArrayTable.argtypes = [ctypes.c_void_p, fp, ctypes.c_int]
+        lib.MV_AddArrayTable.argtypes = lib.MV_GetArrayTable.argtypes
+        lib.MV_AddAsyncArrayTable.argtypes = lib.MV_GetArrayTable.argtypes
+        lib.MV_NewMatrixTable.argtypes = [ctypes.c_int, ctypes.c_int, hp]
+        lib.MV_GetMatrixTableAll.argtypes = [ctypes.c_void_p, fp,
+                                             ctypes.c_int]
+        lib.MV_AddMatrixTableAll.argtypes = lib.MV_GetMatrixTableAll.argtypes
+        lib.MV_AddAsyncMatrixTableAll.argtypes = \
+            lib.MV_GetMatrixTableAll.argtypes
+        lib.MV_GetMatrixTableByRows.argtypes = [
+            ctypes.c_void_p, fp, ctypes.c_int, ip, ctypes.c_int]
+        lib.MV_AddMatrixTableByRows.argtypes = \
+            lib.MV_GetMatrixTableByRows.argtypes
+        lib.MV_AddAsyncMatrixTableByRows.argtypes = \
+            lib.MV_GetMatrixTableByRows.argtypes
+        self._lib = lib
+
+    def __getattr__(self, name):
+        if name.startswith("MV_"):
+            return getattr(self._lib, name)
+        raise AttributeError(name)
+
+
+class _FFIShim:
+    """The subset of LuaJIT's ffi module that multiverso.lua uses."""
+
+    def cdef(self, src):
+        assert "MV_Init" in src   # sanity: the real cdef block arrived
+
+    def load(self, name):
+        assert name == "multiverso"
+        return _CLib(_SO)
+
+    def new(self, spec, n=None):
+        if spec == "TableHandler[1]":
+            return (ctypes.c_void_p * 1)()
+        if spec == "float[?]":
+            return (ctypes.c_float * int(n))()
+        if spec == "int[?]":
+            return (ctypes.c_int * int(n))()
+        raise NotImplementedError(spec)
+
+
+def _load_binding():
+    rt = lupa.LuaRuntime(unpack_returned_tuples=True)
+    shim = _FFIShim()
+    rt.globals()["__py_ffi"] = shim
+    rt.execute("package.preload['ffi'] = function() return __py_ffi end")
+    src = open(_LUA).read()
+    return rt, rt.execute("return (function()\n" + src + "\nend)()")
+
+
+def _farray(*vals):
+    return (ctypes.c_float * len(vals))(*vals)
+
+
+def test_lua_array_table_roundtrip():
+    """ref test.lua testArray: add twice, read back the doubled range."""
+    rt, M = _load_binding()
+    M["init"]()
+    assert int(M["num_workers"]()) == 1
+    size = 64
+    t = M["new_array_table"](size)
+    delta = _farray(*range(1, size + 1))
+    t["add"](t, delta)
+    t["add"](t, delta)
+    M["barrier"]()
+    out = (ctypes.c_float * size)()
+    t["get"](t, out)
+    np.testing.assert_allclose(list(out),
+                               2.0 * np.arange(1, size + 1))
+
+
+def test_lua_matrix_table_full_and_rows():
+    """ref test.lua testMatrix (single worker): one full-table add + one
+    row add; touched rows read back doubled, untouched rows single."""
+    rt, M = _load_binding()
+    M["init"]()
+    num_row, num_col = 11, 10
+    size = num_row * num_col
+    t = M["new_matrix_table"](num_row, num_col)
+    full = _farray(*range(1, size + 1))
+    t["add"](t, full)
+    row_ids = [0, 1, 5, 10]
+    rows_c = (ctypes.c_int * len(row_ids))(*row_ids)
+    row_vals = _farray(*[r * num_col + c + 1
+                         for r in row_ids for c in range(num_col)])
+    t["add_rows"](t, row_vals, rows_c, len(row_ids))
+    M["barrier"]()
+    out = (ctypes.c_float * size)()
+    t["get"](t, out)
+    got = np.asarray(list(out)).reshape(num_row, num_col)
+    base = np.arange(1, size + 1, dtype=np.float64).reshape(num_row,
+                                                            num_col)
+    expect = base.copy()
+    expect[row_ids] *= 2           # touched rows got the value twice
+    np.testing.assert_allclose(got, expect)
+    # row-batch get agrees
+    out_rows = (ctypes.c_float * (len(row_ids) * num_col))()
+    t["get_rows"](t, rows_c, len(row_ids), out_rows)
+    np.testing.assert_allclose(
+        np.asarray(list(out_rows)).reshape(len(row_ids), num_col),
+        expect[row_ids])

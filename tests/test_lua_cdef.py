@@ -66,6 +66,50 @@ def test_capi_surface_fully_mirrored():
                             f"{sorted(unmirrored)}")
 
 
+def _normalize_sig(decl: str) -> str:
+    """``ret name(args)`` -> ``ret(type,type,...)`` with parameter names
+    stripped (``int row_ids[]`` -> ``int[]``, ``float* data`` ->
+    ``float*``) so the cdef and the C++ source compare by TYPES."""
+    decl = re.sub(r"/\*.*?\*/", " ", decl)     # comment-style param names
+    decl = " ".join(decl.split())
+    m = re.match(r"(\w[\w\s\*]*?)\s+MV_\w+\s*\((.*)\)\s*;?$", decl)
+    assert m, decl
+    ret, args = m.group(1), m.group(2).strip()
+    out = []
+    for a in (args.split(",") if args else []):
+        a = a.strip()
+        arr = "[]" if "[" in a else ""
+        a = re.sub(r"\[[^\]]*\]", "", a)
+        toks = a.replace("*", " * ").split()
+        if len(toks) > 1 and re.fullmatch(r"\w+", toks[-1]) \
+                and toks[-1] not in ("int", "float", "void", "char"):
+            toks = toks[:-1]          # drop the parameter name
+        out.append("".join(toks) + arr)
+    return f"{ret}({','.join(out)})"
+
+
+def test_cdef_signatures_match_capi_source():
+    """Name parity is not enough — a drifted ARGUMENT LIST would corrupt
+    the FFI call frame silently. Every declaration in the Lua cdef must
+    match the extern "C" definition in mv_capi.cpp type-for-type."""
+    cdef_src = re.search(r"ffi\.cdef\[\[(.*?)\]\]", open(_LUA).read(),
+                         re.DOTALL).group(1)
+    cpp_src = open(_CAPI).read()
+    cdef_sigs = {re.search(r"(MV_\w+)", d).group(1): _normalize_sig(d)
+                 for d in re.findall(r"[^;{}]*\bMV_\w+\s*\([^)]*\)\s*;",
+                                     cdef_src)}
+    cpp_sigs = {}
+    for d in re.findall(
+            r"^\s*(?:void|int|float|double)[\w\s\*]*?\bMV_\w+\s*\([^)]*\)",
+            cpp_src, re.MULTILINE):
+        name = re.search(r"(MV_\w+)", d).group(1)
+        cpp_sigs[name] = _normalize_sig(d)
+    for name, sig in cdef_sigs.items():
+        assert name in cpp_sigs, f"{name} not defined in mv_capi.cpp"
+        assert sig == cpp_sigs[name], (
+            f"{name}: cdef {sig!r} != C++ {cpp_sigs[name]!r}")
+
+
 def test_cdef_symbols_resolve_through_dynamic_loader():
     """Every cdef name resolves through an actual dlopen/dlsym — the load
     path LuaJIT's ffi.load would take (nm reads the symbol table
