@@ -23,6 +23,38 @@ _lock = threading.Lock()
 _build_failed = False
 
 
+def build_and_load(so_name: str, src_name: str,
+                   extra_flags: Tuple[str, ...] = (),
+                   timeout: int = 180) -> Optional[ctypes.CDLL]:
+    """Build ``native/<src_name>`` into ``native/<so_name>`` if missing
+    (atomic rename so concurrent workers never load a half-written .so),
+    then CDLL it. One implementation for every native helper's
+    build-on-first-use path (this module and ps/native). Returns None when
+    no toolchain produced a loadable library."""
+    so = os.path.join(_DIR, so_name)
+    if not os.path.exists(so):
+        tmp = f"{so}.build.{os.getpid()}"
+        try:
+            subprocess.run(
+                [os.environ.get("CXX", "g++"), "-O3", "-std=c++17",
+                 "-fPIC", "-shared", "-march=native", *extra_flags,
+                 "-o", tmp, os.path.join(_DIR, src_name)],
+                check=True, capture_output=True, timeout=timeout)
+            os.replace(tmp, so)
+        except (subprocess.SubprocessError, OSError):
+            if os.path.exists(tmp):
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+            if not os.path.exists(so):
+                return None
+    try:
+        return ctypes.CDLL(so)
+    except OSError:
+        return None
+
+
 def _try_load() -> Optional[ctypes.CDLL]:
     global _lib, _build_failed
     with _lock:
@@ -30,29 +62,8 @@ def _try_load() -> Optional[ctypes.CDLL]:
             return _lib
         if _build_failed:
             return None
-        if not os.path.exists(_SO):
-            # Build into a process-unique temp name and atomically rename, so
-            # concurrent workers never load a half-written .so.
-            tmp = f"{_SO}.build.{os.getpid()}"
-            try:
-                subprocess.run(
-                    ["g++", "-O3", "-std=c++17", "-fPIC", "-shared",
-                     "-march=native", "-o", tmp,
-                     os.path.join(_DIR, "mv_data.cpp")],
-                    check=True, capture_output=True, timeout=120)
-                os.replace(tmp, _SO)
-            except (subprocess.SubprocessError, OSError):
-                if os.path.exists(tmp):
-                    try:
-                        os.remove(tmp)
-                    except OSError:
-                        pass
-                if not os.path.exists(_SO):
-                    _build_failed = True
-                    return None
-        try:
-            lib = ctypes.CDLL(_SO)
-        except OSError:
+        lib = build_and_load("libmv_data.so", "mv_data.cpp")
+        if lib is None:
             _build_failed = True
             return None
         c_i64, c_i32, c_u64, c_dbl = (ctypes.c_int64, ctypes.c_int32,

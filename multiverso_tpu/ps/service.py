@@ -101,6 +101,16 @@ config.define_bool("ps_coalesce", True,
                    "Merged adds apply as if their deltas arrived in a "
                    "single message: exact for default/sgd updaters, within "
                    "the ASGD contract for the stateful ones")
+config.define_bool("ps_native", True,
+                   "serve and speak the async-PS wire through the native "
+                   "C++ transport (native/mv_ps.cpp) when libmv_ps.so is "
+                   "available: accepted connections are adopted by C++ "
+                   "threads that serve hot row ops on host-backed linear "
+                   "shards with zero Python in the loop (the reference's "
+                   "C++ server hot path, src/server.cpp:36-58), and "
+                   "clients send framed adds/gets straight from C. "
+                   "Anything the native side cannot serve punts to the "
+                   "Python handlers unchanged. Off = pure-Python plane")
 config.define_float("ps_shutdown_grace", 60.0,
                     "seconds a rank keeps its shards served at shutdown "
                     "while waiting for peers to ALSO reach shutdown (the "
@@ -235,6 +245,8 @@ class _Peer:
                  on_death: Optional[Callable[["_Peer", Exception],
                                              None]] = None):
         self.rank = rank
+        self.addr = addr   # the resolved incarnation address (native
+                           # client conns to the same rank reuse it)
         self._on_death = on_death
         host, port = addr.rsplit(":", 1)
         deadline = time.monotonic() + connect_timeout
@@ -380,6 +392,24 @@ class PSService:
         # hop through the Server actor thread, zoo.cpp SendTo)
         self._local_exec = cf.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="ps-local")
+        # native transport (flag ps_native + libmv_ps.so): accepted
+        # connections are adopted by C++ serving threads; _native_cb must
+        # stay referenced or ctypes frees the callback trampoline under
+        # the C++ threads still holding it
+        self._native = None        # cleared (under _native_lock) at close
+        self._native_raw = None    # NEVER cleared: punt callbacks on C++
+        #                            conn threads may run right up to the
+        #                            join inside server_free, which close()
+        #                            calls only after those threads exit
+        self._native_cb = None
+        self._native_lock = threading.Lock()
+        self._nconns: Dict[int, Any] = {}
+        if config.get_flag("ps_native"):
+            from multiverso_tpu.ps import native as ps_native
+            if ps_native.available():
+                self._native, self._native_cb = ps_native.server_new(
+                    self._punt, rank)
+                self._native_raw = self._native
         self._listener = socket.create_server(
             (host, port if port is not None else config.get_flag("ps_port")))
         # published address must be ROUTABLE: a wildcard bind advertises the
@@ -397,12 +427,91 @@ class PSService:
                   self.addr)
 
     # ----------------------------- server side ----------------------- #
-    def register_handler(self, table: str, handler: Callable) -> None:
+    def register_handler(self, table: str, handler: Callable,
+                         shard=None) -> None:
         """``handler(msg_type, meta, arrays) -> (meta, arrays)``, called on
-        a connection thread; the shard serializes internally."""
+        a connection thread; the shard serializes internally. When
+        ``shard`` is a host-backed linear RowShard and the native server
+        is live, the shard's buffer registers with C++ for zero-Python
+        serving of the hot ops — and the Python handler (which then only
+        sees punted messages: compressed wires, checkpoint state, sparse
+        protocol) wraps itself in the native shard mutex so its buffer
+        mutations serialize with C++ applies."""
+        if self._native is not None and shard is not None:
+            wrapped = self._try_register_native(table, handler, shard)
+            if wrapped is not None:
+                handler = wrapped
         with self._handlers_cv:
             self._handlers[table] = handler
             self._handlers_cv.notify_all()
+
+    def _try_register_native(self, table: str, handler: Callable,
+                             shard) -> Optional[Callable]:
+        from multiverso_tpu.ps import native as ps_native
+        from multiverso_tpu.ps.shard import RowShard
+        from multiverso_tpu.updaters import STATELESS_LINEAR
+        # EXACT RowShard only: HashShard grows/remaps its buffer, which
+        # would leave C++ writing through a stale pointer
+        if type(shard) is not RowShard or not shard._np_mode:
+            return None
+        sign = STATELESS_LINEAR.get(type(shard.updater))
+        if sign is None:
+            return None
+        nworkers = 0 if shard._dirty is None else shard._dirty.shape[0]
+        with self._native_lock:
+            if self._native is None:   # raced close(): python plane only
+                return None
+            pin = ps_native.register_shard(
+                self._native, table, shard.lo, shard.n, shard.num_col,
+                shard._data, sign, shard._dirty, nworkers)
+        if pin is None:
+            return None
+        # the pin addresses THIS shard object — stable across same-name
+        # re-registration and server close (review finding: a name lookup
+        # at unlock time could unlock a DIFFERENT shard's mutex)
+        shard.bind_native(pin)
+
+        def locked_handler(msg_type, meta, arrays,
+                           _inner=handler, _pin=pin):
+            ps_native.shard_pin_lock(_pin)
+            try:
+                return _inner(msg_type, meta, arrays)
+            finally:
+                ps_native.shard_pin_unlock(_pin)
+
+        return locked_handler
+
+    def _punt(self, conn_id: int, frame: bytes) -> None:
+        """Frames the native server can't serve, delivered synchronously
+        on the C++ connection thread (per-connection FIFO preserved).
+        Mirrors _serve_conn's dispatch; the reply goes back through the
+        native conn's write lock."""
+        from multiverso_tpu.ps import native as ps_native
+        try:
+            msg_type, msg_id, meta, arrays = wire.parse_frame(frame)
+        except wire.WireError as e:
+            # header was sane (C++ validated bounds) but the body is
+            # garbage: drop it — the python plane kills such connections,
+            # here the conn dies at the client's next real failure
+            log.debug("ps native punt: malformed frame dropped (%s)", e)
+            return
+        try:
+            if msg_type == MSG_PING:       # native serves PING; belt only
+                reply = wire.encode(MSG_REPLY_OK, msg_id,
+                                    {"rank": self.rank})
+            else:
+                handler = self._wait_handler(meta["table"])
+                with monitor(f"ps[{meta['table']}].serve"):
+                    rmeta, rarrays = handler(msg_type, meta, arrays)
+                reply = wire.encode(MSG_REPLY_OK, msg_id, rmeta, rarrays)
+        except Exception as e:
+            log.debug("ps handler error: %s", e)
+            reply = wire.encode(MSG_REPLY_ERR, msg_id,
+                                {"error": f"{type(e).__name__}: {e}"})
+        # _native_raw, not _native: close() clears the latter while punts
+        # may still be in flight; the raw handle stays valid until
+        # server_free (which runs after this conn thread is joined)
+        ps_native.send_raw(self._native_raw, conn_id, reply)
 
     def _wait_handler(self, table: str, timeout: float = 20.0) -> Callable:
         # a worker can race ahead of a peer still constructing its tables
@@ -424,6 +533,13 @@ class PSService:
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if self._native is not None:
+                from multiverso_tpu.ps import native as ps_native
+                # hand the fd to a C++ serving thread (detach: the C++
+                # side owns it now; close() reaches it via the native
+                # server, not self._conns)
+                ps_native.serve_fd(self._native, conn.detach())
+                continue
             with self._conns_lock:
                 self._conns.append(conn)
             threading.Thread(target=self._serve_conn, args=(conn,),
@@ -549,6 +665,58 @@ class PSService:
                 stale.close()
             return peer
 
+    # ------------------------- native client side --------------------- #
+    def native_enabled(self) -> bool:
+        """True when this process can open native client connections (the
+        remote end may still be pure-Python — the wire is identical)."""
+        if not config.get_flag("ps_native"):
+            return False
+        from multiverso_tpu.ps import native as ps_native
+        return ps_native.available()
+
+    def native_conn(self, rank: int):
+        """Native client connection to ``rank`` (NativeConn), creating it
+        lazily. Liveness, rendezvous, and reconnect-backoff bookkeeping
+        stay with the python :meth:`_peer` (which this piggybacks for the
+        address); a native conn observed dead is simply dropped — the next
+        op re-resolves through _peer, so a restarted rank's fresh address
+        is honored. Raises PSPeerError like _peer."""
+        from multiverso_tpu.ps import native as ps_native
+        with self._peers_lock:
+            c = self._nconns.get(rank)
+        if c is not None and not c.dead():
+            return c
+        addr = self.addr if rank == self.rank else self._peer(rank).addr
+        try:
+            c2 = ps_native.NativeConn(addr,
+                                      config.get_flag("ps_connect_timeout"),
+                                      config.get_flag("ps_timeout"))
+        except ps_native.NativeConnError as e:
+            raise PSPeerError(f"rank {rank}: {e}") from e
+        with self._peers_lock:
+            old = self._nconns.get(rank)
+            if old is not None and not old.dead():
+                # lost the race to another thread: use theirs
+                c2.close()
+                return old
+            self._nconns[rank] = c2
+        if old is not None:
+            old.close()
+        return c2
+
+    def drop_native_conn(self, rank: int, conn) -> None:
+        """Forget a native conn observed dead (kept: death bookkeeping —
+        tombstones, hooks — belongs to the python peer plane, which will
+        observe the same failure on its own socket)."""
+        with self._peers_lock:
+            if self._nconns.get(rank) is conn:
+                del self._nconns[rank]
+        conn.close()
+
+    def native_conns(self):
+        with self._peers_lock:
+            return list(self._nconns.values())
+
     def request(self, rank: int, msg_type: int, meta: Dict,
                 arrays: Sequence[np.ndarray] = (),
                 meta_b: Optional[bytes] = None) -> cf.Future:
@@ -593,10 +761,23 @@ class PSService:
 
     def close(self) -> None:
         self._closed = True
+        # shutdown, not just close: close() does NOT wake a thread blocked
+        # in accept() on Linux — shutdown() makes accept return EINVAL
+        # immediately (close alone left the join below eating its timeout
+        # on every service teardown)
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._listener.close()
         except OSError:
             pass
+        # the accept thread must be DONE before the native server is
+        # freed: it could otherwise adopt a last-instant connection into
+        # freed memory
+        if self._accept_thread.is_alive():
+            self._accept_thread.join(timeout=10.0)
         # drop accepted connections too, so an in-process "killed" service
         # actually goes silent (a killed OS process gets this for free)
         with self._conns_lock:
@@ -607,10 +788,21 @@ class PSService:
                     pass
                 conn.close()
             self._conns.clear()
+        with self._native_lock:
+            native, self._native = self._native, None
+        if native is not None:
+            from multiverso_tpu.ps import native as ps_native
+            # joins the C++ serving threads (any in-flight punt callback
+            # finishes first — ctypes released the GIL for this call)
+            ps_native.server_free(native)
+            self._native_cb = None
         with self._peers_lock:
+            nconns, self._nconns = list(self._nconns.values()), {}
             for peer in self._peers.values():
                 peer.close()
             self._peers.clear()
+        for c in nconns:
+            c.close()
         self._local_exec.shutdown(wait=True)
 
 

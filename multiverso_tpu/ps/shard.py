@@ -138,9 +138,17 @@ class RowShard:
         self._addq_lock = threading.Lock()
         self._addq_draining = False
         # observability: adds received vs. jitted updates actually run —
-        # the coalescing ratio the bench asserts on
-        self.stat_adds = 0
-        self.stat_applies = 0
+        # the coalescing ratio the bench asserts on. Python-path counters;
+        # the stat_adds/stat_applies properties add the native server's
+        # counters when the shard is natively registered.
+        self._stat_adds = 0
+        self._stat_applies = 0
+        # native shard PIN once the native server serves this shard's hot
+        # ops (service._try_register_native); Python then only sees punted
+        # messages for it, already holding the native shard mutex. The pin
+        # addresses this exact shard object in C++ and outlives the server
+        # (freed in __del__).
+        self._native_ref: Optional[int] = None
         # dirty[worker, local_row]: starts all-True so a worker's first
         # sparse Get pulls everything (ref matrix.cpp up_to_date_ = false)
         self._dirty = (np.ones((num_workers, self.n), bool)
@@ -169,6 +177,32 @@ class RowShard:
         return jax.device_put(x, NamedSharding(mesh, P()))
 
     # ------------------------------------------------------------------ #
+    def bind_native(self, pin: int) -> None:
+        self._native_ref = pin
+
+    def __del__(self):
+        try:
+            if getattr(self, "_native_ref", None) is not None:
+                from multiverso_tpu.ps import native as ps_native
+                ps_native.shard_pin_free(self._native_ref)
+                self._native_ref = None
+        except Exception:   # noqa: BLE001 — interpreter teardown
+            pass
+
+    def _native_stats(self) -> Tuple[int, int]:
+        if self._native_ref is None:
+            return 0, 0
+        from multiverso_tpu.ps import native as ps_native
+        return ps_native.shard_pin_stats(self._native_ref)
+
+    @property
+    def stat_adds(self) -> int:
+        return self._stat_adds + self._native_stats()[0]
+
+    @property
+    def stat_applies(self) -> int:
+        return self._stat_applies + self._native_stats()[1]
+
     @property
     def scratch(self) -> int:
         return self.n
@@ -370,8 +404,8 @@ class RowShard:
                         except Exception as err:
                             for e in entries:
                                 e.error = err
-                    self.stat_adds += len(batch)
-                    self.stat_applies += len(groups)
+                    self._stat_adds += len(batch)
+                    self._stat_applies += len(groups)
                 for e in batch:
                     e.event.set()
         finally:
@@ -412,14 +446,24 @@ class RowShard:
 
     def _add_rows(self, local: np.ndarray, vals: np.ndarray,
                   opt: AddOption) -> None:
-        if _config.get_flag("ps_coalesce"):
+        if self._native_ref is not None:
+            # natively-served shard: this is a PUNTED add (compressed wire
+            # payload), already running under the native shard mutex via
+            # the service's locked handler. Apply directly — the queue's
+            # drain handoff runs on a pool thread that would NOT hold the
+            # native mutex, racing C++ applies on the same buffer.
+            with self._lock:
+                self._apply_add_group([_PendingAdd(local, vals, opt)], opt)
+                self._stat_adds += 1
+                self._stat_applies += 1
+        elif _config.get_flag("ps_coalesce"):
             self._enqueue_add(local, vals, opt)
         else:
             with self._lock:
                 entry = _PendingAdd(local, vals, opt)
                 self._apply_add_group([entry], opt)
-                self.stat_adds += 1
-                self.stat_applies += 1
+                self._stat_adds += 1
+                self._stat_applies += 1
 
     # ------------------------------------------------------------------ #
     # request handler (runs on service connection threads)

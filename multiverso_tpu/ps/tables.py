@@ -33,6 +33,137 @@ from multiverso_tpu.utils import config, log
 from multiverso_tpu.utils.dashboard import monitor
 
 
+# ---------------------------------------------------------------------- #
+# native-transport futures: Future-shaped handles over the C++ client
+# (ps/native.py). They plug into the same _track/wait/flush bookkeeping as
+# the python _Peer futures — done()/exception()/result(timeout) is all the
+# plane consumes.
+# ---------------------------------------------------------------------- #
+def _failed_future(exc: Exception) -> cf.Future:
+    f: cf.Future = cf.Future()
+    f.set_exception(exc if isinstance(exc, svc.PSPeerError)
+                    else svc.PSPeerError(str(exc)))
+    return f
+
+
+class _NativeAddFuture:
+    """Counted fire-and-forget add: complete when the conn's ack counter
+    reaches this op's sequence number — no Python wakeup per reply. A
+    server ERR reply binds to this op alone (by msg id), matching the
+    python plane's per-future errors."""
+
+    __slots__ = ("_conn", "_seq", "_mid", "_exc")
+
+    def __init__(self, conn, seq: int, mid: int):
+        self._conn, self._seq, self._mid = conn, seq, mid
+        self._exc: Optional[Exception] = None
+
+    def done(self) -> bool:
+        if self._conn.dead():
+            return True
+        done = self._conn.adds_done()
+        return done < 0 or done >= self._seq
+
+    def result(self, timeout=None):
+        from multiverso_tpu.ps.native import NativeConnError
+        if self._exc is not None:
+            raise self._exc
+        try:
+            self._conn.wait_adds(self._seq,
+                                 3600.0 if timeout is None else timeout)
+        except TimeoutError as e:
+            raise cf.TimeoutError(str(e)) from None
+        except NativeConnError as e:
+            self._exc = svc.PSPeerError(str(e))
+            raise self._exc from None
+        err = self._conn.take_add_error(self._mid)
+        if err is not None:
+            self._exc = svc.PSError(err)
+            raise self._exc
+        return ({}, [])
+
+    def exception(self):
+        if not self.done():
+            return None
+        try:
+            self.result(timeout=1.0)
+        except Exception as e:   # noqa: BLE001 — the sweep logs it
+            return e
+        return None
+
+
+class _NativeGetFuture:
+    """Buffer-filling get: the C++ recv thread copies the reply payload
+    straight into ``out``; result() blocks on the native wait."""
+
+    __slots__ = ("_conn", "_mid", "_out", "_state", "_exc")
+
+    def __init__(self, conn, mid: int, out: np.ndarray):
+        self._conn, self._mid, self._out = conn, mid, out
+        self._state = "pending"
+        self._exc: Optional[Exception] = None
+
+    def done(self) -> bool:
+        return self._state != "pending"
+
+    def result(self, timeout=None):
+        from multiverso_tpu.ps.native import NativeConnError
+        if self._state == "error":
+            raise self._exc
+        if self._state == "pending":
+            try:
+                self._conn.get_wait(self._mid,
+                                    3600.0 if timeout is None else timeout)
+            except TimeoutError as e:
+                # the native side dropped the pending entry: this future
+                # can never complete now — pin the failure
+                self._exc = svc.PSPeerError(f"native get: {e}")
+                self._state = "error"
+                raise cf.TimeoutError(str(e)) from None
+            except NativeConnError as e:
+                self._exc = svc.PSPeerError(str(e))
+                self._state = "error"
+                raise self._exc from None
+            self._state = "done"
+        return ({}, [self._out])
+
+    def exception(self):
+        return self._exc
+
+
+def _native_add(service, rank: int, msg_type: int, meta_b: bytes,
+                ids: Optional[np.ndarray], vals: np.ndarray):
+    """One counted add on the native conn to ``rank``; failures come back
+    as failed futures so multi-owner fan-outs keep their live shards
+    (mirrors service.request's never-raise contract)."""
+    conn = None
+    try:
+        conn = service.native_conn(rank)
+        seq, mid = conn.add(msg_type, meta_b, ids, vals)
+        return _NativeAddFuture(conn, seq, mid)
+    except svc.PSError as e:
+        return _failed_future(e)
+    except Exception as e:   # NativeConnError mid-send: conn is toast
+        if conn is not None:
+            service.drop_native_conn(rank, conn)
+        return _failed_future(e)
+
+
+def _native_get(service, rank: int, msg_type: int, meta_b: bytes,
+                ids: Optional[np.ndarray], out: np.ndarray):
+    conn = None
+    try:
+        conn = service.native_conn(rank)
+        mid = conn.get_send(msg_type, meta_b, ids, out)
+        return _NativeGetFuture(conn, mid, out)
+    except svc.PSError as e:
+        return _failed_future(e)
+    except Exception as e:
+        if conn is not None:
+            service.drop_native_conn(rank, conn)
+        return _failed_future(e)
+
+
 def _resolve_updater(updater, num_workers: int, dtype):
     if updater is None:
         updater = config.get_flag("updater_type")
@@ -213,9 +344,19 @@ class AsyncMatrixTable(_AsyncBase):
                                    self.updater, name, init=shard_init,
                                    seed=seed, init_scale=init_scale,
                                    num_workers=shard_workers)
-            self.ctx.service.register_handler(name, self._shard.handle)
+            self.ctx.service.register_handler(name, self._shard.handle,
+                                              shard=self._shard)
         else:
             self._shard = None
+        # client-side native transport eligibility: plain wire, no sparse
+        # stale-row protocol (its dirty-bit ordering relies on the python
+        # conn's FIFO), a dtype the C++ side frames. The server side needs
+        # no agreement — a python peer speaks the same wire.
+        self._native_ok = (wire == "none" and shard_workers == 0
+                           and self.dtype.str in ("<f4", "<f8")
+                           and self.ctx.service.native_enabled())
+        self._meta_cache: Dict[Any, bytes] = {}
+        self._plain_meta_b = wire_mod.pack_meta({"table": self.name})
         # identical on every rank: (rank, lo, hi) of each non-empty shard
         self._ranges = [(r, min(r * self._rows_per, self.num_row),
                          min((r + 1) * self._rows_per, self.num_row))
@@ -243,6 +384,35 @@ class AsyncMatrixTable(_AsyncBase):
         precision) for zero transport savings."""
         return "none" if rank == self.ctx.rank else self._wire
 
+    def _add_meta_b(self, opt: AddOption) -> bytes:
+        """Packed add meta, cached per AddOption (one serialization per
+        distinct opt instead of one per op)."""
+        b = self._meta_cache.get(opt)
+        if b is None:
+            b = wire_mod.pack_meta({"table": self.name,
+                                    "opt": opt._asdict()})
+            if len(self._meta_cache) < 64:
+                self._meta_cache[opt] = b
+        return b
+
+    def _native_flush(self) -> None:
+        """Order fence before python-conn ops that must observe earlier
+        native adds (set_rows/checkpoint): wait for every add issued on
+        this service's native conns. Failures are swallowed here — they
+        surface deterministically through the ops' own futures."""
+        if not getattr(self, "_native_ok", False):
+            return
+        timeout = config.get_flag("ps_timeout")
+        for c in self.ctx.service.native_conns():
+            if c.dead():
+                continue
+            seq = c.adds_issued()   # read under the C issue lock: cannot
+            if seq:                 # lag a completed add on any thread
+                try:
+                    c.wait_adds(seq, timeout)
+                except Exception:   # noqa: BLE001
+                    pass
+
     # ------------------------------------------------------------------ #
     # row ops (ref matrix_table.h:26-75)
     # ------------------------------------------------------------------ #
@@ -252,8 +422,14 @@ class AsyncMatrixTable(_AsyncBase):
         self._zoo_dirty()
         with monitor(f"table[{self.name}].add_rows"):
             uids, vals, _ = self._prep(row_ids, values)
+            meta_b = self._add_meta_b(opt)
+            if self._native_ok and vals.dtype == self.dtype:
+                futs = [_native_add(self.ctx.service, r, svc.MSG_ADD_ROWS,
+                                    meta_b, np.ascontiguousarray(uids[m]),
+                                    np.ascontiguousarray(vals[m]))
+                        for r, m in self._by_owner(uids)]
+                return self._track(futs)
             meta = {"table": self.name, "opt": opt._asdict()}
-            meta_b = wire_mod.pack_meta(meta)   # once, not per owner
             futs = [self.ctx.service.request(
                         r, svc.MSG_ADD_ROWS, meta,
                         [uids[m], wire_mod.to_wire(vals[m],
@@ -270,15 +446,25 @@ class AsyncMatrixTable(_AsyncBase):
         with monitor(f"table[{self.name}].get_rows"):
             uids, _, inv = self._prep(row_ids)
             parts = list(self._by_owner(uids))
-            # remote peers share one packed meta (with the table's wire
-            # codec); the local short-circuit keeps its uncompressed dict
-            meta_b = wire_mod.pack_meta(
-                {"table": self.name, "wire": self._wire})
-            futs = [self.ctx.service.request(
-                        r, svc.MSG_GET_ROWS,
-                        {"table": self.name, "wire": "none"},
-                        [uids[m]], meta_b=meta_b)
-                    for r, m in parts]
+            if self._native_ok:
+                futs = [_native_get(
+                            self.ctx.service, r, svc.MSG_GET_ROWS,
+                            self._plain_meta_b,
+                            np.ascontiguousarray(uids[m]),
+                            np.empty((int(uids[m].size), self.num_col),
+                                     self.dtype))
+                        for r, m in parts]
+            else:
+                # remote peers share one packed meta (with the table's
+                # wire codec); the local short-circuit keeps its
+                # uncompressed dict
+                meta_b = wire_mod.pack_meta(
+                    {"table": self.name, "wire": self._wire})
+                futs = [self.ctx.service.request(
+                            r, svc.MSG_GET_ROWS,
+                            {"table": self.name, "wire": "none"},
+                            [uids[m]], meta_b=meta_b)
+                        for r, m in parts]
 
             def _assemble(results):
                 out = np.empty((uids.size, self.num_col), self.dtype)
@@ -319,6 +505,9 @@ class AsyncMatrixTable(_AsyncBase):
             raise ValueError("set_rows requires unique row ids")
         if np.any((uids < 0) | (uids >= self.num_row)):
             raise IndexError(f"row id out of range [0, {self.num_row})")
+        # order fence: earlier native adds must be acked before this
+        # overwrite travels the python conn (different sockets = no FIFO)
+        self._native_flush()
         meta = {"table": self.name}
         futs = [self.ctx.service.request(r, svc.MSG_SET_ROWS, meta,
                                          [uids[m], vals[m]])
@@ -332,7 +521,14 @@ class AsyncMatrixTable(_AsyncBase):
         opt = opt or AddOption(worker_id=self.ctx.rank)
         self._zoo_dirty()
         with monitor(f"table[{self.name}].add"):
-            delta = np.asarray(delta, self.dtype).reshape(self.shape)
+            delta = np.ascontiguousarray(
+                np.asarray(delta, self.dtype).reshape(self.shape))
+            if self._native_ok:
+                meta_b = self._add_meta_b(opt)
+                futs = [_native_add(self.ctx.service, r, svc.MSG_ADD_FULL,
+                                    meta_b, None, delta[a:b])
+                        for r, a, b in self._ranges]
+                return self._track(futs)
             meta = {"table": self.name, "opt": opt._asdict()}
             futs = [self.ctx.service.request(
                         r, svc.MSG_ADD_FULL, meta,
@@ -346,10 +542,17 @@ class AsyncMatrixTable(_AsyncBase):
     def get_async(self) -> int:
         with monitor(f"table[{self.name}].get"):
             ranges = list(self._ranges)
-            futs = [self.ctx.service.request(
-                        r, svc.MSG_GET_FULL,
-                        {"table": self.name, "wire": self._wire_for(r)})
-                    for r, _, _ in ranges]
+            if self._native_ok:
+                futs = [_native_get(self.ctx.service, r, svc.MSG_GET_FULL,
+                                    self._plain_meta_b, None,
+                                    np.empty((b - a, self.num_col),
+                                             self.dtype))
+                        for r, a, b in ranges]
+            else:
+                futs = [self.ctx.service.request(
+                            r, svc.MSG_GET_FULL,
+                            {"table": self.name, "wire": self._wire_for(r)})
+                        for r, _, _ in ranges]
 
             def _assemble(results):
                 out = np.empty(self.shape, self.dtype)

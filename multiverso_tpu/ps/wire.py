@@ -170,6 +170,32 @@ def recv(sock: socket.socket) -> Tuple[int, int, Dict, List[np.ndarray]]:
     if paylen < metalen or paylen > MAX_FRAME:
         raise WireError(f"frame length out of bounds ({paylen} bytes)")
     body = _recv_exact(sock, paylen)
+    meta, arrays = _parse_body(body, metalen, narr, paylen)
+    return msg_type, msg_id, meta, arrays
+
+
+def parse_frame(frame: bytes) -> Tuple[int, int, Dict, List[np.ndarray]]:
+    """Parse one complete frame already in memory (header + body) — the
+    entry point for frames handed over by the native transport's punt
+    callback (native/mv_ps.cpp). Same validation as :func:`recv`; arrays
+    are views into ``frame``, whose immutability/lifetime the views pin."""
+    if len(frame) < _HEADER.size:
+        raise WireError("short frame")
+    magic, msg_type, _flags, msg_id, metalen, narr, paylen = \
+        _HEADER.unpack_from(frame)
+    if magic != MAGIC:
+        raise WireError(f"bad magic {bytes(magic)!r}")
+    if metalen > MAX_META or paylen < metalen or paylen > MAX_FRAME:
+        raise WireError("frame length out of bounds")
+    body = memoryview(frame)[_HEADER.size:]
+    if len(body) != paylen:
+        raise WireError(f"frame body {len(body)} != paylen {paylen}")
+    meta, arrays = _parse_body(body, metalen, narr, paylen)
+    return msg_type, msg_id, meta, arrays
+
+
+def _parse_body(body, metalen: int, narr: int, paylen: int
+                ) -> Tuple[Dict, List[np.ndarray]]:
     meta = json.loads(bytes(body[:metalen]) or b"{}")
     arrays: List[np.ndarray] = []
     off = metalen
@@ -198,4 +224,4 @@ def recv(sock: socket.socket) -> Tuple[int, int, Dict, List[np.ndarray]]:
     except (struct.error, ValueError, TypeError) as e:
         # TypeError: np.dtype() on a garbage dtype string
         raise WireError(f"malformed frame: {e}") from None
-    return msg_type, msg_id, meta, arrays
+    return meta, arrays

@@ -31,6 +31,8 @@ def main():
     from multiverso_tpu.utils.filesync import file_barrier
 
     config.set_flag("ps_timeout", 120.0)
+    if os.environ.get("MV_PS_NATIVE", "") == "0":   # A/B: pure-python plane
+        config.set_flag("ps_native", False)
     ctx = PSContext(rank, world,
                     PSService(rank, world, FileRendezvous(rdv_dir)))
     rows, dim, batch = 100_000, 128, 1024
@@ -61,14 +63,16 @@ def main():
         t.wait(m)
     dt = time.monotonic() - start
     file_barrier(rdv_dir, world, rank, "done", timeout=60)
-    ctx.close()
     shard = t._shard
+    # snapshot BEFORE close: natively-served shards keep their counters in
+    # the C++ server, which dies with the service
+    stat_adds, stat_applies = shard.stat_adds, shard.stat_applies
+    ctx.close()
     print("RESULT " + json.dumps({
         "rank": rank, "ops": ops, "rows": ops * batch, "seconds": dt,
         # adds this shard received vs. updates actually run: >1 means
         # server-side coalescing merged concurrent adds (ps_coalesce)
-        "coalesce_ratio": round(shard.stat_adds
-                                / max(shard.stat_applies, 1), 2),
+        "coalesce_ratio": round(stat_adds / max(stat_applies, 1), 2),
         "rows_per_sec": ops * batch / dt,
         "mb_per_sec": ops * batch * dim * 4 / dt / 1e6,
         "get_p50_ms": float(np.percentile(get_lat, 50) * 1e3),
