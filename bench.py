@@ -218,21 +218,32 @@ def _collect_worker_results(cmds, timeout: float = 240):
     return results
 
 
-def _run_async_ps_world(world: int, wire: str, seconds: float):
+def _run_async_ps_world(world: int, wire: str, seconds: float,
+                        native: bool = True):
     """One configuration of the uncoordinated-plane bench: ``world`` real
     OS processes (CPU) pushing/pulling 1024-row batches against each
     other's shards over loopback TCP (1/world of the traffic
-    short-circuits)."""
+    short-circuits). ``native=False`` pins the pure-Python plane
+    (MV_PS_NATIVE=0) for the A/B rows."""
     import sys
     import tempfile
 
     repo = os.path.dirname(os.path.abspath(__file__))
-    with tempfile.TemporaryDirectory(prefix="mv_bench_ps_") as rdv:
-        results = _collect_worker_results(
-            [[sys.executable,
-              os.path.join(repo, "tools", "bench_async_ps.py"),
-              rdv, str(world), str(r), str(seconds), wire]
-             for r in range(world)])
+    prior = os.environ.get("MV_PS_NATIVE")   # restore, don't clobber: a
+    if not native:                           # user-exported value must
+        os.environ["MV_PS_NATIVE"] = "0"     # survive this helper
+    try:
+        with tempfile.TemporaryDirectory(prefix="mv_bench_ps_") as rdv:
+            results = _collect_worker_results(
+                [[sys.executable,
+                  os.path.join(repo, "tools", "bench_async_ps.py"),
+                  rdv, str(world), str(r), str(seconds), wire]
+                 for r in range(world)])
+    finally:
+        if prior is None:
+            os.environ.pop("MV_PS_NATIVE", None)
+        else:
+            os.environ["MV_PS_NATIVE"] = prior
     return {
         "rows_per_sec": round(sum(r["rows_per_sec"] for r in results)),
         "mb_per_sec": round(sum(r["mb_per_sec"] for r in results), 1),
@@ -285,6 +296,17 @@ def bench_async_ps(seconds: float = 4.0):
     for world in (2, 4, 8):
         out[f"np{world}"] = max(
             (_run_async_ps_world(world, "none", seconds) for _ in range(2)),
+            key=lambda r: r["rows_per_sec"])
+    # A/B: the same np8 load on the pure-Python plane (ps_native off) —
+    # the native transport's measured margin at the worst
+    # oversubscription. Same best-of-2 protocol as the native rows (an
+    # asymmetric single shot would inflate the ratio by the ±25%
+    # single-run noise alone).
+    from multiverso_tpu.ps import native as _ps_native
+    if _ps_native.available():
+        out["np8_python_plane"] = max(
+            (_run_async_ps_world(8, "none", seconds, native=False)
+             for _ in range(2)),
             key=lambda r: r["rows_per_sec"])
     out["np2_bf16"] = _run_async_ps_world(2, "bf16", seconds)
     # r02-comparable aliases

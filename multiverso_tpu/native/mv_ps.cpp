@@ -44,6 +44,7 @@
 #include <sys/socket.h>
 #include <sys/uio.h>
 #include <unistd.h>
+#include <limits.h>
 
 #include <algorithm>
 #include <atomic>
@@ -108,8 +109,10 @@ bool recv_exact(int fd, void* buf, size_t n) {
 }
 
 bool send_iov(int fd, struct iovec* iov, int cnt) {
+  // chunk at IOV_MAX: the row-gather fanout sends one iovec entry per
+  // (non-contiguous) table row, which can exceed the kernel limit
   while (cnt > 0) {
-    ssize_t r = ::writev(fd, iov, cnt);
+    ssize_t r = ::writev(fd, iov, std::min(cnt, IOV_MAX));
     if (r < 0) {
       if (errno == EINTR) continue;
       return false;
@@ -448,13 +451,25 @@ void reply_err(Server* s, const std::shared_ptr<SrvConn>& c, int64_t msg_id,
   send_reply(s, c, MSG_REPLY_ERR, msg_id, meta, nullptr, 0, nullptr, 0, 0);
 }
 
+// Blob payloads sit at arbitrary offsets inside the frame buffer (the
+// meta length decides), so typed access must go through an alignment
+// gate: aligned data is used in place, misaligned data is copied once
+// into the (max_align'd) scratch vector.
+const uint8_t* aligned_blob(const Blob& b, size_t align,
+                            std::vector<uint8_t>* scratch) {
+  if (reinterpret_cast<uintptr_t>(b.data) % align == 0) return b.data;
+  scratch->assign(b.data, b.data + b.nbytes);
+  return scratch->data();
+}
+
 // localize + bounds-check ids; returns false (and fills err) on violation
 bool localize(const Shard& sh, const Blob& ids, std::vector<int64_t>* out,
               std::string* err) {
-  const auto* p = reinterpret_cast<const int64_t*>(ids.data);
   out->resize(static_cast<size_t>(ids.count));
   for (int64_t i = 0; i < ids.count; ++i) {
-    int64_t l = p[i] - sh.lo;
+    int64_t id;  // memcpy read: the blob may be misaligned in the frame
+    memcpy(&id, ids.data + 8 * i, 8);
+    int64_t l = id - sh.lo;
     if (l < 0 || l >= sh.n) {
       *err = "row ids outside shard [" + std::to_string(sh.lo) + ", " +
              std::to_string(sh.lo + sh.n) + ") of " + sh.name;
@@ -543,11 +558,13 @@ bool serve_native(Server* s, const std::shared_ptr<SrvConn>& c,
         return true;
       }
       {
+        const uint8_t* vdata =
+            aligned_blob(vals, static_cast<size_t>(sh->itemsize), scratch);
         std::lock_guard<std::mutex> g(sh->mu);
         if (sh->itemsize == 4)
-          apply_add<float>(*sh, local, vals.data, sh->sign);
+          apply_add<float>(*sh, local, vdata, sh->sign);
         else
-          apply_add<double>(*sh, local, vals.data, sh->sign);
+          apply_add<double>(*sh, local, vdata, sh->sign);
         mark_dirty(*sh, local);
       }
       sh->adds.fetch_add(1, std::memory_order_relaxed);
@@ -614,11 +631,14 @@ bool serve_native(Server* s, const std::shared_ptr<SrvConn>& c,
         return true;
       }
       {
+        const uint8_t* ddata =
+            aligned_blob(delta, static_cast<size_t>(sh->itemsize),
+                         scratch);
         std::lock_guard<std::mutex> g(sh->mu);
         if (sh->itemsize == 4)
-          apply_full<float>(*sh, delta.data, sh->sign);
+          apply_full<float>(*sh, ddata, sh->sign);
         else
-          apply_full<double>(*sh, delta.data, sh->sign);
+          apply_full<double>(*sh, ddata, sh->sign);
         if (sh->dirty)
           memset(sh->dirty, 1, static_cast<size_t>(sh->nworkers * sh->n));
       }
@@ -685,7 +705,12 @@ void serve_conn(Server* s, std::shared_ptr<SrvConn> c) {
 // ---------------------------------------------------------------------
 struct GetPending {
   uint8_t* out;
-  int64_t out_nbytes;
+  int64_t out_nbytes;   // exact payload size expected (scatter: rows*rowbytes)
+  // scatter mode (get fanout): reply row i lands at out + scatter[i]*rowbytes
+  // instead of contiguously — the C++ side reassembles the multi-owner
+  // reply straight into the caller's full result buffer
+  std::vector<int64_t> scatter;
+  int64_t rowbytes = 0;
   bool done = false;
   std::string err;  // empty = ok
 };
@@ -769,10 +794,17 @@ void client_recv_loop(Client* c) {
           gp->err = "get reply size mismatch (" +
                     std::to_string(blobs[0].nbytes) + " != " +
                     std::to_string(gp->out_nbytes) + " bytes)";
+        } else if (!gp->scatter.empty()) {
+          // fanout reassembly: reply rows land at their ORIGINAL batch
+          // positions in the caller's full buffer (copies under the lock
+          // — a timed-out waiter erases the entry under this same lock,
+          // so the copy can never race a freed caller buffer)
+          const uint8_t* src = blobs[0].data;
+          for (size_t i = 0; i < gp->scatter.size(); ++i)
+            memcpy(gp->out + gp->scatter[i] * gp->rowbytes,
+                   src + static_cast<int64_t>(i) * gp->rowbytes,
+                   static_cast<size_t>(gp->rowbytes));
         } else {
-          // copy under the lock: a timed-out waiter erases the entry
-          // under this same lock, so the copy can never race a freed
-          // caller buffer
           memcpy(gp->out, blobs[0].data,
                  static_cast<size_t>(gp->out_nbytes));
         }
@@ -1157,6 +1189,169 @@ int mvnet_get_wait(void* conn, long long msg_id, double timeout) {
     return gp->err == "connection lost" ? -3 : -2;
   }
   return 0;
+}
+
+// --------------------------- fan-out ops -------------------------------
+// Partition a row batch by owner and send per-owner frames, all inside
+// C — the per-owner numpy masking/copying on the Python side was ~100 us
+// per 1024x128 op at world=8, a large slice of the client CPU budget.
+// owner(id) = mod_owner ? id % world : id / rows_per (the two sharding
+// rules of the async tables). Row payloads go out as per-row iovec
+// entries straight from the caller's batch buffer — no gather copy.
+//
+// out_mid[r]: -2 = rank r owns no rows of this batch, -1 = rows present
+// but conns[r] is NULL/dead or the send failed, >= 0 = msg_id of the
+// counted add on conns[r]. out_seq[r] valid when out_mid[r] >= 0.
+// Returns the number of ranks with rows.
+int mvnet_add_fanout(void** conns, int world, int mod_owner,
+                     long long rows_per, const void* meta,
+                     long long metalen, const int64_t* ids, long long k,
+                     const void* vals, long long rowbytes,
+                     const char* vdtype, long long ncol,
+                     long long* out_seq, long long* out_mid) {
+  std::vector<std::vector<int64_t>> parts(world);
+  for (long long i = 0; i < k; ++i) {
+    int64_t r = mod_owner ? ids[i] % world : ids[i] / rows_per;
+    if (r < 0 || r >= world) return -1;  // caller validated; belt only
+    parts[static_cast<size_t>(r)].push_back(i);
+  }
+  int nranks = 0;
+  std::vector<int64_t> owner_ids;
+  std::vector<struct iovec> iov;
+  for (int r = 0; r < world; ++r) {
+    const auto& idx = parts[r];
+    if (idx.empty()) {
+      out_mid[r] = -2;
+      continue;
+    }
+    ++nranks;
+    auto* c = static_cast<Client*>(conns[r]);
+    if (!c) {
+      out_mid[r] = -1;
+      continue;
+    }
+    const int64_t cnt = static_cast<int64_t>(idx.size());
+    int64_t msg_id, seq;
+    {
+      std::unique_lock<std::mutex> lk(c->mu);
+      if (c->dead) {
+        out_mid[r] = -1;
+        continue;
+      }
+      msg_id = c->next_id++;
+      seq = ++c->adds_issued;
+      c->pending_adds[msg_id] = seq;
+    }
+    owner_ids.resize(static_cast<size_t>(cnt));
+    for (int64_t i = 0; i < cnt; ++i) owner_ids[i] = ids[idx[i]];
+    // head buffer: header + meta + ids blob header; ids data; vals blob
+    // header; then one iovec entry per row of the original buffer
+    std::vector<uint8_t> head, vals_head;
+    int64_t ids_shape[1] = {cnt};
+    std::vector<uint8_t> ids_head;
+    put_blob_header(&ids_head, "<i8", ids_shape, 1);
+    int64_t vshape[2] = {cnt, ncol};
+    put_blob_header(&vals_head, vdtype, vshape, 2);
+    int64_t paylen = metalen + static_cast<int64_t>(ids_head.size()) +
+                     8 * cnt + static_cast<int64_t>(vals_head.size()) +
+                     cnt * rowbytes;
+    put_header(&head, MSG_ADD_ROWS, msg_id,
+               static_cast<uint32_t>(metalen), 2, paylen);
+    head.insert(head.end(), static_cast<const uint8_t*>(meta),
+                static_cast<const uint8_t*>(meta) + metalen);
+    head.insert(head.end(), ids_head.begin(), ids_head.end());
+    iov.clear();
+    iov.push_back({head.data(), head.size()});
+    iov.push_back({owner_ids.data(), static_cast<size_t>(8 * cnt)});
+    iov.push_back({vals_head.data(), vals_head.size()});
+    const auto* vb = static_cast<const uint8_t*>(vals);
+    for (int64_t i = 0; i < cnt; ++i)
+      iov.push_back({const_cast<uint8_t*>(vb + idx[i] * rowbytes),
+                     static_cast<size_t>(rowbytes)});
+    bool ok;
+    {
+      std::lock_guard<std::mutex> g(c->wmu);
+      ok = send_iov(c->fd, iov.data(), static_cast<int>(iov.size()));
+    }
+    if (!ok) {
+      client_mark_dead(c, "send failed");
+      out_mid[r] = -1;
+      continue;
+    }
+    out_mid[r] = msg_id;
+    out_seq[r] = seq;
+  }
+  return nranks;
+}
+
+// Get-side fanout: per-owner GET_ROWS requests whose replies SCATTER into
+// the caller's full (k, ncol) buffer at the original batch positions —
+// the Python-side reassembly (per-part mask writes) disappears.
+// out_mid semantics as in mvnet_add_fanout.
+int mvnet_get_fanout(void** conns, int world, int mod_owner,
+                     long long rows_per, const void* meta,
+                     long long metalen, const int64_t* ids, long long k,
+                     void* out, long long rowbytes, long long* out_mid) {
+  std::vector<std::vector<int64_t>> parts(world);
+  for (long long i = 0; i < k; ++i) {
+    int64_t r = mod_owner ? ids[i] % world : ids[i] / rows_per;
+    if (r < 0 || r >= world) return -1;
+    parts[static_cast<size_t>(r)].push_back(i);
+  }
+  int nranks = 0;
+  std::vector<int64_t> owner_ids;
+  for (int r = 0; r < world; ++r) {
+    const auto& idx = parts[r];
+    if (idx.empty()) {
+      out_mid[r] = -2;
+      continue;
+    }
+    ++nranks;
+    auto* c = static_cast<Client*>(conns[r]);
+    if (!c) {
+      out_mid[r] = -1;
+      continue;
+    }
+    const int64_t cnt = static_cast<int64_t>(idx.size());
+    auto gp = std::make_shared<GetPending>();
+    gp->out = static_cast<uint8_t*>(out);
+    gp->out_nbytes = cnt * rowbytes;
+    gp->rowbytes = rowbytes;
+    gp->scatter = idx;  // original positions for the reply rows
+    int64_t msg_id;
+    {
+      std::unique_lock<std::mutex> lk(c->mu);
+      if (c->dead) {
+        out_mid[r] = -1;
+        continue;
+      }
+      msg_id = c->next_id++;
+      c->gets[msg_id] = gp;
+    }
+    owner_ids.resize(static_cast<size_t>(cnt));
+    for (int64_t i = 0; i < cnt; ++i) owner_ids[i] = ids[idx[i]];
+    if (!client_send_frame(c, MSG_GET_ROWS, msg_id,
+                           static_cast<const uint8_t*>(meta), metalen,
+                           owner_ids.data(), cnt, nullptr, 0, nullptr,
+                           nullptr, 0)) {
+      client_mark_dead(c, "send failed");
+      out_mid[r] = -1;
+      continue;
+    }
+    out_mid[r] = msg_id;
+  }
+  return nranks;
+}
+
+// Drop a pending get without waiting: after this returns, the recv loop
+// can never write into the caller's out buffer for this op (erase and
+// reply-scatter serialize on the same lock). Called when a get future is
+// abandoned (e.g. a sibling owner's failure aborted the whole op) so the
+// shared out buffer can be safely garbage-collected.
+void mvnet_get_cancel(void* conn, long long msg_id) {
+  auto* c = static_cast<Client*>(conn);
+  std::unique_lock<std::mutex> lk(c->mu);
+  c->gets.erase(msg_id);
 }
 
 int mvnet_dead(void* conn) {

@@ -91,6 +91,18 @@ def _try_load() -> Optional[ctypes.CDLL]:
                                        i64, vp, i64]
         lib.mvnet_get_wait.restype = i32
         lib.mvnet_get_wait.argtypes = [vp, i64, dbl]
+        lib.mvnet_get_cancel.argtypes = [vp, i64]
+        lib.mvnet_add_fanout.restype = i32
+        lib.mvnet_add_fanout.argtypes = [ctypes.POINTER(vp), i32, i32,
+                                         i64, ctypes.c_char_p, i64, vp,
+                                         i64, vp, i64, cp, i64,
+                                         ctypes.POINTER(i64),
+                                         ctypes.POINTER(i64)]
+        lib.mvnet_get_fanout.restype = i32
+        lib.mvnet_get_fanout.argtypes = [ctypes.POINTER(vp), i32, i32,
+                                         i64, ctypes.c_char_p, i64, vp,
+                                         i64, vp, i64,
+                                         ctypes.POINTER(i64)]
         lib.mvnet_dead.restype = i32
         lib.mvnet_dead.argtypes = [vp]
         lib.mvnet_last_error.argtypes = [vp, ctypes.c_char_p, i32]
@@ -316,6 +328,15 @@ class NativeConn:
             raise TimeoutError(f"native get: no reply within {timeout}s")
         raise NativeConnError(self.last_error() or "native get failed")
 
+    def get_cancel(self, mid: int) -> None:
+        """Drop a pending get; afterwards the recv loop can never touch
+        the op's out buffer (abandoned-future safety)."""
+        self._lib.mvnet_get_cancel(self._h, mid)
+
+    @property
+    def handle(self) -> int:
+        return self._h
+
     def close(self) -> None:
         """Sever the connection (idempotent). The C++ Client is NOT freed
         here — outstanding futures may still call into it (every call on a
@@ -332,3 +353,66 @@ class NativeConn:
                 self._h = None
         except Exception:   # noqa: BLE001 — interpreter teardown
             pass
+
+
+def add_fanout(conns, world: int, mod_owner: bool, rows_per: int,
+               meta_b: bytes, ids: np.ndarray, vals: np.ndarray):
+    """Partition an add batch by owner and send per-owner frames in C.
+    ``conns``: one NativeConn or None per rank. Returns
+    ``[(rank, conn, seq, mid) | (rank, None, -1, -1)]`` for each rank
+    that owns rows (None conn = unreachable/dead: caller fails that
+    part). Raises only on caller bugs (owner out of range)."""
+    lib = _try_load()
+    assert ids.dtype == np.int64 and ids.flags.c_contiguous
+    assert vals.flags.c_contiguous and vals.ndim == 2
+    handles = (ctypes.c_void_p * world)(
+        *[c.handle if c is not None and not c.dead() else None
+          for c in conns])
+    out_seq = (ctypes.c_int64 * world)()
+    out_mid = (ctypes.c_int64 * world)()
+    rc = lib.mvnet_add_fanout(
+        handles, world, 1 if mod_owner else 0, rows_per,
+        meta_b, len(meta_b), ids.ctypes.data, ids.size,
+        vals.ctypes.data, vals.strides[0], vals.dtype.str.encode(),
+        vals.shape[1], out_seq, out_mid)
+    if rc < 0:
+        raise ValueError("add_fanout: row owner out of range")
+    out = []
+    for r in range(world):
+        if out_mid[r] == -2:
+            continue
+        if out_mid[r] == -1:
+            out.append((r, None, -1, -1))
+        else:
+            out.append((r, conns[r], int(out_seq[r]), int(out_mid[r])))
+    return out
+
+
+def get_fanout(conns, world: int, mod_owner: bool, rows_per: int,
+               meta_b: bytes, ids: np.ndarray, out: np.ndarray):
+    """Per-owner GET_ROWS whose replies scatter into ``out`` (k, ncol) at
+    the original batch positions — reassembly happens in the C++ recv
+    thread. Same return shape as :func:`add_fanout` (seq slot unused)."""
+    lib = _try_load()
+    assert ids.dtype == np.int64 and ids.flags.c_contiguous
+    assert out.flags.c_contiguous and out.ndim == 2
+    assert out.shape[0] == ids.size
+    handles = (ctypes.c_void_p * world)(
+        *[c.handle if c is not None and not c.dead() else None
+          for c in conns])
+    out_mid = (ctypes.c_int64 * world)()
+    rc = lib.mvnet_get_fanout(
+        handles, world, 1 if mod_owner else 0, rows_per,
+        meta_b, len(meta_b), ids.ctypes.data, ids.size,
+        out.ctypes.data, out.strides[0], out_mid)
+    if rc < 0:
+        raise ValueError("get_fanout: row owner out of range")
+    res = []
+    for r in range(world):
+        if out_mid[r] == -2:
+            continue
+        if out_mid[r] == -1:
+            res.append((r, None, -1, -1))
+        else:
+            res.append((r, conns[r], 0, int(out_mid[r])))
+    return res

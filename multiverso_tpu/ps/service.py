@@ -704,6 +704,14 @@ class PSService:
             old.close()
         return c2
 
+    def native_conn_or_none(self, rank: int):
+        """:meth:`native_conn` with unreachable ranks mapped to None (the
+        fanout paths turn those into failed futures per owner)."""
+        try:
+            return self.native_conn(rank)
+        except PSError:
+            return None
+
     def drop_native_conn(self, rank: int, conn) -> None:
         """Forget a native conn observed dead (kept: death bookkeeping —
         tombstones, hooks — belongs to the python peer plane, which will

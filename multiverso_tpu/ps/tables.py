@@ -106,6 +106,16 @@ class _NativeGetFuture:
     def done(self) -> bool:
         return self._state != "pending"
 
+    def __del__(self):
+        # abandoned while pending (a sibling owner's failure aborted the
+        # whole op): cancel so the C++ recv thread can never scatter into
+        # the (about to be GC'd) out buffer
+        try:
+            if self._state == "pending":
+                self._conn.get_cancel(self._mid)
+        except Exception:   # noqa: BLE001 — interpreter teardown
+            pass
+
     def result(self, timeout=None):
         from multiverso_tpu.ps.native import NativeConnError
         if self._state == "error":
@@ -162,6 +172,16 @@ def _native_get(service, rank: int, msg_type: int, meta_b: bytes,
         if conn is not None:
             service.drop_native_conn(rank, conn)
         return _failed_future(e)
+
+
+def _fanout_futures(parts, make):
+    """Shared shaping of add_fanout/get_fanout results into futures: an
+    unreachable owner becomes a failed future (live shards unaffected),
+    everything else goes through ``make(conn, seq, mid)``."""
+    return [(_failed_future(svc.PSPeerError(f"rank {r} unreachable over "
+                                            "native transport"))
+             if conn is None else make(conn, seq, mid))
+            for r, conn, seq, mid in parts]
 
 
 def _resolve_updater(updater, num_workers: int, dtype):
@@ -395,6 +415,18 @@ class AsyncMatrixTable(_AsyncBase):
                 self._meta_cache[opt] = b
         return b
 
+    def _owner_conns(self, uids: np.ndarray):
+        """Native conns for the C-side fanout, indexed by rank. ONLY the
+        ranks that own rows of THIS batch are resolved (a down rank that
+        owns nothing must not cost unrelated ops its connect timeout, and
+        a single-owner batch must not open world-many sockets); the rest
+        stay None, which the fanout reads as no-rows/unreachable."""
+        svc_ = self.ctx.service
+        conns = [None] * self.ctx.world
+        for r in np.unique(uids // self._rows_per).tolist():
+            conns[r] = svc_.native_conn_or_none(int(r))
+        return conns
+
     def _native_flush(self) -> None:
         """Order fence before python-conn ops that must observe earlier
         native adds (set_rows/checkpoint): wait for every add issued on
@@ -424,11 +456,13 @@ class AsyncMatrixTable(_AsyncBase):
             uids, vals, _ = self._prep(row_ids, values)
             meta_b = self._add_meta_b(opt)
             if self._native_ok and vals.dtype == self.dtype:
-                futs = [_native_add(self.ctx.service, r, svc.MSG_ADD_ROWS,
-                                    meta_b, np.ascontiguousarray(uids[m]),
-                                    np.ascontiguousarray(vals[m]))
-                        for r, m in self._by_owner(uids)]
-                return self._track(futs)
+                from multiverso_tpu.ps import native as ps_native
+                parts = ps_native.add_fanout(
+                    self._owner_conns(uids), self.ctx.world, False,
+                    self._rows_per, meta_b, uids,
+                    np.ascontiguousarray(vals))
+                return self._track(_fanout_futures(
+                    parts, lambda c, s, m: _NativeAddFuture(c, s, m)))
             meta = {"table": self.name, "opt": opt._asdict()}
             futs = [self.ctx.service.request(
                         r, svc.MSG_ADD_ROWS, meta,
@@ -445,26 +479,31 @@ class AsyncMatrixTable(_AsyncBase):
     def get_rows_async(self, row_ids) -> int:
         with monitor(f"table[{self.name}].get_rows"):
             uids, _, inv = self._prep(row_ids)
-            parts = list(self._by_owner(uids))
             if self._native_ok:
-                futs = [_native_get(
-                            self.ctx.service, r, svc.MSG_GET_ROWS,
-                            self._plain_meta_b,
-                            np.ascontiguousarray(uids[m]),
-                            np.empty((int(uids[m].size), self.num_col),
-                                     self.dtype))
-                        for r, m in parts]
-            else:
-                # remote peers share one packed meta (with the table's
-                # wire codec); the local short-circuit keeps its
-                # uncompressed dict
-                meta_b = wire_mod.pack_meta(
-                    {"table": self.name, "wire": self._wire})
-                futs = [self.ctx.service.request(
-                            r, svc.MSG_GET_ROWS,
-                            {"table": self.name, "wire": "none"},
-                            [uids[m]], meta_b=meta_b)
-                        for r, m in parts]
+                from multiverso_tpu.ps import native as ps_native
+                out = np.empty((uids.size, self.num_col), self.dtype)
+                fparts = ps_native.get_fanout(
+                    self._owner_conns(uids), self.ctx.world, False,
+                    self._rows_per, self._plain_meta_b, uids, out)
+                futs = _fanout_futures(
+                    fparts, lambda c, s, m: _NativeGetFuture(c, m, out))
+
+                def _assemble_native(results):
+                    # replies scattered into ``out`` in the C++ recv
+                    # threads; results only carry completion
+                    return out if inv is None else out[inv]
+
+                return self._track(futs, _assemble_native)
+            parts = list(self._by_owner(uids))
+            # remote peers share one packed meta (with the table's wire
+            # codec); the local short-circuit keeps its uncompressed dict
+            meta_b = wire_mod.pack_meta(
+                {"table": self.name, "wire": self._wire})
+            futs = [self.ctx.service.request(
+                        r, svc.MSG_GET_ROWS,
+                        {"table": self.name, "wire": "none"},
+                        [uids[m]], meta_b=meta_b)
+                    for r, m in parts]
 
             def _assemble(results):
                 out = np.empty((uids.size, self.num_col), self.dtype)
