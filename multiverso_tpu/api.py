@@ -93,6 +93,11 @@ def is_master_worker() -> bool:
 def create_table(option: Any, name: Optional[str] = None):
     """ref MV_CreateTable (multiverso.h:31-37): build from an Option struct and
     barrier afterwards so every process sees the table."""
+    if not hasattr(option, "build"):
+        raise TypeError(
+            f"create_table expects a table Option (ArrayTableOption, "
+            f"MatrixTableOption, ...), got {type(option).__name__}: "
+            f"{option!r}")
     table = option.build(name) if name is not None else option.build()
     barrier()
     return table

@@ -138,6 +138,8 @@ struct MetaScan {
   bool ok = false;          // parsed, and every key is whitelisted
   std::string table;        // meta["table"]
   std::string wire;         // meta["wire"] (empty = absent)
+  bool sparse = false;      // meta["sparse"] truthy (stale-row get)
+  int64_t worker_id = -1;   // meta["worker_id"] (sparse protocol)
 };
 
 const char* skip_ws(const char* p, const char* end) {
@@ -226,10 +228,12 @@ const char* skip_value(const char* p, const char* end, int depth) {
 }
 
 // Whitelist scan: natively servable metas contain only {"table", "opt",
-// "wire"}. "opt" is skipped whole: the native path only serves shards
-// whose updaters are opt-INSENSITIVE stateless accumulates (registration
-// guarantees it), so its contents cannot matter. Any other key ("sparse",
-// "dump", "all", future extensions) punts the frame to Python.
+// "wire", "sparse", "worker_id"}. "opt" is skipped whole: the native path
+// only serves shards whose updaters are opt-INSENSITIVE stateless
+// accumulates (registration guarantees it), so its contents cannot
+// matter; "sparse"/"worker_id" drive the natively-served stale-row GET
+// branch. Any other key ("dump", "all", future extensions) punts the
+// frame to Python.
 MetaScan scan_meta(const char* p, size_t len) {
   MetaScan m;
   const char* end = p + len;
@@ -253,6 +257,27 @@ MetaScan scan_meta(const char* p, size_t len) {
       p = parse_string(p, end, &m.wire);
     } else if (key == "opt") {
       p = skip_object(p, end, 0);
+    } else if (key == "sparse") {
+      // json.dumps(True) -> "true"; anything else punts via parse fail
+      if (end - p >= 4 && !strncmp(p, "true", 4)) {
+        m.sparse = true;
+        p += 4;
+      } else if (end - p >= 5 && !strncmp(p, "false", 5)) {
+        p += 5;
+      } else {
+        return m;
+      }
+    } else if (key == "worker_id") {
+      // bounded digit parse: the buffer is NOT null-terminated, so
+      // strtoll could walk past `end`
+      int64_t v = 0;
+      const char* q = p;
+      while (q < end && isdigit(static_cast<unsigned char>(*q)) &&
+             v < (1ll << 40))
+        v = v * 10 + (*q++ - '0');
+      if (q == p) return m;   // non-numeric (or negative): punt
+      m.worker_id = v;
+      p = q;
     } else {
       return m;  // unknown key: punt
     }
@@ -600,6 +625,55 @@ bool serve_native(Server* s, const std::shared_ptr<SrvConn>& c,
       if (blobs.size() != 1 || blobs[0].dtype != "<i8") return false;
       const Blob& ids = blobs[0];
       if (ids.count == 0) return false;
+      if (m.sparse) {
+        // stale-row protocol (ref matrix.cpp:475-572 GetOption.worker_id
+        // + stale filter; python twin: RowShard.handle sparse branch):
+        // read+clear this worker's dirty bits and reply
+        // [mask bool[k], stale rows] — bits and gather under ONE lock
+        // hold so the reply is atomic with the bits it cleared.
+        if (!sh->dirty) {
+          reply_err(s, c, h.msg_id,
+                    sh->name + " was not created with num_workers; "
+                    "sparse gets need dirty-bit tracking");
+          return true;
+        }
+        if (m.worker_id < 0 || m.worker_id >= sh->nworkers)
+          return false;  // odd worker_id: let Python shape the error
+        if (!localize(*sh, ids, &local, &err)) {
+          reply_err(s, c, h.msg_id, err);
+          return true;
+        }
+        const int64_t rowbytes = sh->ncol * sh->itemsize;
+        std::vector<uint8_t> mask(static_cast<size_t>(ids.count));
+        int64_t nstale = 0;
+        {
+          std::lock_guard<std::mutex> g(sh->mu);
+          uint8_t* bits = sh->dirty + m.worker_id * sh->n;
+          for (int64_t i = 0; i < ids.count; ++i) {
+            mask[i] = bits[local[i]] ? 1 : 0;
+            bits[local[i]] = 0;
+            nstale += mask[i];
+          }
+          scratch->resize(static_cast<size_t>(nstale) * rowbytes);
+          int64_t w = 0;
+          for (int64_t i = 0; i < ids.count; ++i)
+            if (mask[i])
+              memcpy(scratch->data() + (w++) * rowbytes,
+                     sh->data + local[i] * rowbytes,
+                     static_cast<size_t>(rowbytes));
+        }
+        // reply: blob0 = bool mask (numpy '|b1'), blob1 = stale rows
+        std::vector<uint8_t> bh;
+        int64_t mshape[1] = {ids.count};
+        put_blob_header(&bh, "|b1", mshape, 1);
+        bh.insert(bh.end(), mask.begin(), mask.end());
+        int64_t rshape[2] = {nstale, sh->ncol};
+        put_blob_header(&bh, sh->dtype.c_str(), rshape, 2);
+        send_reply(s, c, MSG_REPLY_OK, h.msg_id, "{}", bh.data(),
+                   bh.size(), scratch->data(),
+                   static_cast<int64_t>(scratch->size()), 2);
+        return true;
+      }
       if (!localize(*sh, ids, &local, &err)) {
         reply_err(s, c, h.msg_id, err);
         return true;

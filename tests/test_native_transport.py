@@ -110,8 +110,9 @@ class TestPuntPaths:
         assert np.all(got < 0)   # adagrad: w -= lr * g / sqrt(g2 + eps)
 
     def test_sparse_protocol_over_native_server(self, two_ranks):
-        """Sparse stale-row pulls punt (python conn) while plain adds are
-        served in C++ — the dirty bits C++ sets must drive the protocol."""
+        """The stale-row protocol end to end with C++ serving BOTH sides:
+        adds set dirty bits in C, sparse gets read+clear them and reply
+        [mask, stale rows] in C — same wire the python server speaks."""
         t0 = AsyncSparseMatrixTable(10, 4, name="psp", ctx=two_ranks[0])
         t1 = AsyncSparseMatrixTable(10, 4, name="psp", ctx=two_ranks[1])
         assert t0._shard._native_ref is not None   # dirty bits live in C++
@@ -121,6 +122,7 @@ class TestPuntPaths:
         assert t1.last_transfer_rows == 2          # initial pull: all stale
         again = t1.get_rows_sparse(ids, worker_id=1)
         assert t1.last_transfer_rows == 0          # clean: nothing moved
+        assert again.shape == (2, 4)
         t0.add_rows([6], np.ones((1, 4), np.float32))   # python conn add
         t1.add_rows([1], np.full((1, 4), 3.0, np.float32))
         t0.flush(), t1.flush()
@@ -128,6 +130,32 @@ class TestPuntPaths:
         assert t1.last_transfer_rows == 2          # both rows re-dirtied
         np.testing.assert_allclose(got[0], 3.0)
         np.testing.assert_allclose(got[1], 1.0)
+        # per-worker isolation: worker 0 still sees everything stale
+        got0 = t0.get_rows_sparse(ids, worker_id=0)
+        assert t0.last_transfer_rows == 2
+        np.testing.assert_allclose(got0, got)
+
+    def test_sparse_get_served_natively_not_punted(self, two_ranks):
+        """The sparse branch must be handled in C++ (no punt): assert by
+        sending a sparse get for a natively-registered shard and checking
+        the python handler was never invoked."""
+        t0 = AsyncSparseMatrixTable(8, 2, name="psn", ctx=two_ranks[0])
+        t1 = AsyncSparseMatrixTable(8, 2, name="psn", ctx=two_ranks[1])
+        calls = []
+        orig = t0._shard.handle
+
+        def spy(*a, **k):
+            calls.append(a[0])
+            return orig(*a, **k)
+
+        # re-register the spy THROUGH the service wrapper machinery
+        two_ranks[0].service.register_handler("psn", spy,
+                                              shard=t0._shard)
+        t1.get_rows_sparse(np.array([0, 1]), worker_id=1)  # rank0's shard
+        t1.add_rows([0], np.ones((1, 2), np.float32))
+        t1.flush()
+        t1.get_rows_sparse(np.array([0, 1]), worker_id=1)
+        assert calls == []   # everything served in C++
 
     def test_checkpoint_roundtrip_over_native(self, two_ranks, tmp_path):
         t0 = AsyncMatrixTable(10, 4, name="ck", ctx=two_ranks[0])
