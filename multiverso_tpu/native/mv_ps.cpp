@@ -110,9 +110,15 @@ bool recv_exact(int fd, void* buf, size_t n) {
 
 bool send_iov(int fd, struct iovec* iov, int cnt) {
   // chunk at IOV_MAX: the row-gather fanout sends one iovec entry per
-  // (non-contiguous) table row, which can exceed the kernel limit
+  // (non-contiguous) table row, which can exceed the kernel limit.
+  // sendmsg+MSG_NOSIGNAL, not writev: a peer-closed socket must yield
+  // EPIPE, not a SIGPIPE that kills a non-Python embedder outright
+  // (Python ignores the signal; a plain C host does not).
   while (cnt > 0) {
-    ssize_t r = ::writev(fd, iov, std::min(cnt, IOV_MAX));
+    struct msghdr mh = {};
+    mh.msg_iov = iov;
+    mh.msg_iovlen = static_cast<size_t>(std::min(cnt, IOV_MAX));
+    ssize_t r = ::sendmsg(fd, &mh, MSG_NOSIGNAL);
     if (r < 0) {
       if (errno == EINTR) continue;
       return false;
@@ -649,11 +655,14 @@ bool serve_native(Server* s, const std::shared_ptr<SrvConn>& c,
         {
           std::lock_guard<std::mutex> g(sh->mu);
           uint8_t* bits = sh->dirty + m.worker_id * sh->n;
+          // mask FIRST, clear second: a duplicate id in one request must
+          // see the same bit at every occurrence (python-twin parity —
+          // its vectorized mask read happens before the clear)
           for (int64_t i = 0; i < ids.count; ++i) {
             mask[i] = bits[local[i]] ? 1 : 0;
-            bits[local[i]] = 0;
             nstale += mask[i];
           }
+          for (int64_t i = 0; i < ids.count; ++i) bits[local[i]] = 0;
           scratch->resize(static_cast<size_t>(nstale) * rowbytes);
           int64_t w = 0;
           for (int64_t i = 0; i < ids.count; ++i)

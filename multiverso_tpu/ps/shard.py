@@ -178,6 +178,9 @@ class RowShard:
 
     # ------------------------------------------------------------------ #
     def bind_native(self, pin: int) -> None:
+        if self._native_ref is not None:   # re-registration: free the old
+            from multiverso_tpu.ps import native as ps_native
+            ps_native.shard_pin_free(self._native_ref)
         self._native_ref = pin
 
     def __del__(self):
