@@ -246,6 +246,13 @@ def _run_async_ps_world(world: int, wire: str, seconds: float,
             os.environ["MV_PS_NATIVE"] = prior
     return {
         "rows_per_sec": round(sum(r["rows_per_sec"] for r in results)),
+        # aggregate request rate across the plane (each op = `world`
+        # messages with these strided row sets): the metric that shows
+        # server throughput RISING with worker count even when rows/s —
+        # which pays world messages per batch — tilts down on a 1-core
+        # host
+        "msgs_per_sec": round(sum(r.get("msgs_per_sec", 0)
+                                  for r in results)),
         "mb_per_sec": round(sum(r["mb_per_sec"] for r in results), 1),
         "get_p50_ms": round(float(np.median(
             [r["get_p50_ms"] for r in results])), 2),

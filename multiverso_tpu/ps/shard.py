@@ -147,8 +147,9 @@ class RowShard:
         # ops (service._try_register_native); Python then only sees punted
         # messages for it, already holding the native shard mutex. The pin
         # addresses this exact shard object in C++ and outlives the server
-        # (freed in __del__).
+        # (freed in __del__, along with pins retired by re-registration).
         self._native_ref: Optional[int] = None
+        self._retired_pins: List[int] = []
         # dirty[worker, local_row]: starts all-True so a worker's first
         # sparse Get pulls everything (ref matrix.cpp up_to_date_ = false)
         self._dirty = (np.ones((num_workers, self.n), bool)
@@ -178,17 +179,23 @@ class RowShard:
 
     # ------------------------------------------------------------------ #
     def bind_native(self, pin: int) -> None:
-        if self._native_ref is not None:   # re-registration: free the old
-            from multiverso_tpu.ps import native as ps_native
-            ps_native.shard_pin_free(self._native_ref)
+        if self._native_ref is not None:
+            # re-registration: the OLD pin must not be freed yet — the
+            # previously installed locked_handler closure still holds it
+            # and may be mid-request; retire it and free at shard death
+            self._retired_pins.append(self._native_ref)
         self._native_ref = pin
 
     def __del__(self):
         try:
+            pins = getattr(self, "_retired_pins", [])
             if getattr(self, "_native_ref", None) is not None:
-                from multiverso_tpu.ps import native as ps_native
-                ps_native.shard_pin_free(self._native_ref)
+                pins = pins + [self._native_ref]
                 self._native_ref = None
+            if pins:
+                from multiverso_tpu.ps import native as ps_native
+                for p in pins:
+                    ps_native.shard_pin_free(p)
         except Exception:   # noqa: BLE001 — interpreter teardown
             pass
 

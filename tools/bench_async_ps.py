@@ -33,6 +33,8 @@ def main():
     config.set_flag("ps_timeout", 120.0)
     if os.environ.get("MV_PS_NATIVE", "") == "0":   # A/B: pure-python plane
         config.set_flag("ps_native", False)
+    from multiverso_tpu.ps import native as ps_native
+    native_plane = (config.get_flag("ps_native") and ps_native.available())
     ctx = PSContext(rank, world,
                     PSService(rank, world, FileRendezvous(rdv_dir)))
     rows, dim, batch = 100_000, 128, 1024
@@ -74,6 +76,13 @@ def main():
         # server-side coalescing merged concurrent adds (ps_coalesce)
         "coalesce_ratio": round(stat_adds / max(stat_applies, 1), 2),
         "rows_per_sec": ops * batch / dt,
+        # the strided row sets span every owner, so each op fans out to
+        # `world` messages: rows/s divides by world as world grows while
+        # the plane's actual request rate RISES — report both. On the
+        # native plane every owner (incl. self) is a real loopback-TCP
+        # message; the python plane short-circuits the local owner
+        # in-process, so it gets world-1.
+        "msgs_per_sec": ops * (world if native_plane else world - 1) / dt,
         "mb_per_sec": ops * batch * dim * 4 / dt / 1e6,
         "get_p50_ms": float(np.percentile(get_lat, 50) * 1e3),
         "get_p99_ms": float(np.percentile(get_lat, 99) * 1e3),
