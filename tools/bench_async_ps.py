@@ -33,13 +33,14 @@ def main():
     config.set_flag("ps_timeout", 120.0)
     if os.environ.get("MV_PS_NATIVE", "") == "0":   # A/B: pure-python plane
         config.set_flag("ps_native", False)
-    from multiverso_tpu.ps import native as ps_native
-    native_plane = (config.get_flag("ps_native") and ps_native.available())
     ctx = PSContext(rank, world,
                     PSService(rank, world, FileRendezvous(rdv_dir)))
     rows, dim, batch = 100_000, 128, 1024
     t = AsyncMatrixTable(rows, dim, name="bench_async", wire=wire,
                          ctx=ctx)
+    # the table's OWN routing decision, not a re-derivation: bf16 wires
+    # and native-setup failures run the python plane regardless of flags
+    native_plane = t._native_ok
     rng = np.random.default_rng(rank)
     # this worker's ids: strided so every batch spans BOTH shards (half
     # the traffic crosses the socket, half short-circuits — the realistic
