@@ -44,13 +44,22 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.slow)
 
 
-@pytest.fixture
-def two_ranks(tmp_path):
+@pytest.fixture(params=["native", "python"])
+def two_ranks(request, tmp_path):
     """Two async-PS contexts sharing a file rendezvous — a 2-rank world in
     one process; every cross-rank op crosses a real localhost socket. The
-    single-process tier-2 fixture for the uncoordinated plane."""
+    single-process tier-2 fixture for the uncoordinated plane.
+
+    Parametrized over BOTH wire planes: the native C++ transport (the
+    default everywhere libmv_ps builds) and the pure-python plane
+    (ps_native off) — the fallback must not rot just because the fast
+    path serves the battery. Where no toolchain built the library the
+    "native" param degrades to python and simply duplicates coverage."""
     from multiverso_tpu.ps.service import (FileRendezvous, PSContext,
                                            PSService)
+    from multiverso_tpu.utils import config
+    if request.param == "python":
+        config.set_flag("ps_native", False)
     rdv = FileRendezvous(str(tmp_path / "rdv"))
     ctxs = [PSContext(r, 2, PSService(r, 2, rdv)) for r in range(2)]
     yield ctxs

@@ -28,6 +28,17 @@ def _world(tmp_path, n=2, sub="rdv"):
     return [PSContext(r, n, PSService(r, n, rdv)) for r in range(n)]
 
 
+@pytest.fixture
+def two_ranks(tmp_path):
+    """Native-only override of conftest's plane-parametrized fixture:
+    these tests assert native-specific behavior (server handles, pins,
+    C-served stats), meaningless on the python plane."""
+    ctxs = _world(tmp_path)
+    yield ctxs
+    for c in ctxs:
+        c.close()
+
+
 class TestNativeServing:
     def test_native_server_is_live(self, two_ranks):
         assert two_ranks[0].service._native is not None
