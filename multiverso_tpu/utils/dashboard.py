@@ -62,6 +62,7 @@ class Dashboard:
     """Process-global registry of Monitors (ref dashboard.h Dashboard)."""
 
     _monitors: Dict[str, Monitor] = {}
+    _notes: Dict[str, str] = {}
     _lock = threading.Lock()
 
     @classmethod
@@ -73,9 +74,17 @@ class Dashboard:
             return mon
 
     @classmethod
+    def note(cls, name: str, text: str) -> None:
+        """Free-form counter line for work the Monitor timers never see
+        (e.g. ops served inside the native transport)."""
+        with cls._lock:
+            cls._notes[name] = text
+
+    @classmethod
     def reset(cls) -> None:
         with cls._lock:
             cls._monitors.clear()
+            cls._notes.clear()
 
     @classmethod
     def snapshot(cls) -> Dict[str, Monitor]:
@@ -85,11 +94,15 @@ class Dashboard:
     @classmethod
     def display(cls, print_fn=print) -> None:
         mons = cls.snapshot()
-        if not mons:
+        with cls._lock:
+            notes = dict(cls._notes)
+        if not mons and not notes:
             return
         print_fn("--------------Dashboard--------------------")
         for name in sorted(mons):
             print_fn(mons[name].info_string())
+        for name in sorted(notes):
+            print_fn(f"[{name}] {notes[name]}")
         print_fn("-------------------------------------------")
 
 

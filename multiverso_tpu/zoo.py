@@ -88,6 +88,19 @@ class Zoo:
             return
         self.barrier()
         if config.get_flag("dashboard"):
+            # natively-served async ops never cross the Python monitor
+            # (that's the point of them), so surface the C++ counters in
+            # the shutdown report alongside the monitored paths
+            for table in list(self._tables.values()):
+                shard = getattr(table, "_shard", None)
+                if shard is None or getattr(shard, "_native_ref",
+                                            None) is None:
+                    continue
+                adds, applies = shard._native_stats()
+                if adds:
+                    Dashboard.note(
+                        f"ps[{table.name}].native_served",
+                        f"adds = {adds}, applies = {applies}")
             Dashboard.display(log.info)
         try:
             from multiverso_tpu.ps import service as _ps_service

@@ -87,6 +87,17 @@ class TestDashboard:
         out = capsys.readouterr().out
         assert "Dashboard" in out and "[x]" in out
 
+    def test_notes_in_display_and_reset(self, capsys):
+        """Free-form notes (native-transport counters) print alongside
+        the monitors and clear on reset."""
+        Dashboard.note("ps[t].native_served", "adds = 7, applies = 7")
+        Dashboard.display()
+        out = capsys.readouterr().out
+        assert "native_served] adds = 7" in out
+        Dashboard.reset()
+        Dashboard.display()
+        assert "native_served" not in capsys.readouterr().out
+
 
 def test_timer():
     t = Timer()
