@@ -93,8 +93,8 @@ class Dashboard:
 
     @classmethod
     def display(cls, print_fn=print) -> None:
-        mons = cls.snapshot()
-        with cls._lock:
+        with cls._lock:   # one hold: monitors+notes are an atomic view
+            mons = dict(cls._monitors)
             notes = dict(cls._notes)
         if not mons and not notes:
             return

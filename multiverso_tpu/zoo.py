@@ -102,6 +102,9 @@ class Zoo:
                         f"ps[{table.name}].native_served",
                         f"adds = {adds}, applies = {applies}")
             Dashboard.display(log.info)
+            # a second init/stop cycle must not reprint this run's
+            # counters as its own
+            Dashboard.reset()
         try:
             from multiverso_tpu.ps import service as _ps_service
             _ps_service.reset_default_context()

@@ -50,6 +50,8 @@ def main():
 
     config.set_flag("ps_timeout", 20.0)
     config.set_flag("ps_connect_timeout", 10.0)
+    if os.environ.get("MV_PS_NATIVE", "") == "0":   # python-plane variant
+        config.set_flag("ps_native", False)
     ctx = None
     if mode != "ftrl_lr":   # ftrl_lr goes through the app's default context
         ctx = PSContext(rank, world,

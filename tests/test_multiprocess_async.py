@@ -16,11 +16,15 @@ _DIR = os.path.dirname(os.path.abspath(__file__))
 _REPO = os.path.dirname(_DIR)
 
 
-def _spawn(tmp_path, nprocs, mode, expect_fail_rank=None):
+def _spawn(tmp_path, nprocs, mode, expect_fail_rank=None, extra_env=None):
     rdv = str(tmp_path / "rdv")
     os.makedirs(rdv, exist_ok=True)
     env = dict(os.environ)
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # an ambient MV_PS_NATIVE (e.g. left exported while debugging the
+    # fallback) must not silently downgrade the native-plane tests
+    env.pop("MV_PS_NATIVE", None)
+    env.update(extra_env or {})
     procs = [
         subprocess.Popen(
             [sys.executable, os.path.join(_DIR, "async_ps_worker.py"),
@@ -48,6 +52,17 @@ def _spawn(tmp_path, nprocs, mode, expect_fail_rank=None):
     if errors:
         pytest.fail("\n".join(errors))
     return results
+
+
+def test_uncoordinated_rates_python_plane(tmp_path):
+    """The same converged-state contract on the pure-PYTHON plane
+    (ps_native off): the fallback for toolchain-less hosts must keep
+    working at the real OS-process tier, not just in-process tests."""
+    results = _spawn(tmp_path, 2, "rates", extra_env={"MV_PS_NATIVE": "0"})
+    assert set(results) == {0, 1}
+    expect_sum = sum((r + 1) * 5 for r in range(2)) * 8 * 4
+    for r in results.values():
+        assert r["row_sum"] == expect_sum
 
 
 @pytest.mark.parametrize("nprocs", [2, 4])
