@@ -349,6 +349,11 @@ def make_train_step(cfg: TransformerConfig, learning_rate: float = 1e-2):
     through ``sharedvar.SharedPytree.sync`` (the delta-sync ASGD surface) or
     ``Table.functional_add`` inside your own step. For stateful optimizers
     use :func:`make_optax_train_step`.
+
+    Jit with ``donate_argnums=(0,)`` when your loop rebinds ``params``
+    every step: the update then writes the weight buffers in place
+    (measured ~0.6 ms/step on the 472M bench config) — but the ORIGINAL
+    params object is consumed, so leave donation off if you keep it.
     """
 
     def step(params, tokens, targets):
