@@ -37,6 +37,8 @@ def main():
     config.set_flag("ps_world", world)
     config.set_flag("ps_rendezvous", rdv_dir)
     config.set_flag("ps_timeout", 120.0)
+    if os.environ.get("MV_PS_NATIVE", "") == "0":   # plane A/B (bench use)
+        config.set_flag("ps_native", False)
     mv.init()
 
     cfg = WEConfig(size=16, epoch=1, min_count=1, batch_size=128,
@@ -60,6 +62,7 @@ def main():
     print("RESULT " + json.dumps({
         "rank": rank,
         "words": int(stats["words_per_sec"] * stats["seconds"] + 0.5),
+        "words_per_sec": round(stats["words_per_sec"], 1),
         "loss": stats["loss"],
         "loss_epoch2": stats2["loss"],
         "total_words": total,
