@@ -4,6 +4,10 @@
 # row-sharded async tables — the reference's defining workflow
 # (mpirun -np 4 distributed_wordembedding), rebuilt TPU-native.
 # Mirrors tests/we_async_worker.py, runnable by hand.
+#
+# The wire rides the native C++ transport when libmv_ps.so builds
+# (auto-built on first use); MV_PS_NATIVE=0 ./async_ps_demo.sh forces
+# the pure-python plane for an A/B.
 set -e
 cd "$(dirname "$0")/.."
 # the workers live under tests/, so python's script-dir sys.path entry is

@@ -182,7 +182,7 @@ def main():
                 assert time.monotonic() < deadline
             # 2) the PS death fed elastic's failed set (tombstone); generous
             # deadline — this can run on a heavily loaded CI box
-            deadline = time.monotonic() + 30
+            deadline = time.monotonic() + 60
             while victim not in elastic.failed(hb_dir, timeout=1e9):
                 assert time.monotonic() < deadline
                 time.sleep(0.05)
