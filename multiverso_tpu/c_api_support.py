@@ -97,6 +97,24 @@ def new_matrix_table(num_row: int, num_col: int) -> int:
                                     name=f"c_matrix_{_next_handle}"))
 
 
+def new_async_array_table(size: int) -> int:
+    """Uncoordinated-plane array table for FFI clients (beyond the
+    reference C API, which only reached the sync tables): every process
+    owns a row range served by its PSService, ops ride the native C++
+    transport where built. The generic array_get/array_add accessors
+    work unchanged — the async tables share the op surface."""
+    return _register(mv.AsyncArrayTable(size, dtype=np.float32,
+                                        name=f"c_async_array_{_next_handle}"))
+
+
+def new_async_matrix_table(num_row: int, num_col: int) -> int:
+    """Uncoordinated-plane matrix table for FFI clients (see
+    new_async_array_table); matrix_* accessors work unchanged."""
+    return _register(mv.AsyncMatrixTable(
+        num_row, num_col, dtype=np.float32,
+        name=f"c_async_matrix_{_next_handle}"))
+
+
 def matrix_get_all(handle: int, addr: int, size: int) -> None:
     t = _tables[handle]
     _view(addr, size)[:] = t.get().reshape(-1)[:size]

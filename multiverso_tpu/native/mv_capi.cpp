@@ -145,6 +145,22 @@ void MV_AddAsyncArrayTable(TableHandler handler, float* data, int size) {
                   reinterpret_cast<int64_t>(data), size, 0));
 }
 
+// Uncoordinated (async-PS plane) tables — BEYOND the reference C API,
+// which reached only the sync tables: every process owns a row shard
+// served by its PSService; Adds/Gets are uncoordinated and ride the
+// native C++ transport where libmv_ps builds. The row/whole-table
+// accessors below work on these handles unchanged (same op surface).
+
+void MV_NewAsyncArrayTable(int size, TableHandler* out) {
+  *out = reinterpret_cast<TableHandler>(
+      call_i64("new_async_array_table", "(i)", size));
+}
+
+void MV_NewAsyncMatrixTable(int num_row, int num_col, TableHandler* out) {
+  *out = reinterpret_cast<TableHandler>(
+      call_i64("new_async_matrix_table", "(ii)", num_row, num_col));
+}
+
 // ---- Matrix table -------------------------------------------------------
 
 void MV_NewMatrixTable(int num_row, int num_col, TableHandler* out) {
