@@ -30,16 +30,15 @@ void MV_NewArrayTable(int size, TableHandler* out);
 void MV_GetArrayTable(TableHandler handler, float* data, int size);
 void MV_AddArrayTable(TableHandler handler, float* data, int size);
 void MV_AddAsyncArrayTable(TableHandler handler, float* data, int size);
+void MV_NewAsyncArrayTable(int size, TableHandler* out);
+void MV_NewAsyncMatrixTable(int num_row, int num_col, TableHandler* out);
 void MV_NewMatrixTable(int num_row, int num_col, TableHandler* out);
 void MV_GetMatrixTableAll(TableHandler handler, float* data, int size);
 void MV_AddMatrixTableAll(TableHandler handler, float* data, int size);
 void MV_AddAsyncMatrixTableAll(TableHandler handler, float* data, int size);
-void MV_GetMatrixTableByRows(TableHandler handler, float* data, int size,
-                             int row_ids[], int row_ids_n);
-void MV_AddMatrixTableByRows(TableHandler handler, float* data, int size,
-                             int row_ids[], int row_ids_n);
-void MV_AddAsyncMatrixTableByRows(TableHandler handler, float* data,
-                                  int size, int row_ids[], int row_ids_n);
+void MV_GetMatrixTableByRows(TableHandler handler, float* data, int size, int row_ids[], int row_ids_n);
+void MV_AddMatrixTableByRows(TableHandler handler, float* data, int size, int row_ids[], int row_ids_n);
+void MV_AddAsyncMatrixTableByRows(TableHandler handler, float* data, int size, int row_ids[], int row_ids_n);
 ]]
 
 local lib = ffi.load('multiverso')
@@ -75,6 +74,15 @@ function ArrayTable:add_async(buf)
   lib.MV_AddAsyncArrayTable(self.handler, buf, self.size)
 end
 
+-- Uncoordinated (async-PS plane) array table — beyond the reference C
+-- API; the row/element accessors are the same, only the constructor
+-- differs (every process owns a shard served by its PSService).
+function M.new_async_array_table(size)
+  local h = ffi.new('TableHandler[1]')
+  lib.MV_NewAsyncArrayTable(size, h)
+  return setmetatable({ handler = h[0], size = size }, ArrayTable)
+end
+
 local MatrixTable = {}
 MatrixTable.__index = MatrixTable
 
@@ -98,6 +106,15 @@ end
 
 function MatrixTable:add_async(buf)
   lib.MV_AddAsyncMatrixTableAll(self.handler, buf, self.size)
+end
+
+-- Async-plane matrix table (see new_async_array_table); same accessors.
+function M.new_async_matrix_table(num_row, num_col)
+  local h = ffi.new('TableHandler[1]')
+  lib.MV_NewAsyncMatrixTable(num_row, num_col, h)
+  return setmetatable({ handler = h[0], num_row = num_row,
+                        num_col = num_col, size = num_row * num_col },
+                      MatrixTable)
 end
 
 -- row batch ops: `rows` is a 0-based int array (ref MatrixTableHandler)

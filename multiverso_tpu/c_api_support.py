@@ -51,7 +51,25 @@ def shutdown() -> None:
 
 
 def barrier() -> None:
+    # The C ABI has no flush entry point; FFI clients (the reference's Lua
+    # test battery) use MV_Barrier as the fence after async adds. Sync
+    # tables are fenced by mv.barrier()'s dirty-shard walk; async-plane
+    # tables need an explicit flush of this process's outstanding ops.
+    # The barrier itself must run even if a flush raises (a swept
+    # fire-and-forget failure or dead peer): aborting early would leave
+    # the other ranks blocked in mv.barrier() forever — the C layer only
+    # prints-and-clears Python errors, it cannot unwind the peers.
+    errors = []
+    for t in list(_tables.values()):
+        flush = getattr(t, "flush", None)
+        if callable(flush):
+            try:
+                flush()
+            except Exception as exc:  # noqa: BLE001 — surfaced below
+                errors.append(exc)
     mv.barrier()
+    if errors:
+        raise errors[0]
 
 
 def num_workers() -> int:

@@ -18,26 +18,27 @@
 typedef void* TableHandler;
 
 /* the ABI under test (mirrors ref include/multiverso/c_api.h:16-54) */
+/* BEGIN generated ABI declarations (tools/gen_capi_surface.py) */
 void MV_Init(int* argc, char** argv);
 void MV_ShutDown(void);
 void MV_Barrier(void);
-int MV_NumWorkers(void);
-int MV_WorkerId(void);
-int MV_ServerId(void);
+int  MV_NumWorkers(void);
+int  MV_WorkerId(void);
+int  MV_ServerId(void);
 void MV_NewArrayTable(int size, TableHandler* out);
-void MV_GetArrayTable(TableHandler h, float* data, int size);
-void MV_AddArrayTable(TableHandler h, float* data, int size);
-void MV_AddAsyncArrayTable(TableHandler h, float* data, int size);
+void MV_GetArrayTable(TableHandler handler, float* data, int size);
+void MV_AddArrayTable(TableHandler handler, float* data, int size);
+void MV_AddAsyncArrayTable(TableHandler handler, float* data, int size);
+void MV_NewAsyncArrayTable(int size, TableHandler* out);
+void MV_NewAsyncMatrixTable(int num_row, int num_col, TableHandler* out);
 void MV_NewMatrixTable(int num_row, int num_col, TableHandler* out);
-void MV_GetMatrixTableAll(TableHandler h, float* data, int size);
-void MV_AddMatrixTableAll(TableHandler h, float* data, int size);
-void MV_AddAsyncMatrixTableAll(TableHandler h, float* data, int size);
-void MV_GetMatrixTableByRows(TableHandler h, float* data, int size,
-                             int row_ids[], int row_ids_n);
-void MV_AddMatrixTableByRows(TableHandler h, float* data, int size,
-                             int row_ids[], int row_ids_n);
-void MV_AddAsyncMatrixTableByRows(TableHandler h, float* data, int size,
-                                  int row_ids[], int row_ids_n);
+void MV_GetMatrixTableAll(TableHandler handler, float* data, int size);
+void MV_AddMatrixTableAll(TableHandler handler, float* data, int size);
+void MV_AddAsyncMatrixTableAll(TableHandler handler, float* data, int size);
+void MV_GetMatrixTableByRows(TableHandler handler, float* data, int size, int row_ids[], int row_ids_n);
+void MV_AddMatrixTableByRows(TableHandler handler, float* data, int size, int row_ids[], int row_ids_n);
+void MV_AddAsyncMatrixTableByRows(TableHandler handler, float* data, int size, int row_ids[], int row_ids_n);
+/* END generated ABI declarations */
 
 static int g_failures = 0;
 
@@ -102,6 +103,35 @@ int main(void) {
   float r0[C];
   MV_GetMatrixTableByRows(mt, r0, C, row0, 1);
   for (int i = 0; i < C; i++) expect_near(r0[i], 2.0f, "untouched row");
+
+  /* ---- async-PS-plane tables (beyond the reference C API): same
+   * accessor surface, uncoordinated ownership; MV_Barrier flushes this
+   * process's outstanding async ops before fencing. ---- */
+  TableHandler aat = NULL;
+  MV_NewAsyncArrayTable(N, &aat);
+  expect(aat != NULL, "MV_NewAsyncArrayTable handle");
+  MV_AddArrayTable(aat, delta, N);
+  MV_AddAsyncArrayTable(aat, delta, N);
+  MV_Barrier();
+  MV_GetArrayTable(aat, out, N);
+  for (int i = 0; i < N; i++)
+    expect_near(out[i], 2.0f * i, "async array sum");
+
+  TableHandler amt = NULL;
+  MV_NewAsyncMatrixTable(R, C, &amt);
+  expect(amt != NULL, "MV_NewAsyncMatrixTable handle");
+  MV_AddMatrixTableAll(amt, md, SZ);
+  MV_AddAsyncMatrixTableAll(amt, md, SZ);
+  MV_Barrier();
+  MV_GetMatrixTableAll(amt, mo, SZ);
+  for (int i = 0; i < SZ; i++)
+    expect_near(mo[i], 2.0f, "async matrix all sum");
+  MV_AddMatrixTableByRows(amt, rvals, 2 * C, rows, 2);
+  MV_AddAsyncMatrixTableByRows(amt, rvals, 2 * C, rows, 2);
+  MV_Barrier();
+  MV_GetMatrixTableByRows(amt, rout, 2 * C, rows, 2);
+  for (int i = 0; i < 2 * C; i++)
+    expect_near(rout[i], 3.0f, "async matrix row sum");
 
   MV_ShutDown();
   if (g_failures == 0) {

@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Regenerate the FFI surface mirrors of the C ABI from its one source of
+truth, native/mv_capi.cpp.
+
+Round 4 shipped with a red pin test because two new C-ABI entry points
+were added without extending the Lua cdef / C driver declarations by
+hand. This tool makes the mirrors *generated*: it parses the extern "C"
+definitions in mv_capi.cpp and rewrites
+
+  * the ``ffi.cdef[[...]]`` block in examples/lua/multiverso.lua, and
+  * the declaration block in native/mv_capi_test.c (between the
+    ``/* BEGIN/END generated ABI declarations */`` markers),
+
+so the surface cannot drift: ``--check`` (run by
+tests/test_lua_cdef.py::test_generated_mirrors_are_current) fails CI
+whenever a regeneration is pending, and the fix is mechanical:
+
+    python tools/gen_capi_surface.py
+
+(ref parallel: binding/lua/init.lua hand-copies c_api.h — the reference
+has exactly the drift hazard this removes.)
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_CAPI = os.path.join(_REPO, "multiverso_tpu", "native", "mv_capi.cpp")
+_LUA = os.path.join(_REPO, "examples", "lua", "multiverso.lua")
+_CTEST = os.path.join(_REPO, "multiverso_tpu", "native", "mv_capi_test.c")
+
+_BEGIN = "/* BEGIN generated ABI declarations (tools/gen_capi_surface.py) */"
+_END = "/* END generated ABI declarations */"
+
+
+def parse_capi(path: str = _CAPI):
+    """Yield (ret, name, [param, ...]) for every extern "C" MV_* definition,
+    in source order. Commented-out parameter names (``int* /*argc*/``) are
+    resurrected so the generated declarations stay self-documenting."""
+    src = open(path).read()
+    out = []
+    for m in re.finditer(
+            r"^(void|int|float|double)\s+(MV_\w+)\s*\(([^)]*)\)\s*\{",
+            src, re.MULTILINE | re.DOTALL):
+        ret, name, raw = m.group(1), m.group(2), m.group(3)
+        params = []
+        for p in raw.split(",") if raw.strip() else []:
+            p = re.sub(r"/\*\s*(\w+)\s*\*/", r"\1", p)  # /*argc*/ -> argc
+            params.append(" ".join(p.split()))
+        out.append((ret, name, params))
+    if not out:
+        raise SystemExit(f"no extern-C MV_* definitions found in {path}")
+    return out
+
+
+def _decl(ret, name, params, empty="") -> str:
+    args = ", ".join(params) if params else empty
+    pad = " " if ret == "void" else "  "  # align like the hand-written file
+    return f"{ret}{pad}{name}({args});"
+
+
+def lua_cdef_block(surface) -> str:
+    lines = ["typedef void* TableHandler;"]
+    lines += [_decl(*f) for f in surface]
+    return "\n" + "\n".join(lines) + "\n"
+
+
+def c_decl_block(surface) -> str:
+    # C (unlike C++) needs (void) to declare a no-arg prototype.
+    lines = [_decl(r, n, p, empty="void") for r, n, p in surface]
+    return "\n".join(lines)
+
+
+def render(path: str, surface) -> str:
+    src = open(path).read()
+    if path.endswith(".lua"):
+        return re.sub(r"(ffi\.cdef\[\[).*?(\]\])",
+                      lambda m: m.group(1) + lua_cdef_block(surface)
+                      + m.group(2),
+                      src, count=1, flags=re.DOTALL)
+    begin, end = src.index(_BEGIN), src.index(_END)
+    return (src[:begin + len(_BEGIN)] + "\n" + c_decl_block(surface)
+            + "\n" + src[end:])
+
+
+def main(argv) -> int:
+    check = "--check" in argv
+    surface = parse_capi()
+    stale = []
+    for path in (_LUA, _CTEST):
+        want = render(path, surface)
+        if open(path).read() != want:
+            if check:
+                stale.append(path)
+            else:
+                open(path, "w").write(want)
+                print(f"regenerated: {os.path.relpath(path, _REPO)}")
+    if stale:
+        print("stale generated ABI mirrors (run tools/gen_capi_surface.py):"
+              f" {[os.path.relpath(p, _REPO) for p in stale]}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
