@@ -51,6 +51,7 @@
 #include <cctype>
 #include <chrono>
 #include <condition_variable>
+#include <cstddef>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -910,16 +911,25 @@ void client_recv_loop(Client* c) {
   c->cv.notify_all();
 }
 
-bool client_send_frame(Client* c, int type, int64_t msg_id,
-                       const uint8_t* meta, int64_t metalen,
-                       const int64_t* ids, int64_t k, const uint8_t* vals,
-                       int64_t vnbytes, const char* vdtype,
-                       const int64_t* vshape, int vndim) {
-  std::vector<uint8_t> head;  // header + meta + ids blob (header+data) +
-                              // vals blob header
+// A fully-built request frame: iov entries point into the owned vectors
+// and the caller's ids/vals buffers, so a Frame must outlive its send.
+// Building is lock-free; only the send itself (and, for counted adds,
+// the msg_id patch + seq assignment) happens under wmu.
+struct Frame {
+  std::vector<uint8_t> head;       // header + meta (+ ids blob header)
+  std::vector<uint8_t> vals_head;
+  struct iovec iov[4];
+  int cnt = 0;
+};
+
+void client_build_frame(Frame* f, int type, int64_t msg_id,
+                        const uint8_t* meta, int64_t metalen,
+                        const int64_t* ids, int64_t k, const uint8_t* vals,
+                        int64_t vnbytes, const char* vdtype,
+                        const int64_t* vshape, int vndim) {
   uint32_t narr = 0;
   int64_t paylen = metalen;
-  std::vector<uint8_t> ids_head, vals_head;
+  std::vector<uint8_t> ids_head;
   if (ids) {
     int64_t shape[1] = {k};
     put_blob_header(&ids_head, "<i8", shape, 1);
@@ -927,35 +937,46 @@ bool client_send_frame(Client* c, int type, int64_t msg_id,
     ++narr;
   }
   if (vals) {
-    put_blob_header(&vals_head, vdtype, vshape, vndim);
-    paylen += static_cast<int64_t>(vals_head.size()) + vnbytes;
+    put_blob_header(&f->vals_head, vdtype, vshape, vndim);
+    paylen += static_cast<int64_t>(f->vals_head.size()) + vnbytes;
     ++narr;
   }
-  head.reserve(sizeof(WireHeader) + static_cast<size_t>(metalen) +
-               ids_head.size());
-  put_header(&head, type, msg_id, static_cast<uint32_t>(metalen), narr,
+  f->head.reserve(sizeof(WireHeader) + static_cast<size_t>(metalen) +
+                  ids_head.size());
+  put_header(&f->head, type, msg_id, static_cast<uint32_t>(metalen), narr,
              paylen);
-  head.insert(head.end(), meta, meta + metalen);
-  struct iovec iov[4];
-  int cnt = 0;
-  iov[cnt].iov_base = head.data();
-  iov[cnt++].iov_len = head.size();
+  f->head.insert(f->head.end(), meta, meta + metalen);
+  if (ids) f->head.insert(f->head.end(), ids_head.begin(), ids_head.end());
+  f->cnt = 0;
+  f->iov[f->cnt].iov_base = f->head.data();
+  f->iov[f->cnt++].iov_len = f->head.size();
   if (ids) {
-    head.insert(head.end(), ids_head.begin(), ids_head.end());
-    // careful: insert may reallocate; rebuild iov[0] afterwards
-    iov[0].iov_base = head.data();
-    iov[0].iov_len = head.size();
-    iov[cnt].iov_base = const_cast<int64_t*>(ids);
-    iov[cnt++].iov_len = static_cast<size_t>(8 * k);
+    f->iov[f->cnt].iov_base = const_cast<int64_t*>(ids);
+    f->iov[f->cnt++].iov_len = static_cast<size_t>(8 * k);
   }
   if (vals) {
-    iov[cnt].iov_base = vals_head.data();
-    iov[cnt++].iov_len = vals_head.size();
-    iov[cnt].iov_base = const_cast<uint8_t*>(vals);
-    iov[cnt++].iov_len = static_cast<size_t>(vnbytes);
+    f->iov[f->cnt].iov_base = f->vals_head.data();
+    f->iov[f->cnt++].iov_len = f->vals_head.size();
+    f->iov[f->cnt].iov_base = const_cast<uint8_t*>(vals);
+    f->iov[f->cnt++].iov_len = static_cast<size_t>(vnbytes);
   }
+}
+
+void frame_patch_msg_id(Frame* f, int64_t msg_id) {
+  memcpy(f->head.data() + offsetof(WireHeader, msg_id), &msg_id,
+         sizeof(msg_id));
+}
+
+bool client_send_frame(Client* c, int type, int64_t msg_id,
+                       const uint8_t* meta, int64_t metalen,
+                       const int64_t* ids, int64_t k, const uint8_t* vals,
+                       int64_t vnbytes, const char* vdtype,
+                       const int64_t* vshape, int vndim) {
+  Frame f;
+  client_build_frame(&f, type, msg_id, meta, metalen, ids, k, vals,
+                     vnbytes, vdtype, vshape, vndim);
   std::lock_guard<std::mutex> g(c->wmu);
-  return send_iov(c->fd, iov, cnt);
+  return send_iov(c->fd, f.iov, f.cnt);
 }
 
 void client_mark_dead(Client* c, const char* why) {
@@ -1155,18 +1176,31 @@ long long mvnet_add(void* conn, int msg_type, const void* meta,
                     const int64_t* vshape, int vndim,
                     long long* seq_out) {
   auto* c = static_cast<Client*>(conn);
+  Frame f;  // built lock-free; msg_id patched in under wmu below
+  client_build_frame(&f, msg_type, /*msg_id=*/0,
+                     static_cast<const uint8_t*>(meta), metalen, ids, k,
+                     static_cast<const uint8_t*>(vals), vnbytes, vdtype,
+                     vshape, vndim);
   int64_t msg_id, seq;
+  bool sent;
   {
-    std::unique_lock<std::mutex> lk(c->mu);
-    if (c->dead) return -1;
-    msg_id = c->next_id++;
-    seq = ++c->adds_issued;
-    c->pending_adds[msg_id] = seq;
+    // seq assignment and the wire write happen under ONE wmu hold: two
+    // threads adding concurrently must hit the wire in seq order, or a
+    // reply to the later seq would mark the earlier add's future done
+    // (adds_done is a plain counter) while its frame is still unsent —
+    // result() could then report success before the op's ERR arrives.
+    std::lock_guard<std::mutex> wg(c->wmu);
+    {
+      std::unique_lock<std::mutex> lk(c->mu);
+      if (c->dead) return -1;
+      msg_id = c->next_id++;
+      seq = ++c->adds_issued;
+      c->pending_adds[msg_id] = seq;
+    }
+    frame_patch_msg_id(&f, msg_id);
+    sent = send_iov(c->fd, f.iov, f.cnt);
   }
-  if (!client_send_frame(c, msg_type, msg_id,
-                         static_cast<const uint8_t*>(meta), metalen, ids, k,
-                         static_cast<const uint8_t*>(vals), vnbytes, vdtype,
-                         vshape, vndim)) {
+  if (!sent) {
     client_mark_dead(c, "send failed");
     return -1;
   }
@@ -1314,21 +1348,12 @@ int mvnet_add_fanout(void** conns, int world, int mod_owner,
       continue;
     }
     const int64_t cnt = static_cast<int64_t>(idx.size());
-    int64_t msg_id, seq;
-    {
-      std::unique_lock<std::mutex> lk(c->mu);
-      if (c->dead) {
-        out_mid[r] = -1;
-        continue;
-      }
-      msg_id = c->next_id++;
-      seq = ++c->adds_issued;
-      c->pending_adds[msg_id] = seq;
-    }
     owner_ids.resize(static_cast<size_t>(cnt));
     for (int64_t i = 0; i < cnt; ++i) owner_ids[i] = ids[idx[i]];
     // head buffer: header + meta + ids blob header; ids data; vals blob
-    // header; then one iovec entry per row of the original buffer
+    // header; then one iovec entry per row of the original buffer. The
+    // msg_id is patched in under wmu below — the frame body itself does
+    // not depend on it, so the build stays outside the lock.
     std::vector<uint8_t> head, vals_head;
     int64_t ids_shape[1] = {cnt};
     std::vector<uint8_t> ids_head;
@@ -1338,7 +1363,7 @@ int mvnet_add_fanout(void** conns, int world, int mod_owner,
     int64_t paylen = metalen + static_cast<int64_t>(ids_head.size()) +
                      8 * cnt + static_cast<int64_t>(vals_head.size()) +
                      cnt * rowbytes;
-    put_header(&head, MSG_ADD_ROWS, msg_id,
+    put_header(&head, MSG_ADD_ROWS, /*msg_id=*/0,
                static_cast<uint32_t>(metalen), 2, paylen);
     head.insert(head.end(), static_cast<const uint8_t*>(meta),
                 static_cast<const uint8_t*>(meta) + metalen);
@@ -1351,9 +1376,23 @@ int mvnet_add_fanout(void** conns, int world, int mod_owner,
     for (int64_t i = 0; i < cnt; ++i)
       iov.push_back({const_cast<uint8_t*>(vb + idx[i] * rowbytes),
                      static_cast<size_t>(rowbytes)});
+    int64_t msg_id, seq;
     bool ok;
     {
+      // same seq-order-equals-wire-order rule as mvnet_add
       std::lock_guard<std::mutex> g(c->wmu);
+      {
+        std::unique_lock<std::mutex> lk(c->mu);
+        if (c->dead) {
+          out_mid[r] = -1;
+          continue;
+        }
+        msg_id = c->next_id++;
+        seq = ++c->adds_issued;
+        c->pending_adds[msg_id] = seq;
+      }
+      memcpy(head.data() + offsetof(WireHeader, msg_id), &msg_id,
+             sizeof(msg_id));
       ok = send_iov(c->fd, iov.data(), static_cast<int>(iov.size()));
     }
     if (!ok) {

@@ -211,6 +211,31 @@ int main() {
   assert(pmid >= 0 && mvnet_get_wait(c1, pmid, 10.0) == -2);
   assert(g_punts.load() == 1);
 
+  // concurrent adds on ONE conn: seq assignment and the wire write share
+  // a wmu hold (mvnet_add), so replies arrive in seq order and the
+  // counted fence is exact — TSan sees the locking, the asserts see the
+  // accounting (4 threads x 8 adds, all acked, no errors recorded)
+  {
+    long long before = mvnet_adds_done(c1);
+    std::vector<std::thread> adders;
+    std::vector<long long> mids(4 * 8);
+    for (int t = 0; t < 4; ++t)
+      adders.emplace_back([&, t] {
+        for (int i = 0; i < 8; ++i) {
+          long long s = 0;
+          mids[t * 8 + i] = mvnet_add(c1, 0x11, meta, strlen(meta), ids,
+                                      4, vals, sizeof(vals), "<f4",
+                                      vshape, 2, &s);
+          assert(mids[t * 8 + i] >= 0 && s > 0);
+        }
+      });
+    for (auto& th : adders) th.join();
+    assert(mvnet_wait_adds(c1, mvnet_adds_issued(c1), 10.0) == 0);
+    assert(mvnet_adds_done(c1) == before + 4 * 8);
+    for (long long m : mids)
+      assert(mvnet_take_add_error(c1, m, ebuf, sizeof(ebuf)) == 0);
+  }
+
   // cancelled get: recv thread must never touch the buffer afterwards
   long long cmid = mvnet_get_send(c2, 0x15, meta, strlen(meta), nullptr, 0,
                                   full.data(),
@@ -222,7 +247,8 @@ int main() {
   mvps_shard_pin_unlock(pin);
   unsigned long long adds = 0, applies = 0;
   mvps_shard_pin_stats(pin, &adds, &applies);
-  assert(adds == 3 && applies == 3);  // 1 single + 2 fanout legs
+  // 1 single + 2 fanout legs + 32 hammer adds
+  assert(adds == 35 && applies == 35);
 
   // hard drop with an add outstanding: futures must observe dead
   long long dseq = 0;

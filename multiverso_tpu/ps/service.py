@@ -490,10 +490,19 @@ class PSService:
         try:
             msg_type, msg_id, meta, arrays = wire.parse_frame(frame)
         except wire.WireError as e:
-            # header was sane (C++ validated bounds) but the body is
-            # garbage: drop it — the python plane kills such connections,
-            # here the conn dies at the client's next real failure
-            log.debug("ps native punt: malformed frame dropped (%s)", e)
+            # Header was sane (C++ validated magic/bounds) but the body
+            # failed to parse. The python plane fails fast by killing the
+            # connection; silently dropping here would instead park the
+            # peer for the full ps_timeout. The header's msg_id is still
+            # trustworthy, so send an ERR reply the peer can raise on.
+            log.debug("ps native punt: malformed frame (%s)", e)
+            try:
+                reply = wire.encode(MSG_REPLY_ERR, wire.peek_msg_id(frame),
+                                    {"error": f"WireError: {e}"})
+                ps_native.send_raw(self._native_raw, conn_id, reply)
+            except Exception:
+                log.debug("ps native punt: ERR reply for malformed frame "
+                          "failed; dropping")
             return
         try:
             if msg_type == MSG_PING:       # native serves PING; belt only
@@ -786,6 +795,19 @@ class PSService:
         # freed memory
         if self._accept_thread.is_alive():
             self._accept_thread.join(timeout=10.0)
+        if self._accept_thread.is_alive():
+            # A wedged accept thread could still call serve_fd into the
+            # native server; freeing it now would be a use-after-free.
+            # Leak the native server instead (process is tearing down or
+            # the test harness will kill it) and log loudly.
+            log.error("ps service close: accept thread did not exit in "
+                      "10s; leaking native server instead of freeing it")
+            with self._native_lock:
+                self._native = None
+            # NOT clearing _native_cb: the leaked server's C++ threads
+            # still hold the ctypes trampoline — freeing it under them
+            # (by dropping the last reference) would be the same
+            # use-after-free this branch exists to avoid.
         # drop accepted connections too, so an in-process "killed" service
         # actually goes silent (a killed OS process gets this for free)
         with self._conns_lock:

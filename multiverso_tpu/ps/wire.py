@@ -194,9 +194,25 @@ def parse_frame(frame: bytes) -> Tuple[int, int, Dict, List[np.ndarray]]:
     return msg_type, msg_id, meta, arrays
 
 
+def peek_msg_id(frame: bytes) -> int:
+    """msg_id from a frame whose header is known-sane (the native
+    transport validates magic/bounds before punting) — lets a server
+    send a bound ERR reply even when the BODY fails to parse."""
+    if len(frame) < _HEADER.size:
+        raise WireError("short frame")
+    return _HEADER.unpack_from(frame)[3]
+
+
 def _parse_body(body, metalen: int, narr: int, paylen: int
                 ) -> Tuple[Dict, List[np.ndarray]]:
-    meta = json.loads(bytes(body[:metalen]) or b"{}")
+    try:
+        meta = json.loads(bytes(body[:metalen]) or b"{}")
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        # corrupt meta must surface as a WireError like every other
+        # malformed-body shape — callers key their fail-fast paths on it
+        # (the native plane's _punt replies ERR instead of parking the
+        # peer for the full ps_timeout)
+        raise WireError(f"malformed meta json: {e}") from None
     arrays: List[np.ndarray] = []
     off = metalen
     try:

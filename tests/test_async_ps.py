@@ -56,6 +56,19 @@ class TestWire:
             wire.recv(b)
         a.close(), b.close()
 
+    def test_corrupt_meta_json_is_wire_error(self):
+        """Garbage meta bytes must surface as WireError, not leak
+        json.JSONDecodeError — the native plane's punt path keys its
+        fail-fast ERR reply on WireError (review finding: corrupt JSON,
+        the likeliest malformed body, used to bypass it and park the
+        peer for the full ps_timeout)."""
+        bad_meta = b"{not json"
+        frame = wire._HEADER.pack(wire.MAGIC, 0x11, 0, 7, len(bad_meta),
+                                  0, len(bad_meta)) + bad_meta
+        with pytest.raises(wire.WireError, match="meta json"):
+            wire.parse_frame(frame)
+        assert wire.peek_msg_id(frame) == 7  # ERR reply stays bindable
+
     def test_bad_magic_raises(self):
         import socket
         a, b = socket.socketpair()
