@@ -260,7 +260,13 @@ def forward_with_aux(params: Dict[str, Any], tokens: jax.Array,
             capacity_factor=cfg.moe_capacity_factor,
             axis=cfg.moe_axis, top_k=cfg.moe_top_k)
 
-    if cfg.tp_axis is not None:
+    if cfg.tp_axis is not None or cfg.batch_axis is not None:
+        # Constrain activations whenever ANY mesh axis is in play — not
+        # just tp. Without the batch-axis pin, the scan-over-layers
+        # backward lets GSPMD invent hybrid layouts for the saved
+        # attention residuals and fall back to "involuntary full
+        # rematerialization" (replicate-then-reshard) on the dp/fsdp
+        # mesh — a silent cross-chip perf tax on every layer.
         from jax.sharding import PartitionSpec as P
 
         from multiverso_tpu.parallel import tp as tp_lib
