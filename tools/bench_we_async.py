@@ -1,0 +1,61 @@
+"""Bench worker: WordEmbedding PS-block training on the UNCOORDINATED
+async plane — the reference's actual product shape (ref
+distributed_wordembedding.cpp:147-252 block pipeline over N independent
+processes + server.cpp async applies).
+
+Same config/corpus as bench.bench_wordembedding_ps()'s 1M-token run
+(seed 12), so the recorded async loss is directly comparable to the sync
+plane's ``loss_1M``. Each rank trains blocks[rank::world] of the shared
+corpus against async tables owned across the plane.
+
+Invoked as: python tools/bench_we_async.py <rdv_dir> <world> <rank>
+            <n_tokens>
+Prints "RESULT <json>".
+"""
+
+import json
+import sys
+
+
+def main():
+    rdv_dir, world, rank, n_tokens = (sys.argv[1], int(sys.argv[2]),
+                                      int(sys.argv[3]), int(sys.argv[4]))
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import multiverso_tpu as mv
+    from multiverso_tpu.apps.word_embedding import (WEConfig, WordEmbedding,
+                                                    synthetic_corpus)
+    from multiverso_tpu.data.dictionary import Dictionary
+    from multiverso_tpu.utils import config
+    from multiverso_tpu.utils.filesync import file_barrier
+
+    config.set_flag("ps_rank", rank)
+    config.set_flag("ps_world", world)
+    config.set_flag("ps_rendezvous", rdv_dir)
+    config.set_flag("ps_timeout", 180.0)
+    mv.init()
+
+    cfg = WEConfig(size=128, min_count=5, batch_size=8192, negative=5,
+                   window=5, epoch=1, data_block_size=50_000,
+                   use_ps="1", async_ps="1", seed=12)
+    tokens = synthetic_corpus(n_tokens, vocab=5_000, seed=12)
+    dictionary = Dictionary.build(tokens, cfg.min_count)
+    we = WordEmbedding(cfg, dictionary)
+    ids = we.prepare_ids(tokens)
+    file_barrier(rdv_dir, world, rank, "tables", timeout=180)
+    we.train_ps_blocks(ids)               # warm: compile block programs
+    file_barrier(rdv_dir, world, rank, "warm", timeout=180)
+    stats = we.train_ps_blocks(ids)       # measured epoch
+    file_barrier(rdv_dir, world, rank, "trained", timeout=180)
+    mv.shutdown()
+    print("RESULT " + json.dumps({
+        "rank": rank,
+        "words_per_sec": round(stats["words_per_sec"], 1),
+        "seconds": round(stats["seconds"], 3),
+        "loss": stats["loss"],
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
