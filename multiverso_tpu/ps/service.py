@@ -542,12 +542,16 @@ class PSService:
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            if self._native is not None:
+            # single read: close()'s leak branch clears self._native while
+            # this thread may be between the check and the call — a second
+            # read here would hand serve_fd a null server
+            native = self._native
+            if native is not None:
                 from multiverso_tpu.ps import native as ps_native
                 # hand the fd to a C++ serving thread (detach: the C++
                 # side owns it now; close() reaches it via the native
                 # server, not self._conns)
-                ps_native.serve_fd(self._native, conn.detach())
+                ps_native.serve_fd(native, conn.detach())
                 continue
             with self._conns_lock:
                 self._conns.append(conn)

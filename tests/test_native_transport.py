@@ -182,6 +182,107 @@ class TestPuntPaths:
         np.testing.assert_allclose(t0.get(), want)
 
 
+    def test_state_roundtrip_under_load_through_restart(self, tmp_path):
+        """VERDICT r4 item 8: GET_STATE/SET_STATE ride the C++->Python
+        punt path (mv_ps.cpp serves only hot ops). A checkpoint taken
+        while counted adds stream on the same connections must succeed
+        (per-conn FIFO keeps the punts ordered among the adds), and a
+        killed-and-restarted owner must get its updater accumulators
+        back through the SET_STATE punt — state equality, not just row
+        ops (ref: the abandoned MV_LoadTable plan, Test/main.cpp:302-316,
+        that this framework claims to have made real)."""
+        import io
+        import threading
+        import time
+
+        import jax
+
+        config.set_flag("ps_timeout", 20.0)
+        config.set_flag("ps_connect_timeout", 5.0)
+        config.set_flag("ps_reconnect_backoff", 0.3)
+        rdv = FileRendezvous(str(tmp_path / "rdv_state"))
+        ctxs = [PSContext(r, 2, PSService(r, 2, rdv)) for r in range(2)]
+        new_ctx1 = None
+        try:
+            t0 = AsyncMatrixTable(10, 4, name="st", ctx=ctxs[0],
+                                  updater="adagrad")
+            AsyncMatrixTable(10, 4, name="st", ctx=ctxs[1],
+                             updater="adagrad")
+            # rows 5-9 (rank 1's shard) get deterministic traffic, then
+            # quiesce — the snapshot content under test
+            t0.add_rows(np.arange(5, 10), np.ones((5, 4), np.float32))
+            t0.flush()
+            want_rows = t0.get_rows(np.arange(5, 10)).copy()
+
+            # hammer rank 0's rows from 2 threads WHILE store() punts
+            # GET_STATE through the same native conns
+            stop = threading.Event()
+
+            def hammer():
+                while not stop.is_set():
+                    t0.add_rows_async(np.arange(5),
+                                      np.ones((5, 4), np.float32))
+
+            threads = [threading.Thread(target=hammer) for _ in range(2)]
+            for th in threads:
+                th.start()
+            try:
+                buf = io.BytesIO()
+                t0.store(buf)
+            finally:
+                stop.set()
+                for th in threads:
+                    th.join()
+            t0.flush()
+
+            # diverge rank 1's shard after the snapshot; the restore must
+            # wipe this
+            t0.add_rows(np.arange(5, 10),
+                        np.full((5, 4), 7.0, np.float32))
+            t0.flush()
+
+            # rank 1 dies and restarts as a NEW incarnation on the same
+            # rendezvous (new port); survivors re-resolve with backoff
+            ctxs[1].close()
+            new_ctx1 = PSContext(1, 2, PSService(1, 2, rdv))
+            t1b = AsyncMatrixTable(10, 4, name="st", ctx=new_ctx1,
+                                   updater="adagrad")
+            deadline = time.monotonic() + 60
+            while True:
+                try:
+                    t0.load(io.BytesIO(buf.getvalue()))
+                    break
+                except Exception:
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.3)
+
+            # row data equals the snapshot (divergence wiped)...
+            np.testing.assert_allclose(t0.get_rows(np.arange(5, 10)),
+                                       want_rows)
+            # ...and the restarted owner's adagrad accumulators equal the
+            # checkpointed ones bit-for-bit (SET_STATE round-trip)
+            stream = io.BytesIO(buf.getvalue())
+            np.load(stream)                      # data
+            np.load(stream)                      # state marker header
+            saved_states = []
+            for _ in range(2):
+                n = int(np.load(stream)[0])
+                saved_states.append([np.load(stream) for _ in range(n)])
+            live = [np.asarray(x)
+                    for x in jax.tree.leaves(t1b._shard._ustate)]
+            assert len(live) == len(saved_states[1]) > 0
+            for a, b in zip(saved_states[1], live):
+                np.testing.assert_array_equal(a, b)
+            # the plane stays usable after the whole dance
+            t0.add_rows([7], np.ones((1, 4), np.float32))
+            t0.flush()
+        finally:
+            ctxs[0].close()
+            if new_ctx1 is not None:
+                new_ctx1.close()
+
+
 class TestNativeClientErrors:
     def test_out_of_shard_get_errors_cleanly(self, two_ranks):
         """A C++-served error reply must surface as NativeConnError with
