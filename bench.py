@@ -811,7 +811,7 @@ def main() -> None:
             lm_large_stats = bench_transformer(steps=24, b=2, s=1024,
                                                dim=2048, layers=8,
                                                vocab=32768, heads=16,
-                                               repeats=4)
+                                               repeats=6)
         except Exception as e:
             lm_large_stats = {"error": f"{type(e).__name__}: {e}"[:200]}
     else:

@@ -418,6 +418,18 @@ class WordEmbedding:
         blocks = [ids[lo: lo + cfg.data_block_size]
                   for lo in range(0, ids.size, cfg.data_block_size)]
         blocks = [b for b in blocks if b.size >= 2]
+        # Delta scaling is ALWAYS 1/nw on the multi-worker planes
+        # (ref communicator.cpp:154). Note the convergence consequence,
+        # measured at np4/1M tokens: with each worker sweeping the FULL
+        # corpus (reference layout; set -data_presplit 1 and feed every
+        # rank all the data), N sweeps x 1/N deltas net one epoch's
+        # learning and the loss tracks the sync plane; with the
+        # partitioned split below, each token contributes only 1/N of a
+        # gradient per epoch (undertrains, loss 2.55 vs sync 0.70), and
+        # dropping the divide instead makes zipf-hot rows absorb ~N
+        # concurrent full-alpha pushes (diverges, loss 5.5). Partitioned
+        # mode is the throughput/liveness fixture; reference-comparable
+        # CONVERGENCE numbers come from the full-sweep layout.
         if nw > 1 and cfg.async_ps and not self._data_presplit:
             # data split evenly per worker (ref BENCHMARK.md common
             # settings). ONLY on the uncoordinated plane: sync-table

@@ -36,9 +36,14 @@ def main():
     config.set_flag("ps_timeout", 180.0)
     mv.init()
 
+    # data_presplit=1 + every rank fed the FULL corpus = the reference's
+    # layout (each process sweeps all blocks, deltas divided by N,
+    # communicator.cpp:154 / distributed_wordembedding.cpp block loop):
+    # N sweeps x 1/N deltas net one epoch's learning, so the loss is
+    # comparable to the sync plane's at the same epoch count.
     cfg = WEConfig(size=128, min_count=5, batch_size=8192, negative=5,
                    window=5, epoch=1, data_block_size=50_000,
-                   use_ps="1", async_ps="1", seed=12)
+                   use_ps="1", async_ps="1", data_presplit="1", seed=12)
     tokens = synthetic_corpus(n_tokens, vocab=5_000, seed=12)
     dictionary = Dictionary.build(tokens, cfg.min_count)
     we = WordEmbedding(cfg, dictionary)
