@@ -182,6 +182,34 @@ class TestPuntPaths:
         np.testing.assert_allclose(t0.get(), want)
 
 
+    def test_malformed_punted_body_gets_fast_err_reply(self, two_ranks):
+        """A frame whose header is sane but whose body fails to parse is
+        punted by C++ and must come back as a FAST error reply bound to
+        the header's msg_id — the python plane kills such connections
+        immediately; silently dropping here would park the peer for the
+        full ps_timeout (advisor r4 finding, ps/service.py _punt)."""
+        import socket
+        import time
+
+        from multiverso_tpu.ps import wire
+
+        host, port = two_ranks[0].service.addr.rsplit(":", 1)
+        s = socket.create_connection((host, int(port)), timeout=10)
+        try:
+            bad = b"{definitely not json"
+            frame = wire._HEADER.pack(wire.MAGIC, 0x7F, 0, 42, len(bad),
+                                      0, len(bad)) + bad
+            t0 = time.monotonic()
+            s.sendall(frame)
+            msg_type, msg_id, meta, _ = wire.recv(s)
+            took = time.monotonic() - t0
+            assert msg_type == svc.MSG_REPLY_ERR
+            assert msg_id == 42
+            assert "WireError" in meta.get("error", "")
+            assert took < 5.0, f"ERR reply took {took:.1f}s"
+        finally:
+            s.close()
+
     def test_state_roundtrip_under_load_through_restart(self, tmp_path):
         """VERDICT r4 item 8: GET_STATE/SET_STATE ride the C++->Python
         punt path (mv_ps.cpp serves only hot ops). A checkpoint taken
