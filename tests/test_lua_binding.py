@@ -46,7 +46,9 @@ class _CLib:
         lib.MV_GetArrayTable.argtypes = [ctypes.c_void_p, fp, ctypes.c_int]
         lib.MV_AddArrayTable.argtypes = lib.MV_GetArrayTable.argtypes
         lib.MV_AddAsyncArrayTable.argtypes = lib.MV_GetArrayTable.argtypes
+        lib.MV_NewAsyncArrayTable.argtypes = [ctypes.c_int, hp]
         lib.MV_NewMatrixTable.argtypes = [ctypes.c_int, ctypes.c_int, hp]
+        lib.MV_NewAsyncMatrixTable.argtypes = lib.MV_NewMatrixTable.argtypes
         lib.MV_GetMatrixTableAll.argtypes = [ctypes.c_void_p, fp,
                                              ctypes.c_int]
         lib.MV_AddMatrixTableAll.argtypes = lib.MV_GetMatrixTableAll.argtypes
@@ -114,6 +116,34 @@ def test_lua_array_table_roundtrip():
     t["get"](t, out)
     np.testing.assert_allclose(list(out),
                                2.0 * np.arange(1, size + 1))
+
+
+def test_lua_async_tables_same_accessor_surface():
+    """The uncoordinated-plane constructors (beyond the reference C API)
+    return handles the ordinary accessors drive unchanged; MV_Barrier
+    flushes the async ops so the Lua-side fence semantics match
+    test.lua's barrier-then-get pattern."""
+    rt, M = _load_binding()
+    M["init"]()
+    size = 32
+    t = M["new_async_array_table"](size)
+    delta = _farray(*range(1, size + 1))
+    t["add"](t, delta)
+    t["add_async"](t, delta)
+    M["barrier"]()
+    out = (ctypes.c_float * size)()
+    t["get"](t, out)
+    np.testing.assert_allclose(list(out), 2.0 * np.arange(1, size + 1))
+
+    num_row, num_col = 6, 4
+    m = M["new_async_matrix_table"](num_row, num_col)
+    full = _farray(*([1.0] * (num_row * num_col)))
+    m["add"](m, full)
+    m["add_async"](m, full)
+    M["barrier"]()
+    mo = (ctypes.c_float * (num_row * num_col))()
+    m["get"](m, mo)
+    np.testing.assert_allclose(list(mo), 2.0)
 
 
 def test_lua_matrix_table_full_and_rows():
