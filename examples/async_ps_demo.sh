@@ -18,7 +18,10 @@ RDV=$(mktemp -d)
 PIDS=""
 # kill stragglers before deleting their rendezvous dir (a crashed rank
 # must not leave the others polling a vanished directory)
-trap 'kill $PIDS 2>/dev/null; rm -rf "$RDV"' EXIT
+# `|| true`: set -e applies INSIDE the trap (dash), so a clean run —
+# where every pid already exited and kill fails — would otherwise abort
+# the trap mid-way (rc 1, rendezvous dir leaked)
+trap 'kill $PIDS 2>/dev/null || true; rm -rf "$RDV"' EXIT
 for RANK in 0 1 2 3; do
   python tests/we_async_worker.py "$RDV" 4 "$RANK" &
   PIDS="$PIDS $!"

@@ -9,7 +9,9 @@ cd "$(dirname "$0")/.."
 RDV=$(mktemp -d)
 WORK=$(mktemp -d)
 PIDS=""
-trap 'kill $PIDS 2>/dev/null; rm -rf "$RDV" "$WORK"' EXIT
+# `|| true`: set -e applies INSIDE the trap (dash); on a clean run kill
+# fails (pids gone) and would abort the trap (rc 1, dirs leaked)
+trap 'kill $PIDS 2>/dev/null || true; rm -rf "$RDV" "$WORK"' EXIT
 
 python - "$WORK" <<'PY'
 import sys
