@@ -1,0 +1,96 @@
+-- XOR MLP demo over the multiverso C ABI — the reference's Lua demo
+-- (ref binding/lua/demos/xor/xor-multiverso.lua: a data-parallel Torch
+-- MLP whose parameters live in an ArrayTable) rebuilt in PLAIN Lua so it
+-- needs no Torch: a 2-4-1 sigmoid MLP, parameters synced through the
+-- table with the delta-push convention (worker computes new weights
+-- locally, pushes new-old, pulls the merged state — the same pattern as
+-- the Python sharedvar binding, ref theano_ext/sharedvar.py:38-50).
+--
+-- Runs under LuaJIT (ffi) or under lupa via the tests' ffi bridge:
+--   tests/test_lua_binding.py::test_lua_xor_demo_converges
+-- Returns the final mean-squared error (must fall well under 0.05).
+
+local ffi = require('ffi')
+local mv = require('multiverso')
+
+local function new_buf(n)
+  return ffi.new('float[?]', n)
+end
+
+-- 2-4-1 MLP: W1[4][2], b1[4], W2[4], b2  => 17 params
+local NP = 17
+local X = { {0, 0}, {0, 1}, {1, 0}, {1, 1} }
+local Y = { 0, 1, 1, 0 }
+
+local function sigmoid(z)
+  return 1.0 / (1.0 + math.exp(-z))
+end
+
+-- forward + backward on the full XOR batch; returns (loss, grad[17])
+local function grad_step(p)
+  local g = {}
+  for i = 1, NP do g[i] = 0.0 end
+  local loss = 0.0
+  for s = 1, 4 do
+    local x1, x2, y = X[s][1], X[s][2], Y[s]
+    local h, zh = {}, {}
+    for j = 0, 3 do
+      zh[j] = p[j * 2 + 1] * x1 + p[j * 2 + 2] * x2 + p[8 + j + 1]
+      h[j] = sigmoid(zh[j])
+    end
+    local zo = p[17]
+    for j = 0, 3 do zo = zo + p[12 + j + 1] * h[j] end
+    local o = sigmoid(zo)
+    local err = o - y
+    loss = loss + 0.5 * err * err
+    local do_ = err * o * (1 - o)
+    for j = 0, 3 do
+      g[12 + j + 1] = g[12 + j + 1] + do_ * h[j]
+      local dh = do_ * p[12 + j + 1] * h[j] * (1 - h[j])
+      g[j * 2 + 1] = g[j * 2 + 1] + dh * x1
+      g[j * 2 + 2] = g[j * 2 + 2] + dh * x2
+      g[8 + j + 1] = g[8 + j + 1] + dh
+    end
+    g[17] = g[17] + do_
+  end
+  return loss / 4, g
+end
+
+local function run(iters, lr)
+  iters = iters or 3000
+  lr = lr or 2.0
+  mv.init()
+  local t = mv.new_array_table(NP)
+
+  -- master-init convention (ref tables.py:50-57): worker 0 seeds the
+  -- table with the initial weights, everyone else contributes zeros.
+  -- Fixed asymmetric values, NOT math.random: XOR has local minima and
+  -- Lua RNG streams differ across interpreters — the demo must converge
+  -- deterministically everywhere it runs.
+  local seed_w = { 0.5, -0.4, -0.6, 0.3, 0.7, 0.2, -0.3, -0.8,
+                   0.1, -0.2, 0.3, -0.1, 0.6, -0.7, 0.5, -0.4, 0.05 }
+  local init = new_buf(NP)
+  if mv.worker_id() == 0 then
+    for i = 0, NP - 1 do init[i] = seed_w[i + 1] end
+  end
+  t:add(init)
+  mv.barrier()
+
+  local cur = t:get()
+  local p = {}
+  local last_loss = 1e9
+  for it = 1, iters do
+    for i = 1, NP do p[i] = cur[i - 1] end
+    local loss, g = grad_step(p)
+    last_loss = loss
+    -- local step, then push (new - old) = -lr*grad as the delta
+    local delta = new_buf(NP)
+    for i = 1, NP do delta[i - 1] = -lr * g[i] end
+    t:add(delta)
+    cur = t:get(cur)
+  end
+  mv.shutdown()
+  return last_loss
+end
+
+return { run = run }

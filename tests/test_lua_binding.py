@@ -94,7 +94,12 @@ def _load_binding():
     rt.globals()["__py_ffi"] = shim
     rt.execute("package.preload['ffi'] = function() return __py_ffi end")
     src = open(_LUA).read()
-    return rt, rt.execute("return (function()\n" + src + "\nend)()")
+    module = rt.execute("return (function()\n" + src + "\nend)()")
+    # let demo scripts require('multiverso') and find the shim
+    rt.globals()["__py_mv"] = module
+    rt.execute("package.preload['multiverso'] = function()"
+               " return __py_mv end")
+    return rt, module
 
 
 def _farray(*vals):
@@ -144,6 +149,19 @@ def test_lua_async_tables_same_accessor_surface():
     mo = (ctypes.c_float * (num_row * num_col))()
     m["get"](m, mo)
     np.testing.assert_allclose(list(mo), 2.0)
+
+
+def test_lua_xor_demo_converges():
+    """The reference's Lua demo tier (ref binding/lua/demos/xor/
+    xor-multiverso.lua — an MLP whose params live in an ArrayTable):
+    the plain-Lua port must train XOR to low error through the real
+    shim + C ABI, delta-push convention included."""
+    rt, _ = _load_binding()
+    src = open(os.path.join(_REPO, "examples", "lua",
+                            "xor_demo.lua")).read()
+    demo = rt.execute("return (function()\n" + src + "\nend)()")
+    final_loss = float(demo["run"](3000, 2.0))
+    assert final_loss < 0.05, final_loss
 
 
 def test_lua_matrix_table_full_and_rows():
