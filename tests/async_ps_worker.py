@@ -133,7 +133,12 @@ def main():
         # its dead backup_worker_ratio flag, src/server.cpp:21)
         from multiverso_tpu import elastic
         restarted = os.environ.get("MV_RESTARTED") == "1"
-        victim = world - 1
+        # the victim is parametrizable (MV_VICTIM): recovery must not be
+        # special-cased to the last rank — rank 0 dying exercises the
+        # same machinery from the other end of the id space
+        victim = int(os.environ.get("MV_VICTIM", world - 1))
+        survivors = [r for r in range(world) if r != victim]
+        saver = survivors[0]          # checkpoint writer (was rank 0)
         num_row = 4 * world
         ck = os.path.join(rdv_dir, "recover.ck")
         hb_dir = os.path.join(rdv_dir, "heartbeats")
@@ -148,7 +153,7 @@ def main():
             deadline = time.monotonic() + 90
             while time.monotonic() < deadline and not all(
                     os.path.exists(os.path.join(rdv_dir, f"done.{r}"))
-                    for r in range(world - 1)):
+                    for r in survivors):
                 time.sleep(0.05)
             out["restarted"] = True
         else:
@@ -156,7 +161,7 @@ def main():
             t.add_rows(np.arange(num_row), np.ones((num_row, 2), np.float32))
             t.flush()
             _sync_point(rdv_dir, world, rank, "pushed")
-            if rank == 0:
+            if rank == saver:
                 with open(ck, "wb") as f:
                     t.store(f)
                 open(os.path.join(rdv_dir, "saved"), "w").close()
@@ -210,8 +215,11 @@ def main():
             out["tombstone_cleared"] = True
             # survivors-only barrier: every survivor must OBSERVE the
             # restored checkpoint value before anyone's step-5 add bumps
-            # it past world (a fast peer used to race slower pollers)
-            _sync_point(rdv_dir, world - 1, rank, "recovered")
+            # it past world (a fast peer used to race slower pollers).
+            # Participant ids are the rank's index in the survivor list,
+            # so the barrier works for ANY victim, not just the last.
+            _sync_point(rdv_dir, len(survivors), survivors.index(rank),
+                        "recovered")
             # 5) training continues against the recovered shard
             t.add_rows([vrow], np.ones((1, 2), np.float32))
             t.flush()

@@ -90,17 +90,22 @@ def test_uncoordinated_sparse_ftrl_lr(tmp_path, nprocs):
         assert r["acc"] > 0.85
 
 
-def test_kill_and_restart_recovers_shard(tmp_path):
+@pytest.mark.parametrize("victim_pick", ["last", "zero"])
+def test_kill_and_restart_recovers_shard(tmp_path, victim_pick):
     """Full elastic recovery loop (VERDICT r2 item 5): a rank dies, PS
     socket-death tombstones it in elastic's failed set, the parent
     restarts it, the new incarnation republishes via rendezvous and
     reloads ITS shard from the checkpoint (load_local — peers' newer
-    state untouched), survivors re-resolve and training resumes."""
+    state untouched), survivors re-resolve and training resumes.
+    Parametrized over the victim: rank 0 dying must recover through the
+    SAME machinery as the last rank (no id-space special cases)."""
     nprocs = 3
+    victim = 0 if victim_pick == "zero" else nprocs - 1
     rdv = str(tmp_path / "rdv")
     os.makedirs(rdv, exist_ok=True)
     env = dict(os.environ)
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["MV_VICTIM"] = str(victim)
 
     def launch(pid, restarted=False):
         e = dict(env)
@@ -113,7 +118,7 @@ def test_kill_and_restart_recovers_shard(tmp_path):
             text=True)
 
     procs = [launch(pid) for pid in range(nprocs)]
-    victim = nprocs - 1
+    survivors = [r for r in range(nprocs) if r != victim]
     try:
         assert procs[victim].wait(timeout=120) == 17
         # restart only after every survivor observed the death (their
@@ -123,7 +128,7 @@ def test_kill_and_restart_recovers_shard(tmp_path):
         # it (one-off flake at 120 s in a full-tier run)
         deadline = time.monotonic() + 240
         while not all(os.path.exists(os.path.join(rdv, f"down.{r}"))
-                      for r in range(nprocs - 1)):
+                      for r in survivors):
             assert time.monotonic() < deadline, "survivors never tombstoned"
             time.sleep(0.1)
         procs[victim] = launch(victim, restarted=True)
@@ -140,7 +145,7 @@ def test_kill_and_restart_recovers_shard(tmp_path):
                 p.kill()
                 p.wait()
     assert results[victim]["restarted"] is True
-    for r in range(nprocs - 1):
+    for r in survivors:
         assert results[r]["tombstoned"] is True
         assert results[r]["recovered_value"] == float(nprocs)
         assert results[r]["tombstone_cleared"] is True
