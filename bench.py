@@ -609,21 +609,25 @@ def bench_transformer(steps: int = 40, b: int = 8, s: int = 512,
         last["loss"] = float(loss)  # host readback = reliable sync
         return time.perf_counter() - t0
 
+    # fwd+bwd FLOPs ~ 6 * params * tokens (dense matmul count), the
+    # standard LM accounting; reported so MFU vs the chip's peak is one
+    # division away, and used for the plausibility floor below
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree.leaves(params))
+    flops_per_step = 6.0 * n_params * b * s
     samples = [_differential(run, max(steps // 4, 1), steps)
                for _ in range(max(repeats, 1))]
     # best slope = least-congested sample; a congestion spike landing on
-    # an n_lo run can push a sample's slope to ~0/negative, and min()
-    # would record that physically-impossible peak — drop them (fall back
-    # to the median only if every sample is corrupt)
-    valid = [s for s in samples if s[0] > 0]
+    # an n_lo run can push a sample's slope to ~0, negative, OR merely
+    # implausibly small — min() would record a physically impossible
+    # peak. Keep only samples whose implied rate is under a generous
+    # chip-peak ceiling (250 TFLOP/s >> the ~197 bf16 peak); fall back
+    # to the median sample only if every one is corrupt.
+    floor_s = flops_per_step / 250e12
+    valid = [x for x in samples if x[0] > floor_s]
     step_s, intercept = (min(valid) if valid
                          else sorted(samples)[len(samples) // 2])
-    # fwd+bwd FLOPs ~ 6 * params * tokens (dense matmul count), the
-    # standard LM accounting; reported so MFU vs the chip's peak is one
-    # division away
-    n_params = sum(int(np.prod(p.shape))
-                   for p in jax.tree.leaves(params))
-    tflops = 6.0 * n_params * b * s / step_s / 1e12
+    tflops = flops_per_step / step_s / 1e12
     out = {"lm_tokens_per_sec": b * s / step_s,
            "lm_step_ms": step_s * 1e3,
            "lm_tflops_per_sec": tflops,
@@ -632,8 +636,7 @@ def bench_transformer(steps: int = 40, b: int = 8, s: int = 512,
     if repeats > 1:
         out["best_of"] = repeats
         out["all_tflops"] = [
-            round(6.0 * n_params * b * s / ss / 1e12, 2) if ss > 0
-            else None
+            round(flops_per_step / ss / 1e12, 2) if ss > 0 else None
             for ss, _ in samples]
     return out
 

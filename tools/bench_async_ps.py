@@ -129,7 +129,7 @@ def main():
         # native plane every owner (incl. self) is a real loopback-TCP
         # message; the python plane short-circuits the local owner
         # in-process, so it gets world-1.
-        "msgs_per_sec": (ops / dt if pattern == "local" else
+        "msgs_per_sec": (ops / dt if pattern in ("local", "paced") else
                          ops * (world if native_plane else world - 1) / dt),
         "mb_per_sec": ops * batch * dim * 4 / dt / 1e6,
         "get_p50_ms": float(np.percentile(get_lat, 50) * 1e3),
