@@ -32,6 +32,7 @@ from __future__ import annotations
 import json
 import os
 import time
+from typing import Optional
 
 import numpy as np
 
@@ -569,7 +570,8 @@ def bench_array_table(size: int = 1_000_000, iters: int = 10):
 
 def bench_transformer(steps: int = 40, b: int = 8, s: int = 512,
                       dim: int = 256, layers: int = 4, vocab: int = 8192,
-                      heads: int = 8, repeats: int = 1):
+                      heads: int = 8, repeats: int = 1,
+                      attn: Optional[str] = None):
     """LM train-step throughput (tokens/sec) with the fused flash-attention
     kernel on TPU (reference_attention elsewhere — interpret-mode Pallas
     would measure the interpreter, not the chip). ``repeats`` re-runs the
@@ -585,7 +587,7 @@ def bench_transformer(steps: int = 40, b: int = 8, s: int = 512,
     on_tpu = jax.devices()[0].platform == "tpu"
     cfg = tfm.TransformerConfig(
         vocab_size=vocab, dim=dim, num_heads=heads, num_layers=layers,
-        max_seq=s, attn="flash" if on_tpu else "local",
+        max_seq=s, attn=attn or ("flash" if on_tpu else "local"),
         dtype=jnp.bfloat16 if on_tpu else jnp.float32)
     params = tfm.init_params(cfg, seed=0)
     rng = np.random.default_rng(0)
@@ -817,9 +819,27 @@ def main() -> None:
                                                repeats=6)
         except Exception as e:
             lm_large_stats = {"error": f"{type(e).__name__}: {e}"[:200]}
+        try:
+            # A/B: the same 472M step with XLA-native attention instead
+            # of the Pallas flash kernel — the recorded evidence of what
+            # the kernel buys end-to-end (r5 probes: ~46 vs ~61 ms/step)
+            xla_attn = bench_transformer(steps=24, b=2, s=1024, dim=2048,
+                                         layers=8, vocab=32768, heads=16,
+                                         repeats=2, attn="local")
+            lm_attn_ab = {
+                "xla_native_attn_step_ms": xla_attn["lm_step_ms"],
+                "flash_step_ms": lm_large_stats.get("lm_step_ms"),
+                "flash_speedup": round(
+                    xla_attn["lm_step_ms"]
+                    / lm_large_stats["lm_step_ms"], 3)
+                if lm_large_stats.get("lm_step_ms") else None,
+            }
+        except Exception as e:
+            lm_attn_ab = {"error": f"{type(e).__name__}: {e}"[:200]}
     else:
         lm_large_stats = {"skipped": "TPU-only config (472M params in f32 "
                                      "would take minutes/OOM on CPU)"}
+        lm_attn_ab = {"skipped": "TPU-only"}
     try:
         resnet_stats = bench_resnet()
     except Exception as e:
@@ -868,6 +888,7 @@ def main() -> None:
         "array_table_cpu_nontunnel": array_cpu_stats,
         "transformer_lm_bs8_seq512_d256_L4": lm_stats,
         "transformer_lm_472M_bs2_seq1024_d2048_L8": lm_large_stats,
+        "transformer_lm_472M_attn_ab": lm_attn_ab,
         "resnet32_cifar_50k": resnet_stats,
         "matrix_sparse_row_add": rows_stats,
         "lm_decode_b8_d256_L4": decode_stats,
