@@ -1,0 +1,107 @@
+"""Randomized differential test for the UNCOORDINATED plane: a long
+random op sequence through two live PSContexts (riding the native C++
+transport where built) must match a plain numpy model exactly — the
+async twin of tests/test_table_fuzz.py, catching row-partitioning,
+dedupe-in-batch, FIFO-per-owner, and reply-scatter edge cases that the
+scripted tests don't reach.
+
+Ordering contract exercised: all ops issue from ONE thread, and every
+owner's traffic (including the self shard — a real loopback conn on the
+native plane) is per-connection FIFO, so a get issued after an async
+add must observe it.
+"""
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.ps.service import FileRendezvous, PSContext, PSService
+from multiverso_tpu.ps.tables import (AsyncArrayTable, AsyncKVTable,
+                                      AsyncMatrixTable)
+
+
+@pytest.fixture
+def two_ranks(tmp_path):
+    rdv = FileRendezvous(str(tmp_path / "rdv"))
+    ctxs = [PSContext(r, 2, PSService(r, 2, rdv)) for r in range(2)]
+    yield ctxs
+    for c in ctxs:
+        c.close()
+
+
+def test_async_matrix_matches_numpy_model(two_ranks):
+    rng = np.random.default_rng(7)
+    rows, cols = 37, 5            # awkward split: ceil(37/2)=19 vs 18
+    t = AsyncMatrixTable(rows, cols, name="fz_m", ctx=two_ranks[0])
+    AsyncMatrixTable(rows, cols, name="fz_m", ctx=two_ranks[1])
+    model = np.zeros((rows, cols), np.float32)
+    pending = []
+    for step in range(120):
+        op = rng.choice(["add_rows", "add_rows_async", "get_rows",
+                         "add_full", "get_full", "flush"])
+        if op in ("add_rows", "add_rows_async"):
+            k = int(rng.integers(1, 12))
+            ids = rng.integers(0, rows, k)      # duplicates welcome
+            vals = rng.normal(size=(k, cols)).astype(np.float32)
+            if op == "add_rows":
+                t.add_rows(ids, vals)
+            else:
+                pending.append(t.add_rows_async(ids, vals))
+            np.add.at(model, ids, vals)
+        elif op == "add_full":
+            d = rng.normal(size=(rows, cols)).astype(np.float32)
+            t.add(d)
+            model += d
+        elif op == "get_rows":
+            k = int(rng.integers(1, 10))
+            ids = np.unique(rng.integers(0, rows, k))
+            np.testing.assert_allclose(t.get_rows(ids), model[ids],
+                                       rtol=2e-5, atol=2e-4)
+        elif op == "get_full":
+            np.testing.assert_allclose(t.get(), model, rtol=2e-5,
+                                       atol=2e-4)
+        else:
+            t.flush()
+            pending.clear()
+    t.flush()
+    np.testing.assert_allclose(t.get(), model, rtol=2e-5, atol=2e-4)
+
+
+def test_async_array_matches_numpy_model(two_ranks):
+    rng = np.random.default_rng(11)
+    size = 101
+    t = AsyncArrayTable(size, name="fz_a", ctx=two_ranks[0])
+    AsyncArrayTable(size, name="fz_a", ctx=two_ranks[1])
+    model = np.zeros(size, np.float32)
+    for step in range(80):
+        op = rng.choice(["add", "add_async", "get"])
+        if op in ("add", "add_async"):
+            d = rng.normal(size=size).astype(np.float32)
+            (t.add if op == "add" else t.add_async)(d)
+            model += d
+        else:
+            np.testing.assert_allclose(t.get(), model, rtol=2e-5,
+                                       atol=2e-4)
+    t.flush()
+    np.testing.assert_allclose(t.get(), model, rtol=2e-5, atol=2e-4)
+
+
+def test_async_kv_matches_dict_model(two_ranks):
+    rng = np.random.default_rng(13)
+    t = AsyncKVTable(name="fz_kv", ctx=two_ranks[0])
+    AsyncKVTable(name="fz_kv", ctx=two_ranks[1])
+    model = {}
+    for step in range(60):
+        if rng.random() < 0.7:
+            keys = rng.integers(0, 40, rng.integers(1, 5)).tolist()
+            vals = rng.normal(size=len(keys)).tolist()
+            t.add(keys, vals)
+            for k, v in zip(keys, vals):
+                model[k] = model.get(k, 0.0) + v
+        else:
+            got = t.get()
+            assert set(got) == set(model)
+            for k, v in model.items():
+                assert abs(got[k] - v) < 1e-3, (k, got[k], v)
+    got = t.get()
+    for k, v in model.items():
+        assert abs(got[k] - v) < 1e-3
