@@ -85,6 +85,44 @@ def test_async_array_matches_numpy_model(two_ranks):
     np.testing.assert_allclose(t.get(), model, rtol=2e-5, atol=2e-4)
 
 
+def test_async_sparse_matrix_matches_numpy_model(two_ranks):
+    """The stale-row protocol (C++-served dirty-bit GET) is an
+    optimization, not a semantics change: get_rows_sparse must always
+    equal the model's rows, for EITHER worker's cache, interleaved with
+    adds from both ranks' table objects at random."""
+    from multiverso_tpu.ps.tables import AsyncSparseMatrixTable
+    rng = np.random.default_rng(23)
+    rows, cols = 29, 3
+    t0 = AsyncSparseMatrixTable(rows, cols, name="fz_s", ctx=two_ranks[0])
+    t1 = AsyncSparseMatrixTable(rows, cols, name="fz_s", ctx=two_ranks[1])
+    model = np.zeros((rows, cols), np.float32)
+    for step in range(100):
+        op = rng.choice(["add0", "add1", "sparse0", "sparse1", "plain"])
+        if op in ("add0", "add1"):
+            k = int(rng.integers(1, 8))
+            ids = rng.integers(0, rows, k)
+            vals = rng.normal(size=(k, cols)).astype(np.float32)
+            (t0 if op == "add0" else t1).add_rows(ids, vals)
+            np.add.at(model, ids, vals)
+        elif op in ("sparse0", "sparse1"):
+            t = t0 if op == "sparse0" else t1
+            k = int(rng.integers(1, 10))
+            ids = np.unique(rng.integers(0, rows, k))
+            got = t.get_rows_sparse(ids)
+            np.testing.assert_allclose(got, model[ids], rtol=2e-5,
+                                       atol=2e-4)
+        else:
+            ids = np.unique(rng.integers(0, rows, 6))
+            np.testing.assert_allclose(t0.get_rows(ids), model[ids],
+                                       rtol=2e-5, atol=2e-4)
+    # final full check from both workers' caches
+    all_ids = np.arange(rows)
+    np.testing.assert_allclose(t0.get_rows_sparse(all_ids), model,
+                               rtol=2e-5, atol=2e-4)
+    np.testing.assert_allclose(t1.get_rows_sparse(all_ids), model,
+                               rtol=2e-5, atol=2e-4)
+
+
 def test_async_kv_matches_dict_model(two_ranks):
     rng = np.random.default_rng(13)
     t = AsyncKVTable(name="fz_kv", ctx=two_ranks[0])
