@@ -37,6 +37,13 @@ from typing import Optional
 import numpy as np
 
 
+# Exit status of a SIGTERM-truncated run that still salvaged its headline
+# JSON line: 75 (BSD EX_TEMPFAIL — "try again with more budget"). 0 means
+# a COMPLETE run; 1 means the salvage itself failed (no usable line).
+# tools/run_bench.py keys the recorded "truncated" field off this.
+TRUNCATED_EXIT = 75
+
+
 def _percentile_ms(samples):
     return float(np.percentile(np.asarray(samples) * 1e3, 50))
 
@@ -492,8 +499,9 @@ def bench_array_table(size: int = 1_000_000, iters: int = 10):
     # sends sign bits + block scales with error feedback. Measured
     # INTERLEAVED with a plain table so tunnel-load drift between runs
     # cannot masquerade as a filter effect — compare the *_vs_plain ratios.
+    wire_modes = ("bf16", "1bit", "topk")
     tables = {"plain": t}
-    for mode in ("bf16", "1bit"):
+    for mode in wire_modes:
         tables[mode] = mv.ArrayTable(size, updater="sgd",
                                      name=f"bench_array_{mode}",
                                      wire_filter=mode)
@@ -512,12 +520,35 @@ def bench_array_table(size: int = 1_000_000, iters: int = 10):
     plain_get = _percentile_ms(samples["plain"]["get"])
     wf = {"plain_interleaved": {"add_p50_ms": plain_add,
                                 "get_p50_ms": plain_get}}
-    for mode in ("bf16", "1bit"):
+    from multiverso_tpu.ops import wire_codec
+    add_wire_bytes = {"bf16": 2 * size,
+                      "1bit": wire_codec.onebit_compressed_nbytes(size),
+                      "topk": wire_codec.topk_compressed_nbytes(
+                          wire_codec.default_topk(size))}
+    for mode in wire_modes:
         am = _percentile_ms(samples[mode]["add"])
         gm = _percentile_ms(samples[mode]["get"])
         wf[mode] = {"add_p50_ms": am, "get_p50_ms": gm,
                     "add_vs_plain": round(plain_add / am, 3),
-                    "get_vs_plain": round(plain_get / gm, 3)}
+                    "get_vs_plain": round(plain_get / gm, 3),
+                    "add_payload_bytes": add_wire_bytes[mode],
+                    "add_payload_vs_f32": round(4 * size
+                                                / add_wire_bytes[mode], 1)}
+
+    # version-cached repeat get (flag table_get_cache): no intervening
+    # add, so the snapshot dispatch + device->host transfer are skipped
+    # entirely — a hit costs one host memcpy
+    from multiverso_tpu.utils.dashboard import Dashboard
+    cache_mon = Dashboard.get("table[bench_array].get.cached")
+    hits_before = cache_mon.count
+    t.get()   # prime the cache at the current version
+    rep = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        t.get()
+        rep.append(time.perf_counter() - t0)
+    get_cached_ms = _percentile_ms(rep)
+    get_cache_hits = cache_mon.count - hits_before
     # device plane: delta already resident (the real TPU deployment shape —
     # grads are produced on device; host numbers above are tunnel-bound)
     import jax
@@ -561,6 +592,8 @@ def bench_array_table(size: int = 1_000_000, iters: int = 10):
         "pipelined_add_ms": _percentile_ms(pipe),
         "pipelined_add_gbps": nbytes / np.percentile(pipe, 50) / 1e9,
         "wire_filtered": wf,
+        "get_repeat_cached_ms": get_cached_ms,
+        "get_cache_hits": int(get_cache_hits),
         "device_add_ms": dev_add_s * 1e3,
         "device_add_gbps": nbytes / dev_add_s / 1e9,
         "fixed_overhead_ms": dev_intercept * 1e3,
@@ -772,7 +805,9 @@ def main() -> None:
     # (with whatever vs_baseline the baseline file gives) instead of
     # dying silently — a truncated run must not erase the record. The
     # normal path still prints exactly one JSON line (this handler never
-    # fires then).
+    # fires then). The salvage exits TRUNCATED_EXIT (not 0): a truncated
+    # run with a usable headline must stay distinguishable from a
+    # complete one (tools/run_bench.py records the distinction).
     def _salvage(signum, frame):
         ok = False
         try:
@@ -784,7 +819,7 @@ def main() -> None:
         except BaseException:   # noqa: BLE001 — the exit must still run
             pass                # (an exception here must not turn the
         finally:                # truncation into a silent success)
-            os._exit(0 if ok else 1)
+            os._exit(TRUNCATED_EXIT if ok else 1)
 
     signal.signal(signal.SIGTERM, _salvage)
     try:

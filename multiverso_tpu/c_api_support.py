@@ -69,6 +69,17 @@ def barrier() -> None:
                 errors.append(exc)
     mv.barrier()
     if errors:
+        # Surface EVERY flush failure, not just the first: the remaining
+        # ones are logged (a multi-table flush failure must not vanish
+        # behind the one that raises) and chained onto the raised
+        # exception as its __cause__ so tracebacks show at least two.
+        from multiverso_tpu.utils import log
+        for exc in errors[1:]:
+            log.error("barrier: additional async-table flush failure "
+                      "(first one is raised): %s: %s",
+                      type(exc).__name__, exc)
+        if len(errors) > 1:
+            raise errors[0] from errors[1]
         raise errors[0]
 
 

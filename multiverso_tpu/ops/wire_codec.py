@@ -1,0 +1,188 @@
+"""Device-resident wire-compression kernels.
+
+Jitted JAX implementations of the filter layer in ``utils/filters.py``
+(the reference's quantization_util.h surface — SparseFilter and the
+1-bit SGD OneBitsFilter recipe, Seide et al. 2014 / Alistarh et al.
+2017).  The numpy filters remain the REFERENCE implementation; the
+kernels here are property-tested to match them **bit-for-bit** on the
+encoded bits and per-block scales (tests/test_wire_codec.py), so a
+payload encoded on one side always decodes identically on the other.
+
+Bit-for-bit parity is engineered, not hoped for:
+
+* per-block sums use an explicit pairwise fold (:func:`fold_sum` here,
+  ``filters._fold_sum`` on the numpy side) — the identical sequence of
+  f32 additions on both sides, where a naive ``sum()`` would differ in
+  the last ulp between numpy's pairwise reduction and XLA's;
+* masking uses ``where`` (select), never multiply, so XLA cannot fuse a
+  multiply-add into an FMA with different rounding;
+* the scale division is a single f32/f32 divide on both sides;
+* bit packing is ``jnp.packbits``/``np.packbits`` (MSB-first), exact.
+
+Who runs where: encode kernels run on whatever device their inputs live
+on.  For host-resident payloads :func:`host_codec_device` supplies a CPU
+device so the f32 payload never crosses the accelerator wire just to be
+compressed (the whole point is to ship FEWER bytes over that seam);
+decode runs in-graph on the table's devices, fused into the updater
+apply (table.py builds those programs from :func:`onebit_decode` /
+:func:`topk_decode`).
+
+Error feedback (1bit / topk): the quantization error is returned as a
+new residual to be added to the next payload, carried as device state by
+the caller — it never round-trips through the host.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+_TINY = np.float32(np.finfo(np.float32).tiny)
+
+
+def canon_f32(x: jnp.ndarray) -> jnp.ndarray:
+    """Flush sub-normals to zero — codec property shared with the numpy
+    reference (``filters.canon_f32``). XLA flushes denormals (FTZ) in any
+    case the moment arithmetic touches them; making the flush explicit on
+    BOTH sides is what keeps bits/scales/residuals bit-identical when the
+    input contains them."""
+    return jnp.where(jnp.abs(x) < _TINY, jnp.float32(0), x)
+
+
+def fold_sum(x: jnp.ndarray) -> jnp.ndarray:
+    """Pairwise-fold sum over axis 1. Width must be a power of two (pad
+    with zeros first); mirrors ``filters._fold_sum`` addition-for-addition."""
+    while x.shape[1] > 1:
+        x = x[:, 0::2] + x[:, 1::2]
+    return x[:, 0]
+
+
+def _pow2_pad(width: int) -> int:
+    return 1 << max(width - 1, 0).bit_length() if width > 1 else 1
+
+
+def block_scales(blocks: jnp.ndarray, n: Optional[int] = None
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """(pos mask, pos_scale, neg_scale) for (nb, block) f32 blocks —
+    mean of positives / mean magnitude of non-positives per block.
+    ``n`` (logical element count, static): the block-padding tail beyond
+    it is EXCLUDED from the negative-side mean, mirroring
+    ``filters._block_scales`` (pad zeros are not data; counting them
+    dilutes the last block's neg scale and destabilizes error
+    feedback)."""
+    nb, block = blocks.shape
+    pos = blocks > 0
+    neg = ~pos
+    if n is not None and n < nb * block:
+        valid = (jnp.arange(nb * block) < n).reshape(nb, block)
+        neg = neg & valid
+    m = _pow2_pad(block)
+
+    def _mean(vals, mask):
+        picked = jnp.where(mask, vals, jnp.float32(0))
+        if m != block:
+            picked = jnp.pad(picked, ((0, 0), (0, m - block)))
+        s = fold_sum(picked)
+        cnt = jnp.maximum(mask.sum(1), 1).astype(jnp.float32)
+        return jnp.where(mask.any(1), s / cnt, jnp.float32(0))
+
+    return pos, _mean(blocks, pos), _mean(-blocks, neg)
+
+
+@partial(jax.jit, static_argnames=("block",))
+def onebit_encode(flat: jnp.ndarray, residual: jnp.ndarray,
+                  block: int = 1024
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """1-bit sign-pack with error feedback (filters.OneBitsFilter.filter_in).
+
+    Returns ``(bits u8[ceil(n/block)*block/8], scales f32[nb, 2],
+    new_residual f32[n])``. ``block`` must be a multiple of 8."""
+    if block % 8:
+        raise ValueError(f"block must be a multiple of 8, got {block}")
+    flat = canon_f32(flat.reshape(-1).astype(jnp.float32) + residual)
+    n = flat.shape[0]
+    nb = -(-n // block)
+    padded = jnp.zeros(nb * block, jnp.float32).at[:n].set(flat)
+    pos, pos_scale, neg_scale = block_scales(padded.reshape(nb, block),
+                                             n=n)
+    bits = jnp.packbits(pos.reshape(-1))
+    decoded = jnp.where(pos, pos_scale[:, None],
+                        -neg_scale[:, None]).reshape(-1)[:n]
+    return bits, jnp.stack([pos_scale, neg_scale], axis=1), flat - decoded
+
+
+@partial(jax.jit, static_argnames=("n", "block"))
+def onebit_decode(bits: jnp.ndarray, scales: jnp.ndarray, n: int,
+                  block: int = 1024) -> jnp.ndarray:
+    """Inverse of :func:`onebit_encode` (filters.OneBitsFilter.filter_out)."""
+    nb = -(-n // block)
+    pos = jnp.unpackbits(bits, count=nb * block).reshape(nb, block) > 0
+    flat = jnp.where(pos, scales[:, 0:1], -scales[:, 1:2])
+    return flat.reshape(-1)[:n]
+
+
+@partial(jax.jit, static_argnames=("k",))
+def topk_encode(flat: jnp.ndarray, residual: jnp.ndarray, k: int
+                ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Sparse top-magnitude encode with error feedback (QSGD-style
+    sparsification): keep the k largest-|x| entries exactly, accumulate
+    the rest into the residual. Ties break toward the lower index, same
+    as the numpy reference (``filters.TopKFilter``).
+
+    Returns ``(idx i32[k], vals f32[k], new_residual f32[n])``."""
+    flat = canon_f32(flat.reshape(-1).astype(jnp.float32) + residual)
+    _, idx = jax.lax.top_k(jnp.abs(flat), k)
+    idx = idx.astype(jnp.int32)
+    vals = flat[idx]
+    return idx, vals, flat.at[idx].set(jnp.float32(0))
+
+
+@partial(jax.jit, static_argnames=("n",))
+def topk_decode(idx: jnp.ndarray, vals: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Inverse of :func:`topk_encode` (zeros off-support)."""
+    return jnp.zeros(n, vals.dtype).at[idx].set(vals)
+
+
+@jax.jit
+def bf16_cast(x: jnp.ndarray) -> jnp.ndarray:
+    """bf16 down-cast for the Get reply wire (table.py's snapshot encode:
+    half the download bytes). Deliberately NON-donating — the only f32
+    this path ever casts is the live table data, which must survive the
+    cast. (A donating variant was dropped: every other bf16 encode in
+    the system is a host-side numpy cast before upload, so there is no
+    throwaway device f32 to donate.)"""
+    return x.astype(jnp.bfloat16)
+
+
+def host_codec_device() -> Optional[jax.Device]:
+    """A CPU device for encoding HOST payloads: compression must shrink
+    the bytes crossing the accelerator seam, so the f32 input cannot be
+    shipped to the accelerator just to be encoded. None when the CPU
+    platform is unavailable (callers fall back to the numpy filters)."""
+    try:
+        devs = jax.local_devices(backend="cpu")
+    except RuntimeError:
+        return None
+    return devs[0] if devs else None
+
+
+def onebit_compressed_nbytes(n: int, block: int = 1024) -> int:
+    """Wire bytes of a 1-bit payload (bits + scales) for n f32 elements."""
+    nb = -(-n // block)
+    return nb * block // 8 + nb * 8
+
+
+def topk_compressed_nbytes(k: int) -> int:
+    """Wire bytes of a top-k payload (i32 idx + f32 vals)."""
+    return 8 * k
+
+
+def default_topk(n: int) -> int:
+    """Default sparse-encode support: ~3% of entries (≈16x fewer wire
+    bytes than f32), at least one."""
+    return max(n // 32, 1)

@@ -448,10 +448,19 @@ class RowShard:
 
     def _prep_add(self, meta: Dict, arrays: Sequence[np.ndarray]
                   ) -> Tuple[np.ndarray, np.ndarray, AddOption]:
-        """Validate an ADD_ROWS request into (local ids, vals, opt)."""
+        """Validate an ADD_ROWS request into (local ids, vals, opt). The
+        value payload decodes ONCE here, straight from the frame blobs
+        into the apply (wire.decode_payload) — there is no intermediate
+        re-encode hop for compressed wires."""
         opt = AddOption(**meta.get("opt", {}))
         local = self._localize_raw(arrays[0])
-        vals = np.asarray(arrays[1], self.dtype)[: local.size]
+        wirem = meta.get("wire", "none")
+        if wirem in ("none", "bf16"):   # single blob decodes implicitly
+            vals = np.asarray(arrays[1], self.dtype)[: local.size]
+        else:
+            vals = wire.decode_payload(arrays[1:], wirem,
+                                       (local.size, self.num_col),
+                                       self.dtype)
         return local, vals, opt
 
     def _add_rows(self, local: np.ndarray, vals: np.ndarray,
@@ -512,8 +521,7 @@ class RowShard:
             # reference's semantics anyway (one Server actor thread).
             with self._lock:
                 rows = self._gather_rows(local)
-            rows = wire.to_wire(rows, meta.get("wire", "none"))
-            return {}, [rows]
+            return {}, wire.encode_payload(rows, meta.get("wire", "none"))
         if msg_type == svc.MSG_SET_ROWS:
             ids, k = self._localize(arrays[0])
             vals = np.asarray(arrays[1], self.dtype)[:k]
@@ -528,8 +536,8 @@ class RowShard:
             return {}, []
         if msg_type == svc.MSG_ADD_FULL:
             opt = AddOption(**meta.get("opt", {}))
-            delta = np.asarray(arrays[0], self.dtype).reshape(
-                self.n, self.num_col)
+            delta = wire.decode_payload(arrays, meta.get("wire", "none"),
+                                        (self.n, self.num_col), self.dtype)
             with self._lock:
                 if self._np_mode:
                     sign = _LINEAR_SIGN[type(self.updater)]
@@ -552,8 +560,8 @@ class RowShard:
                 # so the reply can't tear against a concurrent add
                 full = (self._data[: self.n].copy() if self._np_mode
                         else np.asarray(self._data))
-            full = wire.to_wire(full[: self.n], meta.get("wire", "none"))
-            return {}, [full]
+            return {}, wire.encode_payload(full[: self.n],
+                                           meta.get("wire", "none"))
         if msg_type == svc.MSG_GET_STATE:
             # updater-state leaves, full precision (checkpoint plumbing:
             # the sync table persists ustate, table.py store(); async
@@ -719,8 +727,8 @@ class HashShard(RowShard):
                         [self._slot_of.get(k, self.n)
                          for k in keys.tolist()], np.int64)
                     rows = self._gather_rows(slots)
-                    return {}, [wire.to_wire(rows,
-                                             meta.get("wire", "none"))]
+                    return {}, wire.encode_payload(
+                        rows, meta.get("wire", "none"))
                 slots = self._slots_for(keys)
                 arrays = [slots] + list(arrays[1:])
             return super().handle(msg_type, meta, arrays)

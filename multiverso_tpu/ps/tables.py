@@ -340,14 +340,32 @@ class AsyncMatrixTable(_AsyncBase):
                  ctx: Optional[svc.PSContext] = None):
         """``shard_workers > 0`` enables per-worker dirty-bit tracking on
         the owned shard (the sparse stale-row protocol; set by
-        AsyncSparseMatrixTable). ``wire="bf16"`` sends row payloads over
-        TCP as bfloat16 — half the bytes on the DCN-analogue wire, the
-        role the reference's SparseFilter played on its MPI wire
-        (quantization_util.h); values are cast back at the endpoint."""
+        AsyncSparseMatrixTable). ``wire="bf16"`` sends payloads over TCP
+        as bfloat16 — half the bytes on the DCN-analogue wire, the role
+        the reference's SparseFilter played on its MPI wire
+        (quantization_util.h); values are cast back at the endpoint.
+        ``wire="1bit"`` sends whole-table add deltas as sign bits +
+        per-block scales (~29x fewer bytes; 1-bit SGD) with per-owner
+        error feedback, row-batch adds as stateless 1-bit payloads (row
+        sets change between batches, so a positional residual has no
+        stable meaning there), and get replies as bf16 (parameter VALUES
+        are not deltas; sign-quantizing them would be destructive —
+        same rule as the sync table's 1bit mode). All encodes go through
+        ``ps/wire.encode_payload``: the frame blobs ARE the codec
+        output, decoded exactly once at the receiving shard."""
         super().__init__(ctx, name)
-        if wire not in ("none", "bf16"):
+        if wire not in ("none", "bf16", "1bit"):
             raise ValueError(f"unknown wire {wire!r}")
         self._wire = wire
+        # per-owner error-feedback residuals for 1bit whole-table adds
+        # (each rank's delta slice has a fixed shape, so the residual's
+        # positions are stable across payloads). The lock serializes the
+        # encode: filter_in reads AND writes the residual, so two
+        # threaded add()s racing it would compensate the same error
+        # twice and bias the stream (the sync Table guards its residual
+        # with the dispatch lock for the same reason)
+        self._add_filters: Dict[int, Any] = {}
+        self._add_filter_lock = threading.Lock()
         self.num_row, self.num_col = int(num_row), int(num_col)
         self.shape = (self.num_row, self.num_col)
         self.dtype = np.dtype(dtype)
@@ -404,15 +422,28 @@ class AsyncMatrixTable(_AsyncBase):
         precision) for zero transport savings."""
         return "none" if rank == self.ctx.rank else self._wire
 
-    def _add_meta_b(self, opt: AddOption) -> bytes:
-        """Packed add meta, cached per AddOption (one serialization per
-        distinct opt instead of one per op)."""
-        b = self._meta_cache.get(opt)
+    def _reply_wire(self) -> str:
+        """Reply wire for gets, rank-independent: 1bit applies to DELTAS
+        (add traffic); parameter values ride bf16 instead (sync-table
+        rule). THE one place that rule lives."""
+        return "bf16" if self._wire == "1bit" else self._wire
+
+    def _get_wire_for(self, rank: int) -> str:
+        """Reply wire per source rank (local short-circuit stays raw)."""
+        return "none" if rank == self.ctx.rank else self._reply_wire()
+
+    def _add_meta_b(self, opt: AddOption, wire: str = "none") -> bytes:
+        """Packed add meta, cached per (AddOption, wire) (one
+        serialization per distinct opt instead of one per op)."""
+        key = (opt, wire)
+        b = self._meta_cache.get(key)
         if b is None:
-            b = wire_mod.pack_meta({"table": self.name,
-                                    "opt": opt._asdict()})
+            meta = {"table": self.name, "opt": opt._asdict()}
+            if wire != "none":
+                meta["wire"] = wire
+            b = wire_mod.pack_meta(meta)
             if len(self._meta_cache) < 64:
-                self._meta_cache[opt] = b
+                self._meta_cache[key] = b
         return b
 
     def _owner_conns(self, uids: np.ndarray):
@@ -463,13 +494,17 @@ class AsyncMatrixTable(_AsyncBase):
                     np.ascontiguousarray(vals))
                 return self._track(_fanout_futures(
                     parts, lambda c, s, m: _NativeAddFuture(c, s, m)))
-            meta = {"table": self.name, "opt": opt._asdict()}
-            futs = [self.ctx.service.request(
-                        r, svc.MSG_ADD_ROWS, meta,
-                        [uids[m], wire_mod.to_wire(vals[m],
-                                                   self._wire_for(r))],
-                        meta_b=meta_b)
-                    for r, m in self._by_owner(uids)]
+            futs = []
+            for r, m in self._by_owner(uids):
+                w = self._wire_for(r)
+                # meta and blobs per destination wire: the local short-
+                # circuit stays uncompressed, remote peers get the codec
+                # frame (decoded exactly once in the shard's _prep_add)
+                futs.append(self.ctx.service.request(
+                    r, svc.MSG_ADD_ROWS,
+                    {"table": self.name, "opt": opt._asdict()},
+                    [uids[m]] + wire_mod.encode_payload(vals[m], w),
+                    meta_b=self._add_meta_b(opt, w)))
         return self._track(futs)
 
     def add_rows(self, row_ids, values,
@@ -495,10 +530,10 @@ class AsyncMatrixTable(_AsyncBase):
 
                 return self._track(futs, _assemble_native)
             parts = list(self._by_owner(uids))
-            # remote peers share one packed meta (with the table's wire
-            # codec); the local short-circuit keeps its uncompressed dict
-            meta_b = wire_mod.pack_meta(
-                {"table": self.name, "wire": self._wire})
+            # remote peers share one packed meta (with the table's reply
+            # wire); the local short-circuit keeps its uncompressed dict
+            gw = self._reply_wire()
+            meta_b = wire_mod.pack_meta({"table": self.name, "wire": gw})
             futs = [self.ctx.service.request(
                         r, svc.MSG_GET_ROWS,
                         {"table": self.name, "wire": "none"},
@@ -508,7 +543,10 @@ class AsyncMatrixTable(_AsyncBase):
             def _assemble(results):
                 out = np.empty((uids.size, self.num_col), self.dtype)
                 for (r, m), (_, arrays) in zip(parts, results):
-                    out[m] = arrays[0]
+                    w = "none" if r == self.ctx.rank else gw
+                    out[m] = wire_mod.decode_payload(
+                        arrays, w, (int(np.count_nonzero(m)),
+                                    self.num_col), self.dtype)
                 # re-expand duplicates to original order (None = no dups)
                 return out if inv is None else out[inv]
 
@@ -568,11 +606,34 @@ class AsyncMatrixTable(_AsyncBase):
                                     meta_b, None, delta[a:b])
                         for r, a, b in self._ranges]
                 return self._track(futs)
-            meta = {"table": self.name, "opt": opt._asdict()}
-            futs = [self.ctx.service.request(
-                        r, svc.MSG_ADD_FULL, meta,
-                        [wire_mod.to_wire(delta[a:b], self._wire_for(r))])
-                    for r, a, b in self._ranges]
+            futs = []
+            for r, a, b in self._ranges:
+                w = self._wire_for(r)
+                if w == "1bit":
+                    # per-owner error feedback: this rank's slice shape is
+                    # fixed, so the residual's positions are stable — the
+                    # quantization error of each payload rides the next
+                    # one (1-bit SGD), and the filter's (bits, scales)
+                    # blobs ARE the frame payload. Encode under the
+                    # filter lock: filter_in reads and writes the
+                    # residual, and threaded adds must not double-apply
+                    # the same compensation
+                    from multiverso_tpu.utils.filters import OneBitsFilter
+                    with self._add_filter_lock:
+                        filt = self._add_filters.get(r)
+                        if filt is None:
+                            filt = self._add_filters[r] = OneBitsFilter(
+                                block=wire_mod.ONEBIT_BLOCK)
+                        _, bits, scales = filt.filter_in(delta[a:b])
+                    arrays = [bits, scales]
+                else:
+                    arrays = wire_mod.encode_payload(delta[a:b], w)
+                meta = {"table": self.name, "opt": opt._asdict()}
+                if w != "none":
+                    meta["wire"] = w
+                futs.append(self.ctx.service.request(
+                    r, svc.MSG_ADD_FULL, meta, arrays,
+                    meta_b=self._add_meta_b(opt, w)))
         return self._track(futs)
 
     def add(self, delta, opt: Optional[AddOption] = None) -> None:
@@ -590,13 +651,16 @@ class AsyncMatrixTable(_AsyncBase):
             else:
                 futs = [self.ctx.service.request(
                             r, svc.MSG_GET_FULL,
-                            {"table": self.name, "wire": self._wire_for(r)})
+                            {"table": self.name,
+                             "wire": self._get_wire_for(r)})
                         for r, _, _ in ranges]
 
             def _assemble(results):
                 out = np.empty(self.shape, self.dtype)
                 for (r, a, b), (_, arrays) in zip(ranges, results):
-                    out[a:b] = arrays[0]
+                    out[a:b] = wire_mod.decode_payload(
+                        arrays, self._get_wire_for(r),
+                        (b - a, self.num_col), self.dtype)
                 return out
 
         return self._track(futs, _assemble)
@@ -974,7 +1038,7 @@ class AsyncArrayTable(_AsyncBase):
 
     def __init__(self, size: int, dtype=np.float32,
                  updater=None, name: str = "async_array",
-                 init: Optional[np.ndarray] = None,
+                 init: Optional[np.ndarray] = None, wire: str = "none",
                  ctx: Optional[svc.PSContext] = None):
         super().__init__(ctx, name)
         self.size = int(size)
@@ -983,7 +1047,7 @@ class AsyncArrayTable(_AsyncBase):
                   if init is not None else None)
         self._m = AsyncMatrixTable(self.size, 1, dtype=dtype,
                                    updater=updater, name=name,
-                                   init=init2d, ctx=self.ctx)
+                                   init=init2d, wire=wire, ctx=self.ctx)
         self.table_id = self._m.table_id
 
     def raw(self):

@@ -56,15 +56,47 @@ class WireError(RuntimeError):
     pass
 
 
+ONEBIT_BLOCK = 1024   # per-block scale granularity of the "1bit" wire
+
+
 def to_wire(arr: np.ndarray, wire: str) -> np.ndarray:
-    """Payload-side codec for a wire mode ("none" | "bf16"): the ONE place
-    wire formats are encoded, shared by client sends and shard replies.
-    The receiving side decodes implicitly — ``np.asarray(x, table_dtype)``
-    casts back."""
+    """Single-blob codec for a wire mode ("none" | "bf16"): shared by
+    client sends and shard replies. The receiving side decodes implicitly
+    — ``np.asarray(x, table_dtype)`` casts back. Multi-blob modes
+    ("1bit") go through :func:`encode_payload`."""
     if wire == "bf16":
         import ml_dtypes
         return np.asarray(arr).astype(ml_dtypes.bfloat16)
     return arr
+
+
+def encode_payload(arr: np.ndarray, wire: str) -> List[np.ndarray]:
+    """The ONE place PS payloads are wire-encoded: an array -> the blob
+    list that travels in the frame. "none" -> [arr]; "bf16" -> [bf16];
+    "1bit" -> [sign bits, per-block scales] (~29x fewer bytes; matches
+    the device codec in ops/wire_codec bit-for-bit, so an encoded frame
+    decodes identically at either endpoint — no decode/re-encode hop).
+    1bit is stateless at THIS layer: error feedback (residuals) belongs
+    to the endpoint that owns the stream (ps/tables.py for adds)."""
+    if wire == "1bit":
+        from multiverso_tpu.utils import filters
+        bits, scales = filters.onebit_encode_np(
+            np.asarray(arr, np.float32).reshape(-1), ONEBIT_BLOCK)
+        return [bits, scales]
+    return [to_wire(arr, wire)]
+
+
+def decode_payload(arrays: Sequence[np.ndarray], wire: str,
+                   shape: Tuple[int, ...], dtype) -> np.ndarray:
+    """Inverse of :func:`encode_payload` (the other endpoint)."""
+    if wire == "1bit":
+        from multiverso_tpu.utils import filters
+        n = int(np.prod(shape, dtype=np.int64))
+        flat = filters.onebit_decode_np(np.asarray(arrays[0]),
+                                        np.asarray(arrays[1]), n,
+                                        ONEBIT_BLOCK)
+        return flat.reshape(shape).astype(dtype, copy=False)
+    return np.asarray(arrays[0], dtype).reshape(shape)
 
 
 def _recv_exact(sock: socket.socket, n: int, *, sof: bool = False

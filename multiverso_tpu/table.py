@@ -44,10 +44,21 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from multiverso_tpu import updaters as updaters_lib
+from multiverso_tpu.ops import wire_codec
 from multiverso_tpu.updaters import AddOption
 from multiverso_tpu.utils import config, log
-from multiverso_tpu.utils.dashboard import monitor
+from multiverso_tpu.utils.dashboard import Dashboard, monitor
 from multiverso_tpu.zoo import Zoo
+
+config.define_bool(
+    "table_get_cache", True,
+    "version-stamped host cache for whole-table Get: each applied Add "
+    "bumps a table version, and a Get at an unchanged version returns "
+    "the cached host array instead of dispatching a snapshot + "
+    "device->host transfer (a repeated Get with no intervening Add "
+    "costs one memcpy, not one wire round-trip). Safe multi-controller: "
+    "host-plane ops are collective and identical on every process, so "
+    "versions advance in lockstep and all ranks hit or miss together")
 
 
 class _HostAdd:
@@ -95,8 +106,14 @@ class Table:
         quantization_util.h SparseFilter; OneBitsFilter was declared there
         and implemented here): "bf16" halves both directions (near-lossless
         for SGD traffic); "1bit" sends sign bits + per-block scales with
-        host-side error feedback (1-bit SGD) on Add and bf16 on Get. Row
-        ops are unaffected (their payloads are already small)."""
+        error feedback (1-bit SGD) on Add and bf16 on Get; "topk" sends
+        the ~3% largest-|x| delta entries exactly (QSGD-style
+        sparsification) with error feedback on Add and bf16 on Get.
+        Encoding runs through the jitted ops/wire_codec kernels (on the
+        host-side CPU backend, so the f32 payload never crosses the
+        accelerator wire just to be compressed); decode runs in-graph,
+        fused into the updater apply. Row ops are unaffected (their
+        payloads are already small)."""
         zoo = Zoo.get()
         self._zoo = zoo
         self.name = name
@@ -130,12 +147,23 @@ class Table:
                                                        self.dtype))
         self.table_id = zoo.register_table(self)
 
-        if wire_filter not in ("none", "bf16", "1bit"):
+        if wire_filter not in ("none", "bf16", "1bit", "topk"):
             raise ValueError(f"unknown wire_filter {wire_filter!r}")
         self._wire = wire_filter
         if wire_filter == "1bit":
             from multiverso_tpu.utils.filters import OneBitsFilter
             self._one_bit = OneBitsFilter(block=1024)
+        elif wire_filter == "topk":
+            from multiverso_tpu.utils.filters import TopKFilter
+            self._topk_k = wire_codec.default_topk(int(np.prod(self.shape)))
+            self._topk = TopKFilter(self._topk_k)
+        if wire_filter in ("1bit", "topk"):
+            # jitted encode runs on the host-side CPU backend (numpy
+            # reference filter when unavailable); the error-feedback
+            # residual stays resident there as table state — it never
+            # round-trips through a host pull
+            self._codec_dev = wire_codec.host_codec_device()
+            self._wire_residual: Optional[jax.Array] = None
         if wire_filter != "none":
             # filters trade encode CPU for wire bytes; on a FAST link that
             # trade loses (1bit measured ~10x slower than plain off-tunnel)
@@ -153,6 +181,12 @@ class Table:
         self._pending: Dict[int, Any] = {}
         self._next_msg_id = 0
         self._lock = threading.Lock()
+        # version-stamped get cache: every applied mutation bumps
+        # _version (see _mark_mutated); a whole-table Get at an unchanged
+        # version returns the cached host array and skips the snapshot
+        # dispatch + device->host transfer entirely (flag table_get_cache)
+        self._version = 0
+        self._get_cache: Optional[Tuple[int, np.ndarray]] = None
         # Serializes op *dispatch* (not device execution): a donating add on
         # one thread must not delete the data buffer while another thread
         # (e.g. an AsyncBuffer prefetch pull) is snapshotting it.
@@ -200,6 +234,70 @@ class Table:
             spec = P(*([None] * (nd - pd)), self._axis, *([None] * (pd - 1)))
             return jax.device_put(x, NamedSharding(self._mesh, spec))
         return jax.device_put(x, self._replicated)
+
+    # ------------------------------------------------------------------ #
+    # mutation bookkeeping (Zoo dirty fence + get-cache version)
+    # ------------------------------------------------------------------ #
+    def _mark_mutated(self) -> None:
+        """Entry of every table mutation path: dirty-mark for the Zoo
+        barrier fence and bump the get-cache version CONSERVATIVELY (so a
+        ``version`` poll — e.g. an AsyncBuffer ``version_fn`` — already
+        sees a queued-but-unapplied coalesced add as a change). This
+        entry bump alone cannot make the cache correct: it happens
+        outside the dispatch lock, so a concurrent Get could stamp
+        pre-mutation data with the post-bump version. The guarantee
+        comes from :meth:`_version_applied`, which bumps AGAIN at the
+        point the mutation is dispatched while the dispatch lock is
+        held — any mutation applying after a Get's snapshot therefore
+        always moves the version past that Get's stamp."""
+        self._zoo.mark_dirty(self.table_id)
+        self._version += 1
+
+    def _version_applied(self) -> None:
+        """Apply-side version bump (see :meth:`_mark_mutated`). Called at
+        every site that actually mutates ``_data``/``_ustate``, while the
+        dispatch lock is held (or, for adopt/load, after the state
+        assignment) — the Get cache's correctness anchor."""
+        self._version += 1
+
+    @property
+    def version(self) -> int:
+        """Monotonic mutation counter (the get-cache stamp). Cheap enough
+        to poll — e.g. as an AsyncBuffer ``version_fn`` so a prefetch pull
+        of an unchanged table is skipped entirely."""
+        return self._version
+
+    def _cached_get(self, into: Optional[np.ndarray] = None
+                    ) -> Optional[np.ndarray]:
+        """Cached host array when the version is unchanged, else None.
+        Caller holds the dispatch lock. The cache owns a private copy
+        (callers may mutate what get() hands them), so hits pay one
+        memcpy instead of a dispatch + transfer — straight into ``into``
+        when the caller supplied a reusable output buffer (one memcpy,
+        not copy-then-copyto)."""
+        if not config.get_flag("table_get_cache"):
+            return None
+        cache = self._get_cache
+        if cache is None or cache[0] != self._version:
+            return None
+        Dashboard.get(f"table[{self.name}].get.cached").observe_ms(0.0)
+        if into is not None:
+            np.copyto(into.reshape(self.shape), cache[1])
+            return into
+        return cache[1].copy()
+
+    def _store_get_cache(self, version: int, host: np.ndarray) -> None:
+        """Caller holds the dispatch lock. An older-version store (a slow
+        get_async finalize racing a sync get that already cached fresher
+        data) is dropped instead of clobbering the fresher entry — it
+        could never match a future version check anyway, and replacing
+        the fresh entry would just turn the next Get into a miss."""
+        if not config.get_flag("table_get_cache"):
+            return
+        cache = self._get_cache
+        if cache is not None and cache[0] > version:
+            return
+        self._get_cache = (version, host.copy())
 
     # ------------------------------------------------------------------ #
     # msg-id / Waiter bookkeeping (ref src/table.cpp:27-97)
@@ -270,10 +368,11 @@ class Table:
 
     def adopt(self, state: Dict[str, Any]) -> None:
         """Commit an externally-advanced table state (end of in-graph loop)."""
-        self._zoo.mark_dirty(self.table_id)
+        self._mark_mutated()
         self._flush_host_adds()   # a late-applying add must not overwrite
         self._data = state["data"]
         self._ustate = state["ustate"]
+        self._version_applied()
 
     def pad_delta(self, delta: jax.Array) -> jax.Array:
         pad = self._padded_rows - self.shape[0]
@@ -377,25 +476,25 @@ class Table:
                 _update, donate_argnums=(0, 1))
         return fn
 
+    def _pad_flat_delta(self, flat: jax.Array, dtype) -> jax.Array:
+        """Raveled logical-size delta -> padded table shape (in-graph)."""
+        n = int(np.prod(self.shape))
+        return jnp.zeros(self._padded_shape, dtype).reshape(-1).at[:n].set(
+            flat.astype(dtype)).reshape(self._padded_shape)
+
     def _onebit_update_fn(self):
         fn = self._jit_cache.get("full_1bit")
         if fn is None:
             updater = self.updater
-            padded = self._padded_shape
             n = int(np.prod(self.shape))
             block = self._one_bit.block
 
             def _update(data, ustate, bits, scales, opt):
-                # device-side unpack of the 1-bit payload: sign bits ->
-                # per-block +pos_scale / -neg_scale
-                nb = scales.shape[0]
-                expand = (bits[:, None] >>
-                          jnp.arange(7, -1, -1, dtype=jnp.uint8)) & 1
-                pos = expand.reshape(-1)[: nb * block].reshape(nb, block) > 0
-                flat = jnp.where(pos, scales[:, 0:1], -scales[:, 1:2])
-                delta = jnp.zeros(padded, data.dtype).reshape(-1).at[
-                    : n].set(flat.reshape(-1)[: n].astype(data.dtype)
-                             ).reshape(padded)
+                # in-graph decode of the 1-bit payload (ops/wire_codec),
+                # fused into the updater apply
+                flat = wire_codec.onebit_decode(bits, scales, n=n,
+                                                block=block)
+                delta = self._pad_flat_delta(flat, data.dtype)
                 data, ustate = updater.apply(data, ustate, delta, opt)
                 return data, ustate, jnp.ravel(data)[0]
 
@@ -403,16 +502,38 @@ class Table:
                 _update, donate_argnums=(0, 1))
         return fn
 
+    def _topk_update_fn(self):
+        fn = self._jit_cache.get("full_topk")
+        if fn is None:
+            updater = self.updater
+            n = int(np.prod(self.shape))
+
+            def _update(data, ustate, idx, vals, opt):
+                flat = wire_codec.topk_decode(idx, vals, n=n)
+                delta = self._pad_flat_delta(flat, data.dtype)
+                data, ustate = updater.apply(data, ustate, delta, opt)
+                return data, ustate, jnp.ravel(data)[0]
+
+            fn = self._jit_cache["full_topk"] = jax.jit(
+                _update, donate_argnums=(0, 1))
+        return fn
+
     # ------------------------------------------------------------------ #
     # client-side add coalescing
     # ------------------------------------------------------------------ #
     def _coalescible(self, delta, opt) -> bool:
-        """Async host adds coalesce when the merge is EXACT: stateless
-        linear updater (sum of deltas == sequence of adds, and opt is
-        never read), single controller (a collective process_sum must
-        keep one per-process issue order), uncompressed wire (the 1bit
-        filter's error feedback is sequence-dependent)."""
-        return (self._wire == "none" and self._zoo.size() == 1
+        """Async host adds coalesce when the merge is EXACT for the
+        updater: stateless linear updater (sum of deltas == sequence of
+        adds, and opt is never read), single controller (a collective
+        process_sum must keep one per-process issue order). Wire-filtered
+        tables coalesce too: the single applier thread preserves encode
+        order, and under a linear updater the error-feedback codecs are
+        indifferent to whether N deltas are encoded one-by-one or as
+        their sum — the residual carries whatever any one payload left
+        out. This is also what takes the encode off the caller's
+        dispatch path (BENCH_r05: the inline 1bit encode+compile made
+        add_async ~1400x the uncompressed dispatch)."""
+        return (self._zoo.size() == 1
                 and not isinstance(delta, jax.Array)
                 and type(self.updater) in updaters_lib.STATELESS_LINEAR)
 
@@ -446,9 +567,15 @@ class Table:
                 for e in batch:
                     acc += e.arr
                 acc = acc.astype(self.dtype)
-            delta_dev = self._host_delta(acc)   # ONE upload for all
-            self._data, self._ustate, token = self._full_update_fn()(
-                self._data, self._ustate, delta_dev, batch[0].opt)
+            if self._wire != "none":
+                # compressed upload for the whole merged batch: ONE
+                # encode + one small transfer instead of N of either
+                token = self._dispatch_wire_add(acc, batch[0].opt)
+            else:
+                delta_dev = self._host_delta(acc)   # ONE upload for all
+                self._data, self._ustate, token = self._full_update_fn()(
+                    self._data, self._ustate, delta_dev, batch[0].opt)
+                self._version_applied()
             for e in batch:
                 e.token = token
         except Exception as err:   # pragma: no cover - device failure
@@ -516,7 +643,7 @@ class Table:
         tunneled link, so fewer transfers is the only lever). Everything
         else applies inline under the dispatch lock."""
         opt = opt or AddOption()
-        self._zoo.mark_dirty(self.table_id)
+        self._mark_mutated()
         with monitor(f"table[{self.name}].add"):
             if self._coalescible(delta, opt):
                 return self._enqueue_host_add(delta, opt)
@@ -527,15 +654,34 @@ class Table:
                 delta_dev = self._host_delta(delta)
                 self._data, self._ustate, token = self._full_update_fn()(
                     self._data, self._ustate, delta_dev, opt)
+                self._version_applied()
         return self._track(token)
 
     def _add_async_wire(self, delta: ArrayLike, opt: AddOption) -> int:
         """Compressed upload: the host payload shrinks 2x (bf16) / ~29x
-        (1bit) before crossing the wire; decode runs in-graph."""
+        (1bit) / ~16x (topk) before crossing the wire; decode runs
+        in-graph, fused into the updater apply."""
         arr = np.asarray(delta, dtype=self.dtype).reshape(self.shape)
         if self._zoo.size() > 1:
             from multiverso_tpu.parallel.collectives import process_sum
             arr = process_sum(arr)
+        return self._track(self._dispatch_wire_add(arr, opt))
+
+    def _encode_residual(self) -> jax.Array:
+        """The device-resident error-feedback residual (lazy zeros)."""
+        if self._wire_residual is None:
+            self._wire_residual = jax.device_put(
+                np.zeros(int(np.prod(self.shape)), np.float32),
+                self._codec_dev)
+        return self._wire_residual
+
+    def _dispatch_wire_add(self, arr: np.ndarray, opt: AddOption):
+        """Encode (jitted wire_codec kernel on the host-side CPU backend,
+        numpy reference filter when that backend is unavailable) + ship
+        only the compressed payload across the host<->device seam + apply
+        via the in-graph decode+update program. Caller holds the dispatch
+        lock (the codec residual is table state). Returns the completion
+        token."""
         if self._wire == "bf16":
             import ml_dtypes
             padded = np.zeros(self._padded_shape, ml_dtypes.bfloat16)
@@ -543,36 +689,70 @@ class Table:
             dev = jax.device_put(padded, self._sharding)
             self._data, self._ustate, token = self._bf16_update_fn()(
                 self._data, self._ustate, dev, opt)
-        else:  # 1bit, with host-side error feedback
-            _, bits, scales = self._one_bit.filter_in(arr)
+        elif self._wire == "1bit":
+            if self._codec_dev is not None:
+                bits, scales, self._wire_residual = wire_codec.onebit_encode(
+                    arr.reshape(-1).astype(np.float32, copy=False),
+                    self._encode_residual(), block=self._one_bit.block)
+                bits, scales = np.asarray(bits), np.asarray(scales)
+            else:
+                _, bits, scales = self._one_bit.filter_in(arr)
             self._data, self._ustate, token = self._onebit_update_fn()(
                 self._data, self._ustate,
                 jax.device_put(bits, self._replicated),
                 jax.device_put(scales, self._replicated), opt)
-        return self._track(token)
+        else:  # topk
+            if self._codec_dev is not None:
+                idx, vals, self._wire_residual = wire_codec.topk_encode(
+                    arr.reshape(-1).astype(np.float32, copy=False),
+                    self._encode_residual(), k=self._topk_k)
+                idx, vals = np.asarray(idx), np.asarray(vals)
+            else:
+                _, idx, vals = self._topk.filter_in(arr)
+            self._data, self._ustate, token = self._topk_update_fn()(
+                self._data, self._ustate,
+                jax.device_put(idx, self._replicated),
+                jax.device_put(vals, self._replicated), opt)
+        self._version_applied()
+        return token
 
     def add(self, delta: ArrayLike, opt: Optional[AddOption] = None) -> None:
         """ref WorkerTable::Add — blocking add (Wait(AddAsync(...)))."""
         self.wait(self.add_async(delta, opt))
 
     def get_async(self) -> int:
-        """ref WorkerTable::GetAsync — start device->host transfer, return id."""
+        """ref WorkerTable::GetAsync — start device->host transfer, return
+        id. A version-cache hit skips the snapshot dispatch and transfer
+        entirely; with a wire filter the snapshot is cast to bf16 on
+        device first (half the download bytes — get() always did this,
+        the async variant previously pulled full f32)."""
         self._flush_host_adds()   # before the lock: the applier needs it
         with monitor(f"table[{self.name}].get"), self._dispatch_lock:
-            snap = self._snapshot_fn()(self._data)
+            cached = self._cached_get()
+            if cached is not None:
+                return self._track((), lambda _: cached)
+            version = self._version
+            snap = (self._bf16_cast_fn()(self._data)
+                    if self._wire != "none"
+                    else self._snapshot_fn()(self._data))
             try:
                 snap.copy_to_host_async()
             except AttributeError:
                 pass
-            return self._track(
-                snap, lambda s: self._to_host(s)[: self.shape[0]])
+
+            def _finalize(s, _v=version):
+                host = self._to_host(s)[: self.shape[0]]
+                if host.dtype != self.dtype:
+                    host = host.astype(self.dtype)
+                with self._dispatch_lock:
+                    self._store_get_cache(_v, host)
+                return host
+
+            return self._track(snap, _finalize)
 
     def _bf16_cast_fn(self):
-        fn = self._jit_cache.get("bf16_cast")
-        if fn is None:
-            fn = self._jit_cache["bf16_cast"] = jax.jit(
-                lambda d: d.astype(jnp.bfloat16))
-        return fn
+        # the non-donating codec kernel: table data stays live
+        return wire_codec.bf16_cast
 
     def get(self, out: Optional[np.ndarray] = None) -> np.ndarray:
         """ref WorkerTable::Get — blocking pull of the whole logical table.
@@ -586,11 +766,16 @@ class Table:
         bytes; ~3 decimal digits, plenty for parameter traffic)."""
         self._flush_host_adds()   # before the lock: the applier needs it
         with monitor(f"table[{self.name}].get"), self._dispatch_lock:
+            hit = self._cached_get(into=out)
+            if hit is not None:
+                return hit
+            version = self._version
             if self._wire != "none":
                 host = self._to_host(self._bf16_cast_fn()(self._data))
                 host = host[: self.shape[0]].astype(self.dtype)
             else:
                 host = self._to_host(self._data)[: self.shape[0]]
+            self._store_get_cache(version, host)
         if out is not None:
             np.copyto(out.reshape(self.shape), host)
             return out
@@ -626,7 +811,7 @@ class Table:
             np.save(stream, self._to_host(leaf), allow_pickle=False)
 
     def load(self, stream) -> None:
-        self._zoo.mark_dirty(self.table_id)
+        self._mark_mutated()
         self._flush_host_adds()   # a late-applying add must not overwrite
         data = np.load(stream)
         if data.shape != self._padded_shape:
@@ -640,3 +825,4 @@ class Table:
         leaves = [np.load(stream) for _ in range(n)]
         self._ustate = jax.tree.unflatten(
             treedef, [self._place_state(l) for l in leaves])
+        self._version_applied()

@@ -196,7 +196,7 @@ class MatrixTable(Table):
     def add_rows_async(self, row_ids, values,
                        opt: Optional[AddOption] = None) -> int:
         opt = opt or AddOption()
-        self._zoo.mark_dirty(self.table_id)
+        self._mark_mutated()
         with monitor(f"table[{self.name}].add_rows"), self._dispatch_lock:
             ids, vals, _, _ = self._prep_ids(row_ids, values)
             if self._zoo.size() > 1:
@@ -215,6 +215,7 @@ class MatrixTable(Table):
             # union, not just this worker's set): the sparse table's dirty
             # bits must cover rows other workers contributed
             self._rows_applied(ids)
+            self._version_applied()
         return self._track(token)
 
     def _rows_applied(self, ids: np.ndarray) -> None:

@@ -45,3 +45,55 @@ def test_we_async_worker_tiny():
     assert r["words_per_sec_aggregate"] > 0
     assert len(r["words_per_sec_per_worker"]) == 2
     assert np.isfinite(r["loss_mean"])
+
+
+def test_array_table_bench_smoke():
+    """Tier-1 smoke of the full bench_array_table path at toy scale: a
+    wire-codec regression (encode kernel, get cache, topk plane) surfaces
+    here instead of only in a full driver bench run. Asserts the
+    dashboard reports all four benched tables' counters."""
+    import multiverso_tpu as mv
+    from multiverso_tpu.utils.dashboard import Dashboard
+
+    mv.init()
+    r = bench.bench_array_table(size=10_000, iters=2)
+    assert r["add_p50_ms"] > 0 and r["get_p50_ms"] > 0
+    for mode in ("bf16", "1bit", "topk"):
+        assert r["wire_filtered"][mode]["add_p50_ms"] > 0, mode
+        assert r["wire_filtered"][mode]["get_p50_ms"] > 0, mode
+    # the repeat-get loop must actually hit the version cache
+    assert r["get_cache_hits"] >= 2
+    snap = Dashboard.snapshot()
+    for name in ("bench_array", "bench_array_bf16", "bench_array_1bit",
+                 "bench_array_topk"):
+        for op in ("add", "get"):
+            key = f"table[{name}].{op}"
+            assert key in snap and snap[key].count > 0, key
+
+
+def test_bench_truncation_recording(tmp_path):
+    """The SIGTERM salvage exits bench.TRUNCATED_EXIT (documented,
+    nonzero, distinct from a hard failure) and tools/run_bench records
+    the distinction — a timeout-truncated run can never masquerade as a
+    complete one."""
+    import json
+
+    from tools.run_bench import last_json_line, record
+
+    assert bench.TRUNCATED_EXIT not in (0, 1)
+    headline = {"metric": "m", "value": 1.0, "vs_baseline": 1.0,
+                "extra": {"truncated": "bench interrupted by signal 15"}}
+    out = "log noise\n" + json.dumps(headline) + "\n"
+    rec = record(bench.TRUNCATED_EXIT, out)
+    assert rec["truncated"] and not rec["complete"]
+    assert rec["headline"]["value"] == 1.0
+    complete = {"metric": "m", "value": 2.0, "vs_baseline": 1.0,
+                "extra": {}}
+    rec2 = record(0, json.dumps(complete))
+    assert not rec2["truncated"] and rec2["complete"]
+    # belt: the headline's own salvage marker flags truncation even if
+    # the exit status was lost by a wrapper — and the record can never
+    # be simultaneously complete and truncated
+    rec3 = record(0, out)
+    assert rec3["truncated"] and not rec3["complete"]
+    assert last_json_line("no json here") is None

@@ -39,18 +39,37 @@ _END = "/* END generated ABI declarations */"
 def parse_capi(path: str = _CAPI):
     """Yield (ret, name, [param, ...]) for every extern "C" MV_* definition,
     in source order. Commented-out parameter names (``int* /*argc*/``) are
-    resurrected so the generated declarations stay self-documenting."""
+    resurrected so the generated declarations stay self-documenting.
+
+    The return type admits pointers and multi-word scalars
+    (``void*``, ``const char*``, ``unsigned long long``) — and a looser
+    scan cross-checks the strict pattern: an ``MV_`` definition the strict
+    regex missed fails LOUDLY here instead of silently vanishing from the
+    generated cdef (the exact drift this tool exists to prevent)."""
     src = open(path).read()
     out = []
     for m in re.finditer(
-            r"^(void|int|float|double)\s+(MV_\w+)\s*\(([^)]*)\)\s*\{",
+            r"^((?:const\s+)?(?:unsigned\s+|signed\s+)?\w+(?:\s+\w+)?"
+            r"(?:\s*\*+)?)\s*(MV_\w+)\s*\(([^)]*)\)\s*\{",
             src, re.MULTILINE | re.DOTALL):
-        ret, name, raw = m.group(1), m.group(2), m.group(3)
+        ret, name, raw = " ".join(m.group(1).split()), m.group(2), m.group(3)
         params = []
         for p in raw.split(",") if raw.strip() else []:
             p = re.sub(r"/\*\s*(\w+)\s*\*/", r"\1", p)  # /*argc*/ -> argc
             params.append(" ".join(p.split()))
         out.append((ret, name, params))
+    # cross-check: ANY line-anchored MV_* function definition, however
+    # exotic its return type
+    loose = set(re.findall(r"^[ \t]*[\w\*&: \t]+?\b(MV_\w+)\s*\([^)]*\)\s*\{",
+                           src, re.MULTILINE | re.DOTALL))
+    strict = {name for _, name, _ in out}
+    missed = sorted(loose - strict)
+    if missed:
+        raise SystemExit(
+            f"{path}: MV_ exports {missed} match the loose definition scan "
+            "but not the strict return-type pattern — extend parse_capi's "
+            "regex (refusing to silently drop them from the generated "
+            "cdef)")
     if not out:
         raise SystemExit(f"no extern-C MV_* definitions found in {path}")
     return out

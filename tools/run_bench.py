@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Run bench.py and record its headline with truncation status.
+
+The bench prints ONE JSON line and exits 0 when complete; a driver-side
+timeout SIGTERM triggers the salvage handler, which still prints the
+headline but exits ``bench.TRUNCATED_EXIT`` (75). This wrapper is the
+recording side of that contract: it re-runs the bench unchanged,
+captures the last JSON line, and writes it (default ``BENCH_RUN.json``)
+with an explicit ``truncated`` key derived from the exit status — so a
+timeout-truncated record can never masquerade as a complete run.
+
+    python tools/run_bench.py [-o BENCH_RUN.json] [-- extra bench args]
+
+Exit status mirrors the bench's own (0 complete, 75 truncated-but-
+salvaged, anything else = failed).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from bench import TRUNCATED_EXIT  # noqa: E402
+
+
+def last_json_line(text: str):
+    """The bench contract: the headline is the last parseable JSON line."""
+    for line in reversed(text.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def record(returncode: int, stdout: str) -> dict:
+    """Shape a bench run into the recorded artifact (pure: testable
+    without spawning the real 20-minute bench)."""
+    headline = last_json_line(stdout)
+    # truncated iff the salvage path exited, OR the headline itself
+    # carries the salvage marker (belt: a wrapper that lost the exit
+    # status must still never record a truncated run as complete)
+    truncated = (returncode == TRUNCATED_EXIT
+                 or bool((headline or {}).get("extra", {})
+                         .get("truncated")))
+    return {
+        "returncode": returncode,
+        "truncated": truncated,
+        # never both: the belt case (exit status lost, salvage marker
+        # present) must read as truncated, not complete
+        "complete": returncode == 0 and not truncated,
+        "headline": headline,
+    }
+
+
+def main(argv) -> int:
+    out_path = os.path.join(_REPO, "BENCH_RUN.json")
+    if argv[:1] == ["-o"]:
+        out_path, argv = argv[1], argv[2:]
+    if argv[:1] == ["--"]:
+        argv = argv[1:]
+    proc = subprocess.run([sys.executable, os.path.join(_REPO, "bench.py"),
+                           *argv], cwd=_REPO, capture_output=True, text=True)
+    rec = record(proc.returncode, proc.stdout)
+    if rec["headline"] is None:
+        sys.stderr.write(proc.stderr[-2000:])
+    with open(out_path, "w") as f:
+        json.dump(rec, f, indent=1)
+    print(json.dumps({"recorded": os.path.relpath(out_path, _REPO),
+                      "truncated": rec["truncated"],
+                      "complete": rec["complete"]}))
+    return proc.returncode
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
