@@ -48,14 +48,27 @@ def _percentile_ms(samples):
     return float(np.percentile(np.asarray(samples) * 1e3, 50))
 
 
+# degenerate two-point measurements (t_hi < t_lo: timing noise swamped the
+# signal) recorded here and surfaced in the bench record's "extra" — a
+# floored slope must stay visible as a bad measurement, not pass as data
+_DEGENERATE_DIFFERENTIALS = []
+
+
 def _differential(run, n_lo: int, n_hi: int):
     """Two-point slope timing: ``run(n)`` performs n units of work ending in
     a host readback and returns its wall seconds. Returns
     ``(sec_per_unit, intercept_s)`` — the steady-state device time per unit
-    and the fixed sync/dispatch cost the slope removed."""
+    and the fixed sync/dispatch cost the slope removed. A noise-negative
+    slope (t_hi < t_lo) floors at 0 and logs the raw pair to
+    ``_DEGENERATE_DIFFERENTIALS`` instead of reporting a negative ms/call."""
     t_lo = run(n_lo)
     t_hi = run(n_hi)
     slope = (t_hi - t_lo) / (n_hi - n_lo)
+    if slope < 0.0:
+        _DEGENERATE_DIFFERENTIALS.append(
+            {"n_lo": n_lo, "n_hi": n_hi,
+             "t_lo_s": round(t_lo, 6), "t_hi_s": round(t_hi, 6)})
+        slope = 0.0
     return slope, max(t_lo - n_lo * slope, 0.0)
 
 
@@ -393,6 +406,31 @@ def bench_async_ps(seconds: float = 4.0):
     out["rows_per_sec_2workers"] = out["np2"]["rows_per_sec"]
     out["mb_per_sec_2workers"] = out["np2"]["mb_per_sec"]
     return out
+
+
+def bench_small_add_window(iters: int = 400):
+    """Small-add (1-row) p50 per-call latency with the client send window
+    on vs off (ISSUE 2 acceptance metric) — subprocess so the 2-rank PS
+    world and the CPU backend never touch this process's runtime. The
+    worker interleaves both arms over the same ids/values and refuses to
+    report latency unless the final states match bit-for-bit."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "bench_small_add.py"),
+         str(iters)],
+        capture_output=True, text=True, timeout=300, env=env, cwd=repo)
+    if out.returncode != 0:
+        raise RuntimeError(f"small-add bench rc={out.returncode}: "
+                           f"{out.stderr[-300:]}")
+    for line in out.stdout.splitlines():
+        if line.startswith("RESULT "):
+            return json.loads(line[len("RESULT "):])
+    raise RuntimeError("small-add bench produced no RESULT line")
 
 
 def bench_array_table_nontunnel(size: int = 1_000_000, iters: int = 10):
@@ -913,6 +951,10 @@ def main() -> None:
         decode_stats = bench_decode()
     except Exception as e:
         decode_stats = {"error": f"{type(e).__name__}: {e}"[:200]}
+    try:
+        small_add_stats = bench_small_add_window()
+    except Exception as e:
+        small_add_stats = {"error": f"{type(e).__name__}: {e}"[:200]}
     mv.shutdown()
 
     baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -944,7 +986,12 @@ def main() -> None:
         "resnet32_cifar_50k": resnet_stats,
         "matrix_sparse_row_add": rows_stats,
         "lm_decode_b8_d256_L4": decode_stats,
+        "small_add_send_window": small_add_stats,
     }
+    if _DEGENERATE_DIFFERENTIALS:
+        # floored noise-negative slopes (see _differential): the raw pairs
+        # stay on the record so a degenerate measurement is visible
+        extra["degenerate_differentials"] = list(_DEGENERATE_DIFFERENTIALS)
     extra = _sanitize(extra)
     # bulky sub-bench detail goes to a side file; the driver-parsed line
     # stays compact, strictly-valid JSON (r02's record lost its headline to

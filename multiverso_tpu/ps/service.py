@@ -57,6 +57,12 @@ MSG_KV_ADD = 0x16
 MSG_KV_GET = 0x17
 MSG_GET_STATE = 0x18
 MSG_SET_STATE = 0x19
+# multi-op frame: N logical sub-ops (each a complete inner frame with its
+# own meta + codec wire, wire.pack_batch) delivered, dispatched, and acked
+# as ONE request — the client send window's unit (ps/tables._SendWindow).
+# Unknown to the native C++ server by design: it punts to the Python
+# handler, which already holds the native shard mutex there.
+MSG_BATCH = 0x1A
 
 config.define_string("ps_rendezvous", "",
                      "directory for async-PS rank rendezvous (empty = use "

@@ -38,6 +38,7 @@ from multiverso_tpu.utils import config as _config
 # on host-backed shards (~20 us vs ~60 us jit dispatch for a 128-row
 # batch); opt-insensitive ones coalesce across senders.
 from multiverso_tpu.updaters import (OPT_INSENSITIVE as _OPT_INSENSITIVE,
+                                     ROW_LOCAL_STATE as _ROW_LOCAL_STATE,
                                      STATELESS_LINEAR as _LINEAR_SIGN)
 
 
@@ -313,12 +314,21 @@ class RowShard:
     # coalescing apply queue (ps_coalesce)
     # ------------------------------------------------------------------ #
     def _apply_add_group(self, entries: List[_PendingAdd],
-                         opt: AddOption) -> None:
+                         opt: AddOption) -> int:
         """Apply one opt-group of queued adds as ONE jitted update (caller
         holds ``self._lock``). Cross-request duplicate rows sum their
         deltas (float64 accumulation, same rule as the client-side
         ``_dedupe_batch``) — semantically the deltas arrived in a single
-        message, which is the associativity async mode already grants."""
+        message, which is the associativity async mode already grants.
+        Updaters with GLOBAL state (adam's step counter advances once per
+        apply) never merge: K adds must count K steps. Returns the number
+        of updates actually dispatched (the ``stat_applies`` unit, so the
+        reported coalescing ratio stays honest for non-merging
+        updaters)."""
+        if len(entries) > 1 and type(self.updater) not in _ROW_LOCAL_STATE:
+            for e in entries:
+                self._apply_rows(e.local, e.vals, e.opt)
+            return len(entries)
         if len(entries) == 1:
             local, vals = entries[0].local, entries[0].vals
         else:
@@ -330,6 +340,7 @@ class RowShard:
                       .astype(np.float64))
             vals = acc.astype(self.dtype)
         self._apply_rows(local, vals, opt)
+        return 1
 
     def _apply_rows(self, local: np.ndarray, vals: np.ndarray,
                     opt: AddOption) -> None:
@@ -408,14 +419,16 @@ class RowShard:
                     groups.setdefault(
                         None if merge_all else e.opt, []).append(e)
                 with self._lock:
+                    applies = 0
                     for entries in groups.values():
                         try:
-                            self._apply_add_group(entries, entries[0].opt)
+                            applies += self._apply_add_group(
+                                entries, entries[0].opt)
                         except Exception as err:
                             for e in entries:
                                 e.error = err
                     self._stat_adds += len(batch)
-                    self._stat_applies += len(groups)
+                    self._stat_applies += applies
                 for e in batch:
                     e.event.set()
         finally:
@@ -463,6 +476,101 @@ class RowShard:
                                        self.dtype)
         return local, vals, opt
 
+    def _prep_add_entry(self, meta: Dict, arrays: Sequence[np.ndarray]
+                        ) -> _PendingAdd:
+        """One MSG_BATCH sub-op -> a validated pending entry (HashShard
+        overrides: its entries carry keys, translated at apply time)."""
+        local, vals, opt = self._prep_add(meta, arrays)
+        return _PendingAdd(local, vals, opt)
+
+    def _apply_batch_adds(self, entries: List[_PendingAdd]
+                          ) -> Tuple[List[int], List[str]]:
+        """Apply one window's adds as conflict-free WAVES: consecutive
+        entries whose row sets are disjoint (and whose opts agree, unless
+        the updater is opt-insensitive) concatenate into ONE bucketed
+        scatter; a conflicting entry closes the wave, so overlapping rows
+        still apply in arrival order with per-op arithmetic. Disjoint
+        grouping is what keeps a batched window BIT-IDENTICAL to the same
+        ops arriving as N separate frames — the queue's f64 duplicate
+        merge (:meth:`_apply_add_group`) is reserved for genuinely
+        concurrent senders, where no order was ever promised. Global-
+        state updaters (adam: one step-counter bump per apply) never
+        wave-merge: every entry applies alone, K adds = K steps.
+
+        Returns ``(failed_indices, error_strings)``: a wave that fails
+        marks ONLY its entries failed and the later waves still apply —
+        exactly window-off semantics, where each op is an independent
+        request and op K failing does not stop op K+1. The caller
+        reports failures PER SUB-OP so the client can never mistake an
+        applied delta for a lost one (a blanket error would invite a
+        retry that double-applies the deltas that DID land)."""
+        failed: List[int] = []
+        errors: List[str] = []
+        if not entries:
+            return failed, errors
+        mergeable = type(self.updater) in _ROW_LOCAL_STATE
+        merge_all = type(self.updater) in _OPT_INSENSITIVE
+        with self._lock:
+            wave: List[Tuple[int, _PendingAdd]] = []
+            seen: set = set()
+
+            def flush_wave():
+                if not wave:
+                    return
+                try:
+                    if len(wave) == 1:
+                        e = wave[0][1]
+                        self._apply_rows(e.local, e.vals, e.opt)
+                    else:
+                        self._apply_rows(
+                            np.concatenate([e.local for _, e in wave]),
+                            np.concatenate([e.vals for _, e in wave]),
+                            wave[0][1].opt)
+                    self._stat_applies += 1
+                except Exception as err:   # noqa: BLE001 — reported per op
+                    failed.extend(i for i, _ in wave)
+                    errors.append(f"{type(err).__name__}: {err}")
+                wave.clear()
+                seen.clear()
+
+            for i, e in enumerate(entries):
+                ids = e.local.tolist()
+                if wave and (not mergeable
+                             or any(x in seen for x in ids)
+                             or (not merge_all
+                                 and e.opt != wave[0][1].opt)):
+                    flush_wave()
+                wave.append((i, e))
+                seen.update(ids)
+            flush_wave()
+            self._stat_adds += len(entries)
+        return failed, errors
+
+    def _handle_batch(self, meta: Dict, arrays: Sequence[np.ndarray]
+                      ) -> Tuple[Dict, List[np.ndarray]]:
+        """One MSG_BATCH frame: the client send window's sub-ops, applied
+        in window order with one ack for the lot. Windows carry row adds
+        only (gets fence the window client-side), so anything else in a
+        batch is a framing error, not a dispatch case. Validation
+        failures (unknown sub-op type, bad ids) raise BEFORE anything
+        applies — a whole-frame error then means nothing landed; apply
+        failures after that point come back per sub-op in the reply meta
+        ("failed" indices), never as a blanket error."""
+        subs = wire.unpack_batch(arrays)
+        entries = []
+        for mt, m, arrs in subs:
+            if mt != svc.MSG_ADD_ROWS:
+                raise svc.PSError(
+                    f"{self.name}: batch frames carry MSG_ADD_ROWS only "
+                    f"(got type {mt})")
+            entries.append(self._prep_add_entry(m, arrs))
+        failed, errors = self._apply_batch_adds(entries)
+        rmeta: Dict = {"n": len(subs)}
+        if failed:
+            rmeta["failed"] = failed
+            rmeta["error"] = "; ".join(errors[:3])
+        return rmeta, []
+
     def _add_rows(self, local: np.ndarray, vals: np.ndarray,
                   opt: AddOption) -> None:
         if self._native_ref is not None:
@@ -494,6 +602,9 @@ class RowShard:
             local, vals, opt = self._prep_add(meta, arrays)
             self._add_rows(local, vals, opt)
             return {}, []
+        if msg_type == svc.MSG_BATCH:
+            # a client send window: N logical adds in one frame, one ack
+            return self._handle_batch(meta, arrays)
         if msg_type == svc.MSG_GET_ROWS and meta.get("sparse"):
             # stale-only reply for meta["worker_id"] (ref matrix.cpp
             # :475-483 GetOption.worker_id + :540-572 stale filter)
@@ -671,6 +782,25 @@ class HashShard(RowShard):
         interleave between translation and apply)."""
         super()._apply_rows(self._slots_for(keys), vals, opt)
 
+    def _validate_keys(self, arr) -> np.ndarray:
+        """Shared key validation (per-op adds, batched sub-ops, gets)."""
+        keys = np.asarray(arr, np.int64)
+        if keys.size == 0:
+            raise IndexError(f"{self.name}: empty key batch")
+        if np.any(keys < 0):
+            raise IndexError(f"{self.name}: negative keys")
+        return keys
+
+    def _prep_add_entry(self, meta: Dict, arrays: Sequence[np.ndarray]
+                        ) -> _PendingAdd:
+        """Batched sub-ops carry KEYS (validated here); key -> slot
+        translation stays at apply time inside :meth:`_apply_rows`,
+        atomic with the update (same rule as the coalescing queue)."""
+        keys = self._validate_keys(arrays[0])
+        opt = AddOption(**meta.get("opt", {}))
+        vals = np.asarray(arrays[1], self.dtype)[: keys.size]
+        return _PendingAdd(keys, vals, opt)
+
     def _slots_for(self, keys: np.ndarray) -> np.ndarray:
         """key -> slot, allocating unseen keys (under the caller's lock)."""
         out = np.empty(keys.size, np.int64)
@@ -699,14 +829,8 @@ class HashShard(RowShard):
             # atomic with the update — slots resolved at enqueue time
             # could go stale if a checkpoint restore rebuilds the slot map
             # in between
-            keys = np.asarray(arrays[0], np.int64)
-            if keys.size == 0:
-                raise IndexError(f"{self.name}: empty key batch")
-            if np.any(keys < 0):
-                raise IndexError(f"{self.name}: negative keys")
-            opt = AddOption(**meta.get("opt", {}))
-            vals = np.asarray(arrays[1], self.dtype)[: keys.size]
-            self._add_rows(keys, vals, opt)
+            entry = self._prep_add_entry(meta, arrays)
+            self._add_rows(entry.local, entry.vals, entry.opt)
             return {}, []
         with self._lock:   # reentrant: key->slot stays atomic w/ the update
             if msg_type == svc.MSG_GET_STATE and meta.get("dump"):
@@ -714,11 +838,7 @@ class HashShard(RowShard):
             if msg_type == svc.MSG_SET_STATE and meta.get("dump"):
                 return self._restore(arrays)
             if msg_type in (svc.MSG_GET_ROWS, svc.MSG_SET_ROWS):
-                keys = np.asarray(arrays[0], np.int64)
-                if keys.size == 0:
-                    raise IndexError(f"{self.name}: empty key batch")
-                if np.any(keys < 0):
-                    raise IndexError(f"{self.name}: negative keys")
+                keys = self._validate_keys(arrays[0])
                 if msg_type == svc.MSG_GET_ROWS and not meta.get("sparse"):
                     # allocation-free read: unknown keys gather the scratch
                     # row, which is invariantly zeros (padded adds apply

@@ -20,6 +20,8 @@ from __future__ import annotations
 
 import concurrent.futures as cf
 import threading
+import time
+import weakref
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -30,7 +32,7 @@ from multiverso_tpu.ps import wire as wire_mod
 from multiverso_tpu.ps.shard import KVShard, RowShard
 from multiverso_tpu.updaters import AddOption
 from multiverso_tpu.utils import config, log
-from multiverso_tpu.utils.dashboard import monitor
+from multiverso_tpu.utils.dashboard import Dashboard, monitor
 
 
 # ---------------------------------------------------------------------- #
@@ -214,8 +216,14 @@ def _dedupe_batch(row_ids, num_col: int, dtype,
         raise IndexError("row ids/keys must be non-negative")
     if bound is not None and ids.max() >= bound:
         raise IndexError(f"row id out of range [0, {bound})")
-    s = np.sort(ids)
-    if ids.size == 1 or not np.any(s[1:] == s[:-1]):
+    # the sort only exists to detect duplicates — skip it for the 1-row
+    # small-add hot path
+    if ids.size == 1:
+        has_dups = False
+    else:
+        s = np.sort(ids)
+        has_dups = bool(np.any(s[1:] == s[:-1]))
+    if not has_dups:
         vals = (None if values is None
                 else np.asarray(values, dtype).reshape(ids.size, num_col))
         # own the ids: np.asarray above is zero-copy for int64 input, but
@@ -233,6 +241,296 @@ def _dedupe_batch(row_ids, num_col: int, dtype,
     acc = np.zeros((uids.size, num_col), np.float64)
     np.add.at(acc, inv, vals.astype(np.float64))
     return uids, acc.astype(dtype), inv
+
+
+def _window_loop(ref: "weakref.ref") -> None:
+    """Flusher thread body. Holds the window only through a WEAKREF,
+    re-resolved each cycle: when the table (and its window) are
+    garbage-collected the thread simply exits at its next bounded
+    wakeup — a windowed table must not be pinned in memory (with its
+    conns and monitors) for process lifetime by its own daemon thread."""
+    while True:
+        win = ref()
+        if win is None:
+            return
+        step = win._step
+        del win
+        step()
+        # drop the bound method too — it strongly references the window,
+        # and anything still held here across the next wait would keep
+        # ref() alive forever
+        del step
+
+
+def _complete_window_futures(batch_fut: cf.Future,
+                             group_futs: List[List[cf.Future]]) -> None:
+    """Fan a window frame's single ack out to the per-entry placeholder
+    futures the callers are tracking (runs on the peer's recv thread).
+    ``group_futs`` is aligned with the frame's sub-ops: a partially
+    applied batch reports per-sub-op failures in the reply meta
+    ("failed" indices), and only THOSE futures carry the error — a
+    delta that was durably applied must never be reported lost, or a
+    caller honoring the lost-delta contract would re-issue it and
+    double-apply."""
+    exc: Optional[BaseException] = None
+    meta: Dict = {}
+    try:
+        exc = batch_fut.exception()
+        if exc is None:
+            res = batch_fut.result()
+            if isinstance(res, tuple) and isinstance(res[0], dict):
+                meta = res[0]
+    except (cf.CancelledError, Exception) as e:   # defensive
+        exc = e
+    failed = set(meta.get("failed", ()))
+    ferr = (svc.PSError("batched add failed at the shard: "
+                        f"{meta.get('error', '?')}") if failed else None)
+    for i, futs in enumerate(group_futs):
+        for f in futs:
+            if f.done():
+                continue
+            if exc is not None:
+                f.set_exception(exc)
+            elif i in failed:
+                f.set_exception(ferr)
+            else:
+                f.set_result(({}, []))
+
+
+class _SendWindow:
+    """Client-side cross-call add coalescer (the PS *send window*), one
+    per windowed table: ``add_rows_async`` enqueues per-owner entries and
+    returns immediately; a time/byte/op-bounded flusher ships each
+    owner's pending adds as ONE frame — a plain MSG_ADD_ROWS when the
+    whole window merged into one logical op, a MSG_BATCH multi-op frame
+    otherwise — so a window costs one round-trip and one batched shard
+    apply instead of one per call (the classic PS client-side batching
+    lever, Li et al. OSDI'14; BytePS's fused small-tensor transfers).
+
+    Exactness: queued entries merge into a single sub-op ONLY when the
+    merge is bit-transparent — same effective AddOption, pairwise-
+    disjoint row sets, an elementwise wire ("none"/"bf16"), a row-local-
+    state updater (``updaters.ROW_LOCAL_STATE``; adam's global step
+    counter advances once per apply, so adam never merges); everything
+    else stays its own sub-op (its own meta + codec payload) and the
+    shard applies the sub-ops in order as conflict-free waves
+    (``shard._apply_batch_adds``). Windowed results are therefore
+    BIT-IDENTICAL to window-off — the fuzz tests assert it.
+
+    Ordering: each owner's frames leave in enqueue order on the owner's
+    ordinary python conn — senders serialize on a per-owner SEND lock
+    (taken before popping the queue, so a later sender always ships a
+    later batch), while the window lock itself is never held across a
+    socket send: an ``add_rows_async`` enqueue can never block behind an
+    in-progress flush. A caller that fences (:meth:`flush_pending`) and
+    then issues a get on the same conn reads its own writes — per-conn
+    FIFO at the server does the rest; the fence does NOT wait for acks."""
+
+    def __init__(self, table, window_ms: float, max_bytes: int,
+                 max_ops: int):
+        # weak: the table owns the window, not vice versa — a strong
+        # backref would make table lifetime depend on cyclic GC racing
+        # the flusher thread's per-step strong ref (the thread exits by
+        # observing ITS weakref die, see _window_loop)
+        self._table_ref = weakref.ref(table)
+        self._table_name = table.name
+        self.window_s = float(window_ms) / 1e3
+        self.max_bytes = int(max_bytes)
+        self.max_ops = int(max_ops)
+        self._cv = threading.Condition()
+        # owner -> [(ids, vals, opt, placeholder future)], enqueue order
+        self._pending: Dict[int, List[Tuple]] = {}
+        self._nbytes: Dict[int, int] = {}
+        self._send_locks: Dict[int, threading.Lock] = {}
+        self._deadline: Optional[float] = None
+        self._thread: Optional[threading.Thread] = None
+        base = f"table[{table.name}].add_rows"
+        self._mon_windowed = Dashboard.get(base + ".windowed")
+        self._mon_flushes = Dashboard.get(base + ".flushes")
+        self._mon_merged = Dashboard.get(base + ".merged_rows")
+
+    # ------------------------------------------------------------------ #
+    def submit(self, parts: List[Tuple[int, np.ndarray, np.ndarray]],
+               opt: AddOption) -> List[cf.Future]:
+        """Queue ONE logical add's per-owner pieces; returns one
+        placeholder future per owner (completed by the window ack)."""
+        self._mon_windowed.incr()
+        return [self._enqueue(r, ids, vals, opt) for r, ids, vals in parts]
+
+    def _enqueue(self, owner: int, ids: np.ndarray, vals: np.ndarray,
+                 opt: AddOption) -> cf.Future:
+        fut: cf.Future = cf.Future()
+        ship = False
+        with self._cv:
+            q = self._pending.setdefault(owner, [])
+            q.append((ids, vals, opt, fut))
+            self._nbytes[owner] = (self._nbytes.get(owner, 0)
+                                   + ids.nbytes + vals.nbytes)
+            if (len(q) >= self.max_ops
+                    or self._nbytes[owner] >= self.max_bytes):
+                ship = True   # bound hit: ship now, on this thread
+            elif self._deadline is None:
+                # arm the window and wake the flusher ONLY then — a
+                # notify per enqueue would cost a thread wakeup (~70 us)
+                # on every small add for nothing: the flusher's existing
+                # wait already covers an armed deadline
+                self._deadline = time.monotonic() + self.window_s
+                if self._thread is None:
+                    self._thread = threading.Thread(
+                        target=_window_loop, args=(weakref.ref(self),),
+                        daemon=True,
+                        name=f"ps-window-{self._table_name}")
+                    self._thread.start()
+                self._cv.notify()
+        if ship:
+            self._flush_owner(owner)
+        return fut
+
+    def flush_pending(self) -> None:
+        """Send every queued add NOW — the ordering fence gets / flush /
+        overwrites run before dispatching their own frames. On return,
+        every entry queued BEFORE the call is on its conn. The sweep
+        covers every owner ever sent to, not just those currently
+        pending: a concurrent flusher may have POPPED an owner's queue
+        but not yet reached the socket, and the fence must wait that
+        send out (acquiring the owner's send lock does exactly that) —
+        skipping absent owners would let the caller's next frame
+        overtake the popped batch. Uncontended, a spare owner costs one
+        lock acquire (~100 ns)."""
+        with self._cv:
+            owners = set(self._pending) | set(self._send_locks)
+            self._deadline = None
+        for owner in owners:
+            self._flush_owner(owner)
+
+    # idle condvar waits are bounded so the flusher can notice its window
+    # died (see _window_loop's weakref) instead of pinning it forever
+    _IDLE_WAIT_S = 5.0
+
+    def _step(self) -> bool:
+        """One flusher cycle: wait out the open window (or idle,
+        bounded), then ship everything pending. Returns False only on a
+        spurious/idle wakeup with nothing to do."""
+        with self._cv:
+            if self._deadline is None:
+                self._cv.wait(self._IDLE_WAIT_S)
+                return False
+            delay = self._deadline - time.monotonic()
+            if delay > 0:
+                self._cv.wait(min(delay, self._IDLE_WAIT_S))
+                return False
+            self._deadline = None
+            owners = list(self._pending)
+        for owner in owners:
+            self._flush_owner(owner)
+        return True
+
+    # ------------------------------------------------------------------ #
+    def _send_lock(self, owner: int) -> threading.Lock:
+        with self._cv:
+            lock = self._send_locks.get(owner)
+            if lock is None:
+                lock = self._send_locks[owner] = threading.Lock()
+            return lock
+
+    def _flush_owner(self, owner: int) -> None:
+        """Merge + ship one owner's queue as one frame. The send lock is
+        taken BEFORE popping: concurrent senders to the same owner
+        serialize pop-and-send as a unit, so frames leave in enqueue
+        order and a fence returning means the batch is on the conn. The
+        window lock is only pinched for the pop — enqueues stay
+        wait-free while the socket send runs."""
+        with self._send_lock(owner):
+            with self._cv:
+                entries = self._pending.pop(owner, None)
+                self._nbytes.pop(owner, None)
+            if entries:
+                self._send(owner, entries)
+
+    def _send(self, owner: int, entries: List[Tuple]) -> None:
+        t = self._table_ref()
+        if t is None:
+            # table died with queued adds (caller dropped it without a
+            # flush): nobody can await these futures, but fail them
+            # anyway so any stray holder sees a typed error, not a hang
+            err = svc.PSError(
+                f"table[{self._table_name}] was garbage-collected with "
+                "windowed adds still queued")
+            for _, _, _, fut in entries:
+                if not fut.done():
+                    fut.set_exception(err)
+            return
+        w = t._wire_for(owner)
+        # merging conditions, ALL required for bit-transparency: an
+        # elementwise wire ("none"/"bf16" — 1bit/topk mix values across
+        # block/top-k structure, so each op keeps its own codec payload),
+        # disjoint row sets, a row-local-state updater (adam's global
+        # step counter advances once per APPLY — a merge would miscount),
+        # and matching AddOptions (unless the updater never reads them)
+        exact = (w in ("none", "bf16")
+                 and type(t.updater) in updaters_lib.ROW_LOCAL_STATE)
+        merge_all = type(t.updater) in updaters_lib.OPT_INSENSITIVE
+        groups: List[List] = []   # [ids[], vals[], opt, futs[], idset]
+        merged_rows = 0
+        for ids, vals, opt, fut in entries:
+            g = groups[-1] if groups else None
+            if (g is not None and exact
+                    and (merge_all or opt == g[2])
+                    and not g[4].intersection(ids.tolist())):
+                g[0].append(ids)
+                g[1].append(vals)
+                g[3].append(fut)
+                g[4].update(ids.tolist())
+                merged_rows += int(ids.size)
+            else:
+                groups.append([[ids], [vals], opt, [fut],
+                               set(ids.tolist())])
+        try:
+            packed = [(np.concatenate(g[0]) if len(g[0]) > 1 else g[0][0],
+                       np.concatenate(g[1]) if len(g[1]) > 1 else g[1][0],
+                       g[2]) for g in groups]
+        except Exception as e:   # merge failure must not orphan waiters
+            for g in groups:
+                for f in g[3]:
+                    if not f.done():
+                        f.set_exception(e)
+            return
+        # a window can outgrow one frame (knob raced/misconfigured past
+        # the wire bound): ship in MAX_BATCH_OPS chunks, in order on the
+        # same conn — never fail the whole window over frame capacity
+        for i0 in range(0, len(packed), wire_mod.MAX_BATCH_OPS):
+            chunk = packed[i0:i0 + wire_mod.MAX_BATCH_OPS]
+            gfuts = [g[3] for g in groups[i0:i0 + wire_mod.MAX_BATCH_OPS]]
+            futs = [f for fs in gfuts for f in fs]
+            try:
+                if len(chunk) == 1:
+                    ids, vals, opt = chunk[0]
+                    meta = {"table": t.name, "opt": opt._asdict()}
+                    if w != "none":
+                        meta["wire"] = w
+                    req = t.ctx.service.request(
+                        owner, svc.MSG_ADD_ROWS, meta,
+                        [ids] + wire_mod.encode_payload(vals, w),
+                        meta_b=t._add_meta_b(opt, w))
+                else:
+                    blobs = [wire_mod.encode(
+                        svc.MSG_ADD_ROWS, i, t._add_meta_b(opt, w),
+                        [ids] + wire_mod.encode_payload(vals, w))
+                        for i, (ids, vals, opt) in enumerate(chunk)]
+                    req = t.ctx.service.request(
+                        owner, svc.MSG_BATCH,
+                        {"table": t.name, "n": len(chunk)},
+                        wire_mod.pack_batch(blobs))
+            except Exception as e:   # encode failure must not orphan waiters
+                for f in futs:
+                    if not f.done():
+                        f.set_exception(e)
+                continue
+            self._mon_flushes.incr()
+            req.add_done_callback(
+                lambda bf, gf=gfuts: _complete_window_futures(bf, gf))
+        if merged_rows:
+            self._mon_merged.incr(merged_rows)
 
 
 def _maybe_register_in_zoo(table) -> Optional[int]:
@@ -259,10 +557,59 @@ class _AsyncBase:
         self._pending: Dict[int, Tuple[List[cf.Future], Any]] = {}
         self._next_msg_id = 0
         self._lock = threading.Lock()
+        self._meta_cache: Dict[Any, bytes] = {}
+        # client send window (flag batch_window_ms / per-table override);
+        # None = every add ships immediately (the default)
+        self._window: Optional[_SendWindow] = None
         # failures of already-swept fire-and-forget ops, kept so flush()
         # can surface them deterministically (sweep timing must not decide
         # whether a lost delta is seen)
         self._swept_failures: List[Exception] = []
+
+    def _wire_for(self, rank: int) -> str:
+        """Wire codec per destination rank (overridden by tables with a
+        compressed wire; hash/KV tables always send raw)."""
+        return "none"
+
+    def _add_meta_b(self, opt: AddOption, wire: str = "none") -> bytes:
+        """Packed add meta, cached per (AddOption, wire) (one
+        serialization per distinct opt instead of one per op)."""
+        key = (opt, wire)
+        b = self._meta_cache.get(key)
+        if b is None:
+            meta = {"table": self.name, "opt": opt._asdict()}
+            if wire != "none":
+                meta["wire"] = wire
+            b = wire_mod.pack_meta(meta)
+            if len(self._meta_cache) < 64:
+                self._meta_cache[key] = b
+        return b
+
+    def _make_window(self, send_window_ms: Optional[float]) -> None:
+        """Install the send window when enabled (per-table override wins
+        over the batch_window_ms flag; <= 0 stays off)."""
+        wm = (config.get_flag("batch_window_ms") if send_window_ms is None
+              else float(send_window_ms))
+        if wm > 0:
+            self._window = _SendWindow(
+                self, wm, config.get_flag("batch_window_bytes"),
+                # the wire refuses frames over MAX_BATCH_OPS sub-ops; a
+                # knob set past it must not make windows unsendable
+                min(config.get_flag("batch_window_ops"),
+                    wire_mod.MAX_BATCH_OPS))
+
+    def _flush_window(self) -> None:
+        """Ordering fence: ship any queued windowed adds before the
+        caller dispatches an op that must observe them (no-op when the
+        window is off or empty)."""
+        if self._window is not None:
+            self._window.flush_pending()
+
+    # sweep trigger: scanning every outstanding future on every _track is
+    # O(in-flight) per op (quadratic across a burst of small adds); under
+    # this many pending ops the scan is deferred — memory stays bounded,
+    # and flush() still surfaces every failure deterministically
+    _SWEEP_THRESHOLD = 32
 
     def _track(self, futures: List[cf.Future], finalize=None) -> int:
         with self._lock:
@@ -271,8 +618,9 @@ class _AsyncBase:
             # every later op on the table with a dead peer's stale error,
             # breaking the "live-shard traffic unaffected" contract (a
             # caller who cares about an add's outcome calls wait())
-            done = [mid for mid, (futs, fin) in self._pending.items()
-                    if fin is None and all(f.done() for f in futs)]
+            done = ([mid for mid, (futs, fin) in self._pending.items()
+                     if fin is None and all(f.done() for f in futs)]
+                    if len(self._pending) >= self._SWEEP_THRESHOLD else ())
             for mid in done:
                 futs, _ = self._pending.pop(mid)
                 for f in futs:
@@ -292,6 +640,15 @@ class _AsyncBase:
         gets, returns the assembled host array; for adds, None. Raises
         :class:`~multiverso_tpu.ps.service.PSPeerError` if an owning rank
         died — other tables/ops remain usable."""
+        # a waited op may still be queued in the send window — ship it
+        # (its placeholder futures complete on the window ack)
+        self._flush_window()
+        return self._wait_tracked(msg_id)
+
+    def _wait_tracked(self, msg_id: int) -> Any:
+        """:meth:`wait` minus the window fence — for callers that already
+        fenced (flush waits many ops behind ONE fence instead of paying
+        a per-owner send-lock sweep per op)."""
         with self._lock:
             entry = self._pending.pop(msg_id, None)
         if entry is None:
@@ -310,10 +667,11 @@ class _AsyncBase:
         still pending or was already swept — a lost delta is reported
         deterministically, not only when sweep timing happens to expose
         it."""
+        self._flush_window()
         with self._lock:
             ids = list(self._pending)
         for mid in ids:
-            self.wait(mid)
+            self._wait_tracked(mid)
         with self._lock:
             failures, self._swept_failures = self._swept_failures, []
         if failures:
@@ -337,6 +695,7 @@ class AsyncMatrixTable(_AsyncBase):
                  init: Optional[np.ndarray] = None,
                  seed: Optional[int] = None, init_scale: float = 0.0,
                  shard_workers: int = 0, wire: str = "none",
+                 send_window_ms: Optional[float] = None,
                  ctx: Optional[svc.PSContext] = None):
         """``shard_workers > 0`` enables per-worker dirty-bit tracking on
         the owned shard (the sparse stale-row protocol; set by
@@ -350,11 +709,19 @@ class AsyncMatrixTable(_AsyncBase):
         sets change between batches, so a positional residual has no
         stable meaning there), and get replies as bf16 (parameter VALUES
         are not deltas; sign-quantizing them would be destructive —
-        same rule as the sync table's 1bit mode). All encodes go through
+        same rule as the sync table's 1bit mode). ``wire="topk"`` is the
+        same shape with the ~3% largest-|x| entries exact (QSGD-style)
+        instead of sign bits. All encodes go through
         ``ps/wire.encode_payload``: the frame blobs ARE the codec
-        output, decoded exactly once at the receiving shard."""
+        output, decoded exactly once at the receiving shard.
+
+        ``send_window_ms`` overrides the ``batch_window_ms`` flag for
+        this table: > 0 buffers ``add_rows_async`` client-side and ships
+        each owner's queue as one (multi-op) frame — see _SendWindow.
+        Gets/flush/waits fence the window, so results are bit-identical
+        to window-off; only the moment an add reaches the wire changes."""
         super().__init__(ctx, name)
-        if wire not in ("none", "bf16", "1bit"):
+        if wire not in ("none", "bf16", "1bit", "topk"):
             raise ValueError(f"unknown wire {wire!r}")
         self._wire = wire
         # per-owner error-feedback residuals for 1bit whole-table adds
@@ -393,13 +760,19 @@ class AsyncMatrixTable(_AsyncBase):
         self._native_ok = (wire == "none" and shard_workers == 0
                            and self.dtype.str in ("<f4", "<f8")
                            and self.ctx.service.native_enabled())
-        self._meta_cache: Dict[Any, bytes] = {}
         self._plain_meta_b = wire_mod.pack_meta({"table": self.name})
         # identical on every rank: (rank, lo, hi) of each non-empty shard
         self._ranges = [(r, min(r * self._rows_per, self.num_row),
                          min((r + 1) * self._rows_per, self.num_row))
                         for r in range(world)]
         self._ranges = [(r, a, b) for r, a, b in self._ranges if b > a]
+        self._make_window(send_window_ms)
+        if self._window is not None:
+            # windowed adds ride the python conns; every other op must
+            # share that per-conn FIFO for the fence to mean
+            # read-your-writes, so the native fast path (its own socket =
+            # no cross-plane ordering) stays off for this table
+            self._native_ok = False
         self.table_id = _maybe_register_in_zoo(self)
 
     # ------------------------------------------------------------------ #
@@ -423,28 +796,15 @@ class AsyncMatrixTable(_AsyncBase):
         return "none" if rank == self.ctx.rank else self._wire
 
     def _reply_wire(self) -> str:
-        """Reply wire for gets, rank-independent: 1bit applies to DELTAS
-        (add traffic); parameter values ride bf16 instead (sync-table
-        rule). THE one place that rule lives."""
-        return "bf16" if self._wire == "1bit" else self._wire
+        """Reply wire for gets, rank-independent: 1bit/topk apply to
+        DELTAS (add traffic); parameter values ride bf16 instead —
+        sparsifying a pulled VALUE block would zero ~97% of the weights
+        (sync-table rule). THE one place that rule lives."""
+        return "bf16" if self._wire in ("1bit", "topk") else self._wire
 
     def _get_wire_for(self, rank: int) -> str:
         """Reply wire per source rank (local short-circuit stays raw)."""
         return "none" if rank == self.ctx.rank else self._reply_wire()
-
-    def _add_meta_b(self, opt: AddOption, wire: str = "none") -> bytes:
-        """Packed add meta, cached per (AddOption, wire) (one
-        serialization per distinct opt instead of one per op)."""
-        key = (opt, wire)
-        b = self._meta_cache.get(key)
-        if b is None:
-            meta = {"table": self.name, "opt": opt._asdict()}
-            if wire != "none":
-                meta["wire"] = wire
-            b = wire_mod.pack_meta(meta)
-            if len(self._meta_cache) < 64:
-                self._meta_cache[key] = b
-        return b
 
     def _owner_conns(self, uids: np.ndarray):
         """Native conns for the C-side fanout, indexed by rank. ONLY the
@@ -485,6 +845,26 @@ class AsyncMatrixTable(_AsyncBase):
         self._zoo_dirty()
         with monitor(f"table[{self.name}].add_rows"):
             uids, vals, _ = self._prep(row_ids, values)
+            if self._window is not None:
+                # send window: enqueue per-owner pieces and return — the
+                # flusher (or the next fencing op) ships each owner's
+                # queue as ONE (multi-op) frame. Single-owner batches (the
+                # 1-row small-add hot path) skip the mask partitioning.
+                owners = uids // self._rows_per
+                r0 = int(owners[0])
+                if uids.size == 1 or not np.any(owners != r0):
+                    # the queue reads vals LATER (flusher thread), so it
+                    # must own the bytes: _prep's no-dup path can return
+                    # a zero-copy view of the caller's buffer, and a
+                    # reused gradient scratch would corrupt queued deltas
+                    # (mask slicing below always copies)
+                    if vals is values or vals.base is not None:
+                        vals = vals.copy()
+                    parts = [(r0, uids, vals)]
+                else:
+                    parts = [(r, uids[m], vals[m])
+                             for r, m in self._by_owner(uids)]
+                return self._track(self._window.submit(parts, opt))
             meta_b = self._add_meta_b(opt)
             if self._native_ok and vals.dtype == self.dtype:
                 from multiverso_tpu.ps import native as ps_native
@@ -511,22 +891,42 @@ class AsyncMatrixTable(_AsyncBase):
                  opt: Optional[AddOption] = None) -> None:
         self.wait(self.add_rows_async(row_ids, values, opt))
 
-    def get_rows_async(self, row_ids) -> int:
+    def _reply_buffer(self, out: Optional[np.ndarray], rows: int
+                      ) -> np.ndarray:
+        """Scatter target for a get's per-owner replies: the CALLER's
+        buffer when it can take them directly (right shape/dtype,
+        C-contiguous), else a fresh array. Avoids the extra (rows x cols)
+        allocation + copy per get on the steady-state training loop."""
+        if (out is not None and isinstance(out, np.ndarray)
+                and out.dtype == self.dtype
+                and out.shape == (rows, self.num_col)
+                and out.flags.c_contiguous):
+            return out
+        return np.empty((rows, self.num_col), self.dtype)
+
+    def get_rows_async(self, row_ids,
+                       out: Optional[np.ndarray] = None) -> int:
+        # ordering fence: a get must observe every windowed add this
+        # caller already issued (read-your-writes over per-conn FIFO)
+        self._flush_window()
         with monitor(f"table[{self.name}].get_rows"):
             uids, _, inv = self._prep(row_ids)
             if self._native_ok:
                 from multiverso_tpu.ps import native as ps_native
-                out = np.empty((uids.size, self.num_col), self.dtype)
+                # no duplicate ids: the C++ recv threads scatter replies
+                # straight into the caller's buffer
+                buf = self._reply_buffer(out if inv is None else None,
+                                         uids.size)
                 fparts = ps_native.get_fanout(
                     self._owner_conns(uids), self.ctx.world, False,
-                    self._rows_per, self._plain_meta_b, uids, out)
+                    self._rows_per, self._plain_meta_b, uids, buf)
                 futs = _fanout_futures(
-                    fparts, lambda c, s, m: _NativeGetFuture(c, m, out))
+                    fparts, lambda c, s, m: _NativeGetFuture(c, m, buf))
 
                 def _assemble_native(results):
-                    # replies scattered into ``out`` in the C++ recv
+                    # replies scattered into ``buf`` in the C++ recv
                     # threads; results only carry completion
-                    return out if inv is None else out[inv]
+                    return buf if inv is None else buf[inv]
 
                 return self._track(futs, _assemble_native)
             parts = list(self._by_owner(uids))
@@ -541,21 +941,29 @@ class AsyncMatrixTable(_AsyncBase):
                     for r, m in parts]
 
             def _assemble(results):
-                out = np.empty((uids.size, self.num_col), self.dtype)
+                buf = self._reply_buffer(out if inv is None else None,
+                                         uids.size)
                 for (r, m), (_, arrays) in zip(parts, results):
                     w = "none" if r == self.ctx.rank else gw
-                    out[m] = wire_mod.decode_payload(
+                    buf[m] = wire_mod.decode_payload(
                         arrays, w, (int(np.count_nonzero(m)),
                                     self.num_col), self.dtype)
-                # re-expand duplicates to original order (None = no dups)
-                return out if inv is None else out[inv]
+                if inv is None:
+                    return buf
+                # re-expand duplicates to original order, into the
+                # caller's buffer when it fits
+                dest = self._reply_buffer(out, inv.size)
+                np.take(buf, inv, axis=0, out=dest)
+                return dest
 
         return self._track(futs, _assemble)
 
     def get_rows(self, row_ids, out: Optional[np.ndarray] = None
                  ) -> np.ndarray:
-        host = self.wait(self.get_rows_async(row_ids))
-        if out is not None:
+        host = self.wait(self.get_rows_async(row_ids, out=out))
+        if out is not None and host is not out:
+            # fallback for shape/dtype/layout mismatches the reply
+            # scatter could not take directly
             np.copyto(out.reshape(host.shape), host)
             return out
         return host
@@ -583,8 +991,10 @@ class AsyncMatrixTable(_AsyncBase):
         if np.any((uids < 0) | (uids >= self.num_row)):
             raise IndexError(f"row id out of range [0, {self.num_row})")
         # order fence: earlier native adds must be acked before this
-        # overwrite travels the python conn (different sockets = no FIFO)
+        # overwrite travels the python conn (different sockets = no FIFO),
+        # and queued windowed adds must leave first (same-conn FIFO)
         self._native_flush()
+        self._flush_window()
         meta = {"table": self.name}
         futs = [self.ctx.service.request(r, svc.MSG_SET_ROWS, meta,
                                          [uids[m], vals[m]])
@@ -597,6 +1007,9 @@ class AsyncMatrixTable(_AsyncBase):
     def add_async(self, delta, opt: Optional[AddOption] = None) -> int:
         opt = opt or AddOption(worker_id=self.ctx.rank)
         self._zoo_dirty()
+        # fence: queued windowed row adds must land before a whole-table
+        # delta (floating-point accumulation does not commute bit-wise)
+        self._flush_window()
         with monitor(f"table[{self.name}].add"):
             delta = np.ascontiguousarray(
                 np.asarray(delta, self.dtype).reshape(self.shape))
@@ -626,6 +1039,23 @@ class AsyncMatrixTable(_AsyncBase):
                                 block=wire_mod.ONEBIT_BLOCK)
                         _, bits, scales = filt.filter_in(delta[a:b])
                     arrays = [bits, scales]
+                elif w == "topk":
+                    # same per-owner error-feedback rule as 1bit: the
+                    # slice shape is fixed, so residual positions are
+                    # stable — without the filter the ~97% of gradient
+                    # mass off the top-k support would be PERMANENTLY
+                    # dropped every call (unbounded systematic bias); the
+                    # stateless encode is only for row batches, whose row
+                    # sets change between calls
+                    from multiverso_tpu.utils.filters import (TopKFilter,
+                                                              default_topk)
+                    with self._add_filter_lock:
+                        filt = self._add_filters.get(r)
+                        if filt is None:
+                            filt = self._add_filters[r] = TopKFilter(
+                                default_topk((b - a) * self.num_col))
+                        _, idx, topv = filt.filter_in(delta[a:b])
+                    arrays = [idx, topv]
                 else:
                     arrays = wire_mod.encode_payload(delta[a:b], w)
                 meta = {"table": self.name, "opt": opt._asdict()}
@@ -640,6 +1070,7 @@ class AsyncMatrixTable(_AsyncBase):
         self.wait(self.add_async(delta, opt))
 
     def get_async(self) -> int:
+        self._flush_window()   # read-your-writes for windowed adds
         with monitor(f"table[{self.name}].get"):
             ranges = list(self._ranges)
             if self._native_ok:
@@ -796,6 +1227,7 @@ class _SparseGetMixin:
         double-buffer pattern, ref async_buffer.h + matrix.cpp:407-418)."""
         worker_id = self.ctx.rank if worker_id is None else worker_id
         cache, cache_lock, seqs = self._worker_cache(worker_id)
+        self._flush_window()   # read-your-writes for windowed adds
         with monitor(f"table[{self.name}].get_rows_sparse"):
             uids, _, inv = self._prep(row_ids)
             parts = list(self._by_owner(uids))
@@ -881,13 +1313,15 @@ class AsyncSparseMatrixTable(_SparseGetMixin, AsyncMatrixTable):
                  updater=None, name: str = "async_sparse_matrix",
                  init=None, seed=None, init_scale: float = 0.0,
                  num_workers: Optional[int] = None,
+                 send_window_ms: Optional[float] = None,
                  ctx: Optional[svc.PSContext] = None):
         ctx = ctx if ctx is not None else svc.default_context()
         self._n_workers = num_workers or max(ctx.world, 1)
         super().__init__(num_row, num_col, dtype=dtype, updater=updater,
                          name=name, init=init, seed=seed,
                          init_scale=init_scale,
-                         shard_workers=self._n_workers, ctx=ctx)
+                         shard_workers=self._n_workers,
+                         send_window_ms=send_window_ms, ctx=ctx)
         self._caches: Dict[int, Any] = {}
         self._caches_lock = threading.Lock()
         self._pull_seq = 0
@@ -910,6 +1344,7 @@ class AsyncSparseKVTable(_SparseGetMixin, _AsyncBase):
                  name: str = "async_sparse_kv",
                  num_row: Optional[int] = None,
                  num_workers: Optional[int] = None,
+                 send_window_ms: Optional[float] = None,
                  ctx: Optional[svc.PSContext] = None):
         super().__init__(ctx, name)
         self.num_col = int(num_col)
@@ -925,6 +1360,7 @@ class AsyncSparseKVTable(_SparseGetMixin, _AsyncBase):
         self._caches_lock = threading.Lock()
         self._pull_seq = 0
         self.last_transfer_rows = -1
+        self._make_window(send_window_ms)
         self.table_id = _maybe_register_in_zoo(self)
 
     def raw(self):
@@ -947,6 +1383,21 @@ class AsyncSparseKVTable(_SparseGetMixin, _AsyncBase):
         self._zoo_dirty()
         with monitor(f"table[{self.name}].add_rows"):
             uids, vals, _ = self._prep(keys, values)
+            if self._window is not None:
+                # send window: per-owner key batches queue and ship as
+                # one (multi-op) frame — see _SendWindow. Single-owner
+                # batches skip the mask partitioning (small-add hot path).
+                owners = uids % self.ctx.world
+                r0 = int(owners[0])
+                if uids.size == 1 or not np.any(owners != r0):
+                    # deferred read: own the bytes (see the matrix table)
+                    if vals is values or vals.base is not None:
+                        vals = vals.copy()
+                    parts = [(r0, uids, vals)]
+                else:
+                    parts = [(r, uids[m], vals[m])
+                             for r, m in self._by_owner(uids)]
+                return self._track(self._window.submit(parts, opt))
             meta = {"table": self.name, "opt": opt._asdict()}
             meta_b = wire_mod.pack_meta(meta)
             futs = [self.ctx.service.request(r, svc.MSG_ADD_ROWS, meta,
@@ -960,6 +1411,7 @@ class AsyncSparseKVTable(_SparseGetMixin, _AsyncBase):
         self.wait(self.add_rows_async(keys, values, opt))
 
     def get_rows_async(self, keys) -> int:
+        self._flush_window()   # read-your-writes for windowed adds
         with monitor(f"table[{self.name}].get_rows"):
             uids, _, inv = self._prep(keys)
             parts = list(self._by_owner(uids))
@@ -992,6 +1444,7 @@ class AsyncSparseKVTable(_SparseGetMixin, _AsyncBase):
     def store(self, stream) -> None:
         """(keys, rows, per-key updater state) per owner — the reference
         stubbed KV Store/Load (kv_table.h:101-119); here it round-trips."""
+        self._flush_window()   # the dump must see this caller's queued adds
         timeout = config.get_flag("ps_timeout")
         np.save(stream, np.array([self.ctx.world], np.int64),
                 allow_pickle=False)
@@ -1013,6 +1466,8 @@ class AsyncSparseKVTable(_SparseGetMixin, _AsyncBase):
         self._load(stream, only_local=True)
 
     def _load(self, stream, only_local: bool) -> None:
+        # stale pre-restore deltas must not land on top of restored state
+        self._flush_window()
         world = int(np.load(stream)[0])
         if world != self.ctx.world:
             raise ValueError(

@@ -75,14 +75,21 @@ def encode_payload(arr: np.ndarray, wire: str) -> List[np.ndarray]:
     list that travels in the frame. "none" -> [arr]; "bf16" -> [bf16];
     "1bit" -> [sign bits, per-block scales] (~29x fewer bytes; matches
     the device codec in ops/wire_codec bit-for-bit, so an encoded frame
-    decodes identically at either endpoint — no decode/re-encode hop).
-    1bit is stateless at THIS layer: error feedback (residuals) belongs
-    to the endpoint that owns the stream (ps/tables.py for adds)."""
+    decodes identically at either endpoint — no decode/re-encode hop);
+    "topk" -> [i32 idx, f32 vals] of the ~3% largest-|x| entries
+    (~16x fewer bytes). 1bit/topk are stateless at THIS layer: error
+    feedback (residuals) belongs to the endpoint that owns the stream
+    (ps/tables.py for adds)."""
     if wire == "1bit":
         from multiverso_tpu.utils import filters
         bits, scales = filters.onebit_encode_np(
             np.asarray(arr, np.float32).reshape(-1), ONEBIT_BLOCK)
         return [bits, scales]
+    if wire == "topk":
+        from multiverso_tpu.utils import filters
+        idx, vals = filters.topk_encode_np(
+            np.asarray(arr, np.float32).reshape(-1))
+        return [idx, vals]
     return [to_wire(arr, wire)]
 
 
@@ -95,6 +102,11 @@ def decode_payload(arrays: Sequence[np.ndarray], wire: str,
         flat = filters.onebit_decode_np(np.asarray(arrays[0]),
                                         np.asarray(arrays[1]), n,
                                         ONEBIT_BLOCK)
+        return flat.reshape(shape).astype(dtype, copy=False)
+    if wire == "topk":
+        from multiverso_tpu.utils import filters
+        n = int(np.prod(shape, dtype=np.int64))
+        flat = filters.topk_decode_np(arrays[0], arrays[1], n)
         return flat.reshape(shape).astype(dtype, copy=False)
     return np.asarray(arrays[0], dtype).reshape(shape)
 
@@ -224,6 +236,44 @@ def parse_frame(frame: bytes) -> Tuple[int, int, Dict, List[np.ndarray]]:
         raise WireError(f"frame body {len(body)} != paylen {paylen}")
     meta, arrays = _parse_body(body, metalen, narr, paylen)
     return msg_type, msg_id, meta, arrays
+
+
+# bound on logical sub-ops per MSG_BATCH frame: far above any real send
+# window (batch_window_ops defaults to 64), small enough that a garbage
+# header can't make the unpack loop spin
+MAX_BATCH_OPS = 4096
+
+
+def pack_batch(subframes: Sequence[bytes]) -> List[np.ndarray]:
+    """Pack complete inner frames (each a full :func:`encode` output —
+    header + meta + blobs, so every sub-op keeps its own meta and codec
+    wire) as the blob list of ONE outer MSG_BATCH frame. Each blob is
+    length-prefixed by the ordinary frame layout; the outer frame costs
+    one send, one recv, and one reply for the whole window."""
+    if not subframes:
+        raise WireError("empty batch")
+    if len(subframes) > MAX_BATCH_OPS:
+        raise WireError(f"batch of {len(subframes)} sub-ops exceeds "
+                        f"MAX_BATCH_OPS ({MAX_BATCH_OPS})")
+    return [np.frombuffer(f, np.uint8) for f in subframes]
+
+
+def unpack_batch(arrays: Sequence[np.ndarray]
+                 ) -> List[Tuple[int, Dict, List[np.ndarray]]]:
+    """Inverse of :func:`pack_batch`: the received blob list back into
+    ``(msg_type, meta, arrays)`` sub-ops, in window order. Sub-arrays are
+    zero-copy views into the outer frame's buffer (same lifetime rule as
+    :func:`recv`). Inner msg_ids are the window indices — correlation
+    lives on the OUTER frame; they are only used to name a failing
+    sub-op."""
+    if len(arrays) > MAX_BATCH_OPS:
+        raise WireError(f"batch of {len(arrays)} sub-ops exceeds "
+                        f"MAX_BATCH_OPS ({MAX_BATCH_OPS})")
+    out = []
+    for blob in arrays:
+        msg_type, _mid, meta, arrs = parse_frame(np.ascontiguousarray(blob))
+        out.append((msg_type, meta, arrs))
+    return out
 
 
 def peek_msg_id(frame: bytes) -> int:

@@ -179,8 +179,17 @@ class FTRLUpdater(Updater):
 #   with in-place numpy instead of a jitted program.
 # * OPT_INSENSITIVE: apply() never reads AddOption — queued adds coalesce
 #   across senders regardless of per-worker opt values.
+# * ROW_LOCAL_STATE: apply() is per-row elementwise and every state leaf
+#   is row-aligned (gathered/scattered with the touched rows), so applying
+#   K DISJOINT-row adds as one merged update is bit-identical to K
+#   sequential applies — the invariant the send window's merging (client
+#   groups + shard waves) relies on. Adam is excluded: its global step
+#   counter t advances once per apply() CALL, so a merge would miscount
+#   K-1 steps. Unlisted custom updaters never merge (conservative).
 STATELESS_LINEAR: Dict[type, float] = {Updater: 1.0, SGDUpdater: -1.0}
 OPT_INSENSITIVE = {Updater, SGDUpdater, FTRLUpdater}
+ROW_LOCAL_STATE = {Updater, SGDUpdater, MomentumUpdater, AdaGradUpdater,
+                   FTRLUpdater}
 
 _REGISTRY: Dict[str, Callable[..., Updater]] = {
     "default": Updater,

@@ -191,6 +191,24 @@ def parse_config_file(path: str) -> Dict[str, str]:
 # command lines parse unchanged, and documented as no-ops here.
 # ---------------------------------------------------------------------------
 define_string("ps_role", "default", "role of this process: none|worker|server|default")
+# Client-side send window for the sparse async-PS plane (ps/tables.py):
+# add_rows_async calls buffer per (owner, table) and flush as ONE frame —
+# one round-trip and one batched shard apply per window instead of one
+# per call. Off by default: flush()-exact callers (and anything relying
+# on an add being on the wire when add_rows_async returns) see no change
+# unless they opt in. Windowed results are BIT-IDENTICAL to window-off
+# (exact concat merging only; conflicting ops apply in order).
+define_float("batch_window_ms", 0.0,
+             "send-window age bound in ms for async add_rows batching; "
+             "0 disables the window (every add ships immediately). "
+             "1-2 ms is the bench-derived sweet spot for ~1-row adds "
+             "(docs/TUNING.md)")
+define_int("batch_window_bytes", 1 << 20,
+           "flush an owner's send window early once its pending add "
+           "payloads reach this many bytes")
+define_int("batch_window_ops", 64,
+           "flush an owner's send window early once this many logical "
+           "adds are queued for it")
 define_bool("ma", False, "model-average (allreduce) mode: no parameter tables")
 define_bool("sync", False, "BSP semantics (reference SyncServer). On TPU sync is "
             "the hardware-native mode; async emulated via sync_frequency")

@@ -48,6 +48,13 @@ class Monitor:
             self.count += 1
             self.total_ms += ms
 
+    def incr(self, n: int = 1) -> None:
+        """Pure event counter: bump ``count`` by ``n`` without touching
+        the timing sum (window flushes, merged rows — events with no
+        meaningful per-event duration)."""
+        with self._lock:
+            self.count += n
+
     @property
     def average_ms(self) -> float:
         return self.total_ms / self.count if self.count else 0.0

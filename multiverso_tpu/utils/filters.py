@@ -116,6 +116,34 @@ def onebit_decode_np(bits: np.ndarray, scales: np.ndarray, n: int,
     return out.reshape(-1)[:n].astype(np.float32)
 
 
+def default_topk(n: int) -> int:
+    """Default top-k support: ~3% of entries, at least one (MUST stay in
+    sync with ``wire_codec.default_topk`` — the two codecs are parallel
+    implementations of the same wire)."""
+    return max(n // 32, 1)
+
+
+def topk_encode_np(flat: np.ndarray, k: Optional[int] = None
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+    """Stateless top-k encode of a flat f32 array -> (idx i32, vals f32)
+    — the payload half of :class:`TopKFilter` without the residual (same
+    selection rule: stable descending |x|, ties to the lower index, like
+    ``jax.lax.top_k``). Used where the stream has no owner to carry
+    error feedback (row-batch adds on the PS wire: the row set changes
+    between batches, so a positional residual has no stable meaning)."""
+    flat = canon_f32(np.asarray(flat, np.float32).reshape(-1))
+    k = min(default_topk(flat.size) if k is None else k, flat.size)
+    idx = np.argsort(-np.abs(flat), kind="stable")[:k].astype(np.int32)
+    return idx, flat[idx]
+
+
+def topk_decode_np(idx: np.ndarray, vals: np.ndarray, n: int) -> np.ndarray:
+    """Inverse of :func:`topk_encode_np` (zeros off-support)."""
+    out = np.zeros(n, np.float32)
+    out[np.asarray(idx)] = np.asarray(vals, np.float32)
+    return out
+
+
 class SparseFilter:
     """(index, value) sparse encoding under a clip threshold
     (ref quantization_util.h SparseFilter: FilterIn/FilterOut)."""

