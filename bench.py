@@ -48,6 +48,24 @@ def _percentile_ms(samples):
     return float(np.percentile(np.asarray(samples) * 1e3, 50))
 
 
+def _dashboard_hist(max_monitors: int = 64):
+    """Histogram snapshots of every timed Dashboard monitor (count, p50/
+    p90/p99/max) — the telemetry-plane replacement for ad-hoc counter
+    scraping in the BENCH extra. Taken BEFORE mv.shutdown() (which
+    displays and resets the dashboard). Bounded so a pathological
+    monitor explosion cannot bloat the record."""
+    from multiverso_tpu.utils.dashboard import Dashboard
+    out = {}
+    for name, snap in sorted(Dashboard.snapshot().items()):
+        if not snap.timed:
+            continue   # pure counters carry no latency story
+        if len(out) >= max_monitors:   # only when a monitor is DROPPED
+            out["_truncated"] = True
+            break
+        out[name] = snap.brief_dict()
+    return out
+
+
 # degenerate two-point measurements (t_hi < t_lo: timing noise swamped the
 # signal) recorded here and surfaced in the bench record's "extra" — a
 # floored slope must stay visible as a bad measurement, not pass as data
@@ -955,6 +973,12 @@ def main() -> None:
         small_add_stats = bench_small_add_window()
     except Exception as e:
         small_add_stats = {"error": f"{type(e).__name__}: {e}"[:200]}
+    # telemetry-plane record: latency HISTOGRAMS of every monitored op
+    # this process ran (shutdown resets the dashboard, so snapshot now)
+    try:
+        dashboard_hist = _dashboard_hist()
+    except Exception as e:
+        dashboard_hist = {"error": f"{type(e).__name__}: {e}"[:200]}
     mv.shutdown()
 
     baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -987,6 +1011,7 @@ def main() -> None:
         "matrix_sparse_row_add": rows_stats,
         "lm_decode_b8_d256_L4": decode_stats,
         "small_add_send_window": small_add_stats,
+        "dashboard_hist": dashboard_hist,
     }
     if _DEGENERATE_DIFFERENTIALS:
         # floored noise-negative slopes (see _differential): the raw pairs

@@ -41,6 +41,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from multiverso_tpu.ps import wire
+from multiverso_tpu.telemetry import exporter as _exporter
+from multiverso_tpu.telemetry import trace as _trace
 from multiverso_tpu.utils import config, log
 from multiverso_tpu.utils.dashboard import monitor
 
@@ -63,6 +65,13 @@ MSG_SET_STATE = 0x19
 # Unknown to the native C++ server by design: it punts to the Python
 # handler, which already holds the native shard mutex there.
 MSG_BATCH = 0x1A
+# remote-dashboard RPC: any worker pulls a rank's full telemetry
+# snapshot — Dashboard monitor histograms, free-form notes, and the
+# first-class per-shard server stats (queue depth, pending bytes, wave
+# distribution, version) — as the REPLY META (pure JSON, no blobs).
+# Surfaced as table.server_stats(rank) / PSService.stats(rank); the
+# native C++ server punts it to Python like any unknown type.
+MSG_STATS = 0x1B
 
 config.define_string("ps_rendezvous", "",
                      "directory for async-PS rank rendezvous (empty = use "
@@ -383,7 +392,13 @@ class PSService:
             host = config.get_flag("ps_host") or "127.0.0.1"
         self._rendezvous = rendezvous
         self._handlers: Dict[str, Callable] = {}
+        # table -> shard object for MSG_STATS (handlers alone are opaque
+        # closures; the stats RPC needs the shard's stats() surface)
+        self._shards: Dict[str, Any] = {}
         self._handlers_cv = threading.Condition()
+        # telemetry: adopt the trace_ids flag under this service's rank
+        # (the exporter starts at the END of __init__, once addr exists)
+        _trace.configure(rank)
         self._peers: Dict[int, _Peer] = {}
         self._peers_lock = threading.Lock()
         self._peer_locks: Dict[int, threading.Lock] = {}
@@ -429,6 +444,9 @@ class PSService:
         self._accept_thread.start()
         if rendezvous is not None:
             rendezvous.publish(rank, self.addr)
+        # flag-gated metrics exporter with the rich (shard-aware)
+        # payload; no-op unless metrics_dir is set
+        _exporter.ensure_started(rank, self.stats_payload)
         log.debug("PSService rank %d/%d listening on %s", rank, world,
                   self.addr)
 
@@ -449,6 +467,8 @@ class PSService:
                 handler = wrapped
         with self._handlers_cv:
             self._handlers[table] = handler
+            if shard is not None:
+                self._shards[table] = shard
             self._handlers_cv.notify_all()
 
     def _try_register_native(self, table: str, handler: Callable,
@@ -514,10 +534,20 @@ class PSService:
             if msg_type == MSG_PING:       # native serves PING; belt only
                 reply = wire.encode(MSG_REPLY_OK, msg_id,
                                     {"rank": self.rank})
+            elif msg_type == MSG_STATS:    # remote dashboard pull
+                reply = wire.encode(MSG_REPLY_OK, msg_id,
+                                    self.stats_payload())
             else:
                 handler = self._wait_handler(meta["table"])
+                tr = (meta.get(wire.TRACE_META_KEY)
+                      if _trace.enabled() else None)
+                t0 = time.time() if tr is not None else 0.0
                 with monitor(f"ps[{meta['table']}].serve"):
                     rmeta, rarrays = handler(msg_type, meta, arrays)
+                if tr is not None:
+                    _trace.add_span("ps.serve", t0, time.time(), trace=tr,
+                                    args={"table": meta["table"],
+                                          "type": msg_type})
                 reply = wire.encode(MSG_REPLY_OK, msg_id, rmeta, rarrays)
         except Exception as e:
             log.debug("ps handler error: %s", e)
@@ -527,6 +557,43 @@ class PSService:
         # may still be in flight; the raw handle stays valid until
         # server_free (which runs after this conn thread is joined)
         ps_native.send_raw(self._native_raw, conn_id, reply)
+
+    # ----------------------------- telemetry -------------------------- #
+    def stats_payload(self) -> Dict:
+        """This rank's full telemetry snapshot (the MSG_STATS reply meta
+        and the exporter record share this one shape): Dashboard monitor
+        histograms, free-form notes, and per-shard server stats. Pure
+        JSON-safe data — consumers on other ranks can never mutate live
+        state through it."""
+        shards = {}
+        with self._handlers_cv:
+            items = list(self._shards.items())
+        for table, shard in items:
+            try:
+                stats = shard.stats()
+            except Exception as e:  # noqa: BLE001 — one bad shard must
+                stats = {"error": f"{type(e).__name__}: {e}"}  # not hide
+            shards[table] = stats                              # the rest
+        # ONE record shape: the monitors/notes assembly is the
+        # exporter's (default_stats_fn), overlaid with this service's
+        # identity and shard registry — MSG_STATS replies and exporter
+        # records must never diverge
+        payload = _exporter.default_stats_fn()
+        payload.update(rank=self.rank, world=self.world, addr=self.addr,
+                       shards=shards)
+        return payload
+
+    def stats(self, rank: int, timeout: Optional[float] = None) -> Dict:
+        """Pull ``rank``'s telemetry snapshot over MSG_STATS (the remote
+        dashboard; local rank short-circuits). Raises PSPeerError for a
+        dead/unreachable rank like any other request."""
+        if rank == self.rank:
+            return self.stats_payload()
+        fut = self._peer(rank).request(MSG_STATS, {}, ())
+        meta, _ = await_reply(
+            fut, timeout or config.get_flag("ps_timeout"),
+            f"stats from rank {rank}")
+        return meta
 
     def _wait_handler(self, table: str, timeout: float = 20.0) -> Callable:
         # a worker can race ahead of a peer still constructing its tables
@@ -574,12 +641,31 @@ class PSService:
                         wire.send(conn, MSG_REPLY_OK, msg_id,
                                   {"rank": self.rank})
                     continue
+                if msg_type == MSG_STATS:   # remote dashboard pull
+                    try:
+                        payload = self.stats_payload()
+                    except Exception as e:  # noqa: BLE001
+                        with send_lock:
+                            wire.send(conn, MSG_REPLY_ERR, msg_id,
+                                      {"error": f"{type(e).__name__}: {e}"})
+                        continue
+                    with send_lock:
+                        wire.send(conn, MSG_REPLY_OK, msg_id, payload)
+                    continue
                 try:
                     handler = self._wait_handler(meta["table"])
+                    tr = (meta.get(wire.TRACE_META_KEY)
+                          if _trace.enabled() else None)
+                    t0 = time.time() if tr is not None else 0.0
                     # server-side Dashboard visibility (ref MONITOR_BEGIN
                     # around Server::ProcessAdd/Get, src/server.cpp:37-45)
                     with monitor(f"ps[{meta['table']}].serve"):
                         rmeta, rarrays = handler(msg_type, meta, arrays)
+                    if tr is not None:
+                        _trace.add_span("ps.serve", t0, time.time(),
+                                        trace=tr,
+                                        args={"table": meta["table"],
+                                              "type": msg_type})
                     with send_lock:
                         wire.send(conn, MSG_REPLY_OK, msg_id, rmeta, rarrays)
                 except Exception as e:  # reply errors, don't kill the conn
@@ -900,6 +986,19 @@ class PSContext:
                 # service's sockets/threads
                 log.error("ps shutdown quiesce failed (%s: %s); closing "
                           "anyway", type(e).__name__, e)
+        # final telemetry flush BEFORE the service dies: the last metrics
+        # record and any buffered trace spans must survive a short run.
+        # export_global, NOT stop_global: a process may hold several
+        # contexts (test fixtures, bench workers) and one closing must
+        # not kill the exporter for the rest — the global exporter stops
+        # at Zoo.stop (app teardown) or with the process.
+        try:
+            _exporter.export_global()
+            d = config.get_flag("metrics_dir")
+            if d:
+                _trace.dump_to(d)
+        except Exception as e:  # noqa: BLE001 — telemetry never blocks
+            log.error("telemetry flush at close failed: %s", e)  # shutdown
         self.service.close()
 
 

@@ -20,6 +20,7 @@ see a consistent snapshot; the lock orders the donated updates).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -30,8 +31,10 @@ from multiverso_tpu.ps import service as svc
 from multiverso_tpu.ps import wire
 from multiverso_tpu.table import _ceil_to
 from multiverso_tpu.tables.matrix_table import _bucket_size
+from multiverso_tpu.telemetry import trace as _trace
 from multiverso_tpu.updaters import AddOption, Updater
 from multiverso_tpu.utils import config as _config
+from multiverso_tpu.utils.dashboard import Dashboard
 
 # updater classification (see updaters.STATELESS_LINEAR /
 # OPT_INSENSITIVE): linear stateless updaters apply with in-place numpy
@@ -43,14 +46,19 @@ from multiverso_tpu.updaters import (OPT_INSENSITIVE as _OPT_INSENSITIVE,
 
 
 class _PendingAdd:
-    """One queued row-add awaiting the shard's applier (coalescing path)."""
+    """One queued row-add awaiting the shard's applier (coalescing path).
+    ``trace`` is the request's client-minted trace ID (wire meta "tr"),
+    echoed into the apply-wave spans so a client enqueue span and the
+    shard apply span stitch by ID; None = untraced (the default)."""
 
-    __slots__ = ("local", "vals", "opt", "event", "error")
+    __slots__ = ("local", "vals", "opt", "event", "error", "trace")
 
-    def __init__(self, local: np.ndarray, vals: np.ndarray, opt: AddOption):
+    def __init__(self, local: np.ndarray, vals: np.ndarray, opt: AddOption,
+                 trace: Optional[int] = None):
         self.local, self.vals, self.opt = local, vals, opt
         self.event = threading.Event()
         self.error: Optional[Exception] = None
+        self.trace = trace
 
 
 class RowShard:
@@ -144,6 +152,17 @@ class RowShard:
         # counters when the shard is natively registered.
         self._stat_adds = 0
         self._stat_applies = 0
+        # first-class server-side stats (MSG_STATS / exporter):
+        # _version counts applied mutations (the owner-side analogue of
+        # the client get-cache version in table.py); _wave_ops is the
+        # merged-ops-per-apply distribution in power-of-two buckets
+        # (batch waves AND queue-coalesce groups — the realized server-
+        # side batching the mean hides). Both mutate under self._lock.
+        self._version = 0
+        self._wave_ops: Dict[int, int] = {}
+        self._wave_max = 0
+        # apply latency histogram (the p50/p99 of one updater dispatch)
+        self._mon_apply = Dashboard.get(f"ps[{name}].apply")
         # native shard PIN once the native server serves this shard's hot
         # ops (service._try_register_native); Python then only sees punted
         # messages for it, already holding the native shard mutex. The pin
@@ -213,6 +232,53 @@ class RowShard:
     @property
     def stat_applies(self) -> int:
         return self._stat_applies + self._native_stats()[1]
+
+    def stats(self) -> Dict[str, Any]:
+        """First-class server-side stats (MSG_STATS reply / exporter):
+        JSON-safe scalars + the wave distribution. Cheap — reads
+        counters and queue lengths, never touches the data buffer."""
+        with self._addq_lock:
+            queue_depth = len(self._addq)
+            pending_bytes = sum(e.local.nbytes + e.vals.nbytes
+                                for e in self._addq)
+        # ONE native crossing: the stat_adds/stat_applies properties
+        # would each call shard_pin_stats again, and three racing reads
+        # could mix counter states within one snapshot
+        n_adds, n_applies = self._native_stats()
+        adds = self._stat_adds + n_adds
+        applies = self._stat_applies + n_applies
+        native_applies = n_applies
+        with self._lock:
+            wave_ops = {str(k): v
+                        for k, v in sorted(self._wave_ops.items())}
+            wave_max = self._wave_max
+            # natively-served applies never touch Python, so the zero-
+            # Python C++ counter folds into the mutation version (both
+            # only grow — monotonicity holds); the wave distribution
+            # stays a python-path view by design (same rule as the
+            # dashboard's native_served note)
+            version = self._version + native_applies
+            # rows stale for AT LEAST one worker (any-axis, not the raw
+            # flag sum — a (workers, rows) flag count would exceed the
+            # shard's row count and mislead staleness sizing)
+            dirty_rows = (int(self._dirty.any(axis=0).sum())
+                          if self._dirty is not None else None)
+        out = {
+            "kind": "row",
+            "lo": self.lo, "rows": self.n, "cols": self.num_col,
+            "bytes": int(self._padded[0] * self.num_col
+                         * self.dtype.itemsize),
+            "adds": adds, "applies": applies,
+            "version": version,
+            "queue_depth": queue_depth,
+            "pending_bytes": pending_bytes,
+            "wave_ops": wave_ops,       # pow2-bucketed ops-per-apply
+            "wave_max_ops": wave_max,
+            "apply": self._mon_apply.snapshot().hist_dict(),
+        }
+        if dirty_rows is not None:
+            out["dirty_rows"] = dirty_rows   # sparse-protocol staleness
+        return out
 
     @property
     def scratch(self) -> int:
@@ -326,9 +392,19 @@ class RowShard:
         reported coalescing ratio stays honest for non-merging
         updaters)."""
         if len(entries) > 1 and type(self.updater) not in _ROW_LOCAL_STATE:
+            # per-entry errors: entry k failing must not mark the k-1
+            # already-committed entries lost (a blanket group error would
+            # invite retries that double-apply; same contract as
+            # _apply_batch_adds' per-wave failure reporting)
+            applies = 0
             for e in entries:
-                self._apply_rows(e.local, e.vals, e.opt)
-            return len(entries)
+                self._record_wave(1)
+                try:
+                    self._apply_rows(e.local, e.vals, e.opt)
+                    applies += 1
+                except Exception as err:  # noqa: BLE001 — per-entry
+                    e.error = err
+            return applies
         if len(entries) == 1:
             local, vals = entries[0].local, entries[0].vals
         else:
@@ -339,13 +415,25 @@ class RowShard:
                       np.concatenate([e.vals for e in entries])
                       .astype(np.float64))
             vals = acc.astype(self.dtype)
+        self._record_wave(len(entries))
         self._apply_rows(local, vals, opt)
         return 1
+
+    def _record_wave(self, ops: int) -> None:
+        """Merged-ops-per-apply distribution (under ``self._lock``):
+        power-of-two buckets keep it a tiny exact dict — wave sizes are
+        bounded by MAX_BATCH_OPS, so log-scale bucketing buys nothing."""
+        b = 1 << max(ops - 1, 0).bit_length()
+        self._wave_ops[b] = self._wave_ops.get(b, 0) + 1
+        if ops > self._wave_max:
+            self._wave_max = ops
 
     def _apply_rows(self, local: np.ndarray, vals: np.ndarray,
                     opt: AddOption) -> None:
         """One merged, deduped row-delta batch -> the updater (under
-        ``self._lock``)."""
+        ``self._lock``). Times itself into the ``ps[name].apply``
+        histogram and bumps the shard mutation version."""
+        t0 = time.perf_counter()
         if self._np_mode:
             sign = _LINEAR_SIGN[type(self.updater)]
             if sign > 0:
@@ -354,16 +442,19 @@ class RowShard:
                 self._data[local] -= vals
             if self._dirty is not None:
                 self._dirty[:, local] = True
-            return
-        ids = self._pad_to_bucket(local)
-        if vals.shape[0] < ids.size:   # zero-pad to the bucket
-            vals = np.concatenate(
-                [vals, np.zeros((ids.size - vals.shape[0], self.num_col),
-                                self.dtype)])
-        self._data, self._ustate = self._row_update_fn(ids.size)(
-            self._data, self._ustate, ids, vals, opt)
-        if self._dirty is not None:
-            self._dirty[:, local] = True   # stale for everyone
+        else:
+            ids = self._pad_to_bucket(local)
+            if vals.shape[0] < ids.size:   # zero-pad to the bucket
+                vals = np.concatenate(
+                    [vals,
+                     np.zeros((ids.size - vals.shape[0], self.num_col),
+                              self.dtype)])
+            self._data, self._ustate = self._row_update_fn(ids.size)(
+                self._data, self._ustate, ids, vals, opt)
+            if self._dirty is not None:
+                self._dirty[:, local] = True   # stale for everyone
+        self._version += 1
+        self._mon_apply.observe_ms((time.perf_counter() - t0) * 1e3)
 
     # shared continuation pool for drain hand-off (class-level: shards are
     # many, the pool is one; drain passes never block on anything but the
@@ -481,7 +572,8 @@ class RowShard:
         """One MSG_BATCH sub-op -> a validated pending entry (HashShard
         overrides: its entries carry keys, translated at apply time)."""
         local, vals, opt = self._prep_add(meta, arrays)
-        return _PendingAdd(local, vals, opt)
+        return _PendingAdd(local, vals, opt,
+                           trace=meta.get(wire.TRACE_META_KEY))
 
     def _apply_batch_adds(self, entries: List[_PendingAdd]
                           ) -> Tuple[List[int], List[str]]:
@@ -517,6 +609,10 @@ class RowShard:
             def flush_wave():
                 if not wave:
                     return
+                traced = (_trace.enabled()
+                          and any(e.trace is not None for _, e in wave))
+                t0 = time.time() if traced else 0.0
+                self._record_wave(len(wave))
                 try:
                     if len(wave) == 1:
                         e = wave[0][1]
@@ -530,6 +626,17 @@ class RowShard:
                 except Exception as err:   # noqa: BLE001 — reported per op
                     failed.extend(i for i, _ in wave)
                     errors.append(f"{type(err).__name__}: {err}")
+                if traced:
+                    # ONE span per wave, correlated to every sub-op it
+                    # applied: "trace" carries the first ID (timeline
+                    # stitching), "traces" the full set
+                    tids = [e.trace for _, e in wave
+                            if e.trace is not None]
+                    _trace.add_span(
+                        "shard.wave_apply", t0, time.time(),
+                        trace=tids[0],
+                        args={"table": self.name, "ops": len(wave),
+                              "traces": tids})
                 wave.clear()
                 seen.clear()
 
@@ -600,7 +707,16 @@ class RowShard:
                ) -> Tuple[Dict, List[np.ndarray]]:
         if msg_type == svc.MSG_ADD_ROWS:
             local, vals, opt = self._prep_add(meta, arrays)
+            tr = (meta.get(wire.TRACE_META_KEY)
+                  if _trace.enabled() else None)
+            t0 = time.time() if tr is not None else 0.0
             self._add_rows(local, vals, opt)
+            if tr is not None:
+                # the plain-frame analogue of the batch path's
+                # shard.wave_apply span (a 1-op window ships as a plain
+                # MSG_ADD_ROWS frame, not a MSG_BATCH)
+                _trace.add_span("shard.apply", t0, time.time(), trace=tr,
+                                args={"table": self.name, "traces": [tr]})
             return {}, []
         if msg_type == svc.MSG_BATCH:
             # a client send window: N logical adds in one frame, one ack
@@ -644,6 +760,7 @@ class RowShard:
                         jnp.asarray(vals))
                 if self._dirty is not None:
                     self._dirty[:, ids[:k]] = True
+                self._version += 1
             return {}, []
         if msg_type == svc.MSG_ADD_FULL:
             opt = AddOption(**meta.get("opt", {}))
@@ -664,6 +781,7 @@ class RowShard:
                         opt)
                 if self._dirty is not None:
                     self._dirty[:] = True
+                self._version += 1
             return {}, []
         if msg_type == svc.MSG_GET_FULL:
             with self._lock:   # same donation race as MSG_GET_ROWS
@@ -701,6 +819,7 @@ class RowShard:
                 if self._local_sharding is not None:
                     self._ustate = jax.tree.map(self._place_state_local,
                                                 self._ustate)
+                self._version += 1
             return {}, []
         raise svc.PSError(f"unknown message type {msg_type}")
 
@@ -729,6 +848,13 @@ class HashShard(RowShard):
     def keys(self) -> List[int]:
         with self._lock:
             return list(self._slot_of)
+
+    def stats(self) -> Dict[str, Any]:
+        out = super().stats()
+        out["kind"] = "hash"
+        with self._lock:
+            out["keys"] = len(self._slot_of)
+        return out
 
     def _grow(self, need: int) -> None:
         old_padded = self._padded
@@ -799,7 +925,8 @@ class HashShard(RowShard):
         keys = self._validate_keys(arrays[0])
         opt = AddOption(**meta.get("opt", {}))
         vals = np.asarray(arrays[1], self.dtype)[: keys.size]
-        return _PendingAdd(keys, vals, opt)
+        return _PendingAdd(keys, vals, opt,
+                           trace=meta.get(wire.TRACE_META_KEY))
 
     def _slots_for(self, keys: np.ndarray) -> np.ndarray:
         """key -> slot, allocating unseen keys (under the caller's lock)."""
@@ -830,7 +957,14 @@ class HashShard(RowShard):
             # could go stale if a checkpoint restore rebuilds the slot map
             # in between
             entry = self._prep_add_entry(meta, arrays)
+            t0 = (time.time()
+                  if _trace.enabled() and entry.trace is not None else 0.0)
             self._add_rows(entry.local, entry.vals, entry.opt)
+            if t0:
+                _trace.add_span("shard.apply", t0, time.time(),
+                                trace=entry.trace,
+                                args={"table": self.name,
+                                      "traces": [entry.trace]})
             return {}, []
         with self._lock:   # reentrant: key->slot stays atomic w/ the update
             if msg_type == svc.MSG_GET_STATE and meta.get("dump"):
@@ -924,6 +1058,10 @@ class KVShard:
         self.name = name
         self._store: Dict[int, float] = {}
         self._lock = threading.Lock()
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"kind": "kv", "keys": len(self._store)}
 
     def handle(self, msg_type: int, meta: Dict,
                arrays: Sequence[np.ndarray]

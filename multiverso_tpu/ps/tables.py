@@ -30,6 +30,7 @@ from multiverso_tpu import updaters as updaters_lib
 from multiverso_tpu.ps import service as svc
 from multiverso_tpu.ps import wire as wire_mod
 from multiverso_tpu.ps.shard import KVShard, RowShard
+from multiverso_tpu.telemetry import trace as ttrace
 from multiverso_tpu.updaters import AddOption
 from multiverso_tpu.utils import config, log
 from multiverso_tpu.utils.dashboard import Dashboard, monitor
@@ -297,6 +298,30 @@ def _complete_window_futures(batch_fut: cf.Future,
                 f.set_result(({}, []))
 
 
+def _attach_reply_span(futs: List, name: str, t0: float, tid: int,
+                       table: str) -> None:
+    """Record a client send->reply span when the LAST per-owner future
+    completes (runs on a peer recv thread). Only cf.Futures support
+    callbacks — native-transport handles never reach here (the native
+    fast path is untraced by design)."""
+    remaining = [len([f for f in futs if isinstance(f, cf.Future)])]
+    lock = threading.Lock()
+    if not remaining[0]:
+        return
+
+    def _done(_f):
+        with lock:
+            remaining[0] -= 1
+            last = remaining[0] == 0
+        if last:
+            ttrace.add_span(name, t0, time.time(), trace=tid,
+                            args={"table": table})
+
+    for f in futs:
+        if isinstance(f, cf.Future):
+            f.add_done_callback(_done)
+
+
 class _SendWindow:
     """Client-side cross-call add coalescer (the PS *send window*), one
     per windowed table: ``add_rows_async`` enqueues per-owner entries and
@@ -338,7 +363,8 @@ class _SendWindow:
         self.max_bytes = int(max_bytes)
         self.max_ops = int(max_ops)
         self._cv = threading.Condition()
-        # owner -> [(ids, vals, opt, placeholder future)], enqueue order
+        # owner -> [(ids, vals, opt, placeholder future, trace id)],
+        # enqueue order
         self._pending: Dict[int, List[Tuple]] = {}
         self._nbytes: Dict[int, int] = {}
         self._send_locks: Dict[int, threading.Lock] = {}
@@ -351,19 +377,23 @@ class _SendWindow:
 
     # ------------------------------------------------------------------ #
     def submit(self, parts: List[Tuple[int, np.ndarray, np.ndarray]],
-               opt: AddOption) -> List[cf.Future]:
+               opt: AddOption,
+               trace: Optional[int] = None) -> List[cf.Future]:
         """Queue ONE logical add's per-owner pieces; returns one
-        placeholder future per owner (completed by the window ack)."""
+        placeholder future per owner (completed by the window ack).
+        ``trace`` is the logical op's trace ID (telemetry/trace.py) —
+        it rides every per-owner entry into the frame meta."""
         self._mon_windowed.incr()
-        return [self._enqueue(r, ids, vals, opt) for r, ids, vals in parts]
+        return [self._enqueue(r, ids, vals, opt, trace)
+                for r, ids, vals in parts]
 
     def _enqueue(self, owner: int, ids: np.ndarray, vals: np.ndarray,
-                 opt: AddOption) -> cf.Future:
+                 opt: AddOption, trace: Optional[int] = None) -> cf.Future:
         fut: cf.Future = cf.Future()
         ship = False
         with self._cv:
             q = self._pending.setdefault(owner, [])
-            q.append((ids, vals, opt, fut))
+            q.append((ids, vals, opt, fut, trace))
             self._nbytes[owner] = (self._nbytes.get(owner, 0)
                                    + ids.nbytes + vals.nbytes)
             if (len(q) >= self.max_ops
@@ -456,10 +486,12 @@ class _SendWindow:
             err = svc.PSError(
                 f"table[{self._table_name}] was garbage-collected with "
                 "windowed adds still queued")
-            for _, _, _, fut in entries:
+            for _, _, _, fut, _ in entries:
                 if not fut.done():
                     fut.set_exception(err)
             return
+        traced = ttrace.enabled()
+        t_flush0 = time.time() if traced else 0.0
         w = t._wire_for(owner)
         # merging conditions, ALL required for bit-transparency: an
         # elementwise wire ("none"/"bf16" — 1bit/topk mix values across
@@ -470,9 +502,9 @@ class _SendWindow:
         exact = (w in ("none", "bf16")
                  and type(t.updater) in updaters_lib.ROW_LOCAL_STATE)
         merge_all = type(t.updater) in updaters_lib.OPT_INSENSITIVE
-        groups: List[List] = []   # [ids[], vals[], opt, futs[], idset]
-        merged_rows = 0
-        for ids, vals, opt, fut in entries:
+        groups: List[List] = []   # [ids[], vals[], opt, futs[], idset,
+        merged_rows = 0           #  traces[]]
+        for ids, vals, opt, fut, tid in entries:
             g = groups[-1] if groups else None
             if (g is not None and exact
                     and (merge_all or opt == g[2])
@@ -481,20 +513,38 @@ class _SendWindow:
                 g[1].append(vals)
                 g[3].append(fut)
                 g[4].update(ids.tolist())
+                if tid is not None:
+                    g[5].append(tid)
                 merged_rows += int(ids.size)
             else:
                 groups.append([[ids], [vals], opt, [fut],
-                               set(ids.tolist())])
+                               set(ids.tolist()),
+                               [] if tid is None else [tid]])
         try:
             packed = [(np.concatenate(g[0]) if len(g[0]) > 1 else g[0][0],
                        np.concatenate(g[1]) if len(g[1]) > 1 else g[1][0],
-                       g[2]) for g in groups]
+                       g[2], g[5]) for g in groups]
         except Exception as e:   # merge failure must not orphan waiters
             for g in groups:
                 for f in g[3]:
                     if not f.done():
                         f.set_exception(e)
             return
+
+        def sub_meta(opt, tids):
+            """Per-sub-op meta: the cached packed bytes normally; a dict
+            carrying the trace ID (wire.TRACE_META_KEY) when the group
+            is traced — a merged group's FIRST ID names the sub-op, the
+            full set rides the client flush/ack spans."""
+            if not tids:
+                return t._add_meta_b(opt, w)
+            meta = {"table": t.name, "opt": opt._asdict()}
+            if w != "none":
+                meta["wire"] = w
+            meta[wire_mod.TRACE_META_KEY] = tids[0]
+            return meta
+
+        all_tids = [tid for g in groups for tid in g[5]]
         # a window can outgrow one frame (knob raced/misconfigured past
         # the wire bound): ship in MAX_BATCH_OPS chunks, in order on the
         # same conn — never fail the whole window over frame capacity
@@ -504,19 +554,22 @@ class _SendWindow:
             futs = [f for fs in gfuts for f in fs]
             try:
                 if len(chunk) == 1:
-                    ids, vals, opt = chunk[0]
+                    ids, vals, opt, tids = chunk[0]
                     meta = {"table": t.name, "opt": opt._asdict()}
                     if w != "none":
                         meta["wire"] = w
+                    if tids:
+                        meta[wire_mod.TRACE_META_KEY] = tids[0]
                     req = t.ctx.service.request(
                         owner, svc.MSG_ADD_ROWS, meta,
                         [ids] + wire_mod.encode_payload(vals, w),
-                        meta_b=t._add_meta_b(opt, w))
+                        meta_b=(None if tids
+                                else t._add_meta_b(opt, w)))
                 else:
                     blobs = [wire_mod.encode(
-                        svc.MSG_ADD_ROWS, i, t._add_meta_b(opt, w),
+                        svc.MSG_ADD_ROWS, i, sub_meta(opt, tids),
                         [ids] + wire_mod.encode_payload(vals, w))
-                        for i, (ids, vals, opt) in enumerate(chunk)]
+                        for i, (ids, vals, opt, tids) in enumerate(chunk)]
                     req = t.ctx.service.request(
                         owner, svc.MSG_BATCH,
                         {"table": t.name, "n": len(chunk)},
@@ -527,10 +580,33 @@ class _SendWindow:
                         f.set_exception(e)
                 continue
             self._mon_flushes.incr()
-            req.add_done_callback(
-                lambda bf, gf=gfuts: _complete_window_futures(bf, gf))
+            if traced and all_tids:
+                # ack span: frame on the wire -> window ack fanned out
+                # (runs on the peer's recv thread)
+                t_send = time.time()
+                chunk_tids = [tid for (_, _, _, tids) in chunk
+                              for tid in tids]
+
+                def _done(bf, gf=gfuts, ts=t_send, ct=chunk_tids):
+                    _complete_window_futures(bf, gf)
+                    ttrace.add_span(
+                        "window.ack", ts, time.time(),
+                        trace=ct[0] if ct else None,
+                        args={"owner": owner, "traces": ct})
+
+                req.add_done_callback(_done)
+            else:
+                req.add_done_callback(
+                    lambda bf, gf=gfuts: _complete_window_futures(bf, gf))
         if merged_rows:
             self._mon_merged.incr(merged_rows)
+        if traced and all_tids:
+            nframes = -(-len(packed) // wire_mod.MAX_BATCH_OPS)  # ceil:
+            ttrace.add_span(                 # wire frames, not sub-ops
+                "window.flush", t_flush0, time.time(),
+                trace=all_tids[0],
+                args={"owner": owner, "ops": len(entries),
+                      "frames": nframes, "traces": all_tids})
 
 
 def _maybe_register_in_zoo(table) -> Optional[int]:
@@ -684,6 +760,18 @@ class _AsyncBase:
         if getattr(self, "table_id", None) is not None:
             from multiverso_tpu.zoo import Zoo
             Zoo.get().mark_dirty(self.table_id)
+
+    def server_stats(self, rank: Optional[int] = None) -> Dict:
+        """Remote dashboard (MSG_STATS): pull ``rank``'s full telemetry
+        snapshot — Dashboard monitor histograms, notes, and first-class
+        per-shard server stats for EVERY table served there (keyed by
+        table name under ``"shards"``; this table's own shard is
+        ``server_stats(r)["shards"][self.name]``). ``rank=None`` reads
+        the local rank without touching the socket. Raises
+        :class:`~multiverso_tpu.ps.service.PSPeerError` for a dead rank,
+        like any other request."""
+        return self.ctx.service.stats(
+            self.ctx.rank if rank is None else int(rank))
 
 
 class AsyncMatrixTable(_AsyncBase):
@@ -845,11 +933,18 @@ class AsyncMatrixTable(_AsyncBase):
         self._zoo_dirty()
         with monitor(f"table[{self.name}].add_rows"):
             uids, vals, _ = self._prep(row_ids, values)
+            # per-request trace ID (telemetry/trace.py): rides the frame
+            # meta so client spans and the owning shard's serve/wave
+            # spans stitch by ID; None (the default) costs one attribute
+            # read. The native fan-out stays untraced by design (zero-
+            # Python C++ path).
+            tid = ttrace.new_id() if ttrace.enabled() else None
             if self._window is not None:
                 # send window: enqueue per-owner pieces and return — the
                 # flusher (or the next fencing op) ships each owner's
                 # queue as ONE (multi-op) frame. Single-owner batches (the
                 # 1-row small-add hot path) skip the mask partitioning.
+                t_enq0 = time.time() if tid is not None else 0.0
                 owners = uids // self._rows_per
                 r0 = int(owners[0])
                 if uids.size == 1 or not np.any(owners != r0):
@@ -864,7 +959,13 @@ class AsyncMatrixTable(_AsyncBase):
                 else:
                     parts = [(r, uids[m], vals[m])
                              for r, m in self._by_owner(uids)]
-                return self._track(self._window.submit(parts, opt))
+                mid = self._track(self._window.submit(parts, opt, tid))
+                if tid is not None:
+                    ttrace.add_span("client.enqueue", t_enq0, time.time(),
+                                    trace=tid,
+                                    args={"table": self.name,
+                                          "rows": int(uids.size)})
+                return mid
             meta_b = self._add_meta_b(opt)
             if self._native_ok and vals.dtype == self.dtype:
                 from multiverso_tpu.ps import native as ps_native
@@ -874,17 +975,25 @@ class AsyncMatrixTable(_AsyncBase):
                     np.ascontiguousarray(vals))
                 return self._track(_fanout_futures(
                     parts, lambda c, s, m: _NativeAddFuture(c, s, m)))
+            t_send0 = time.time() if tid is not None else 0.0
             futs = []
             for r, m in self._by_owner(uids):
                 w = self._wire_for(r)
                 # meta and blobs per destination wire: the local short-
                 # circuit stays uncompressed, remote peers get the codec
                 # frame (decoded exactly once in the shard's _prep_add)
+                meta = wire_mod.with_trace(
+                    {"table": self.name, "opt": opt._asdict()}, tid)
+                if tid is not None and w != "none":
+                    meta["wire"] = w
                 futs.append(self.ctx.service.request(
-                    r, svc.MSG_ADD_ROWS,
-                    {"table": self.name, "opt": opt._asdict()},
+                    r, svc.MSG_ADD_ROWS, meta,
                     [uids[m]] + wire_mod.encode_payload(vals[m], w),
-                    meta_b=self._add_meta_b(opt, w)))
+                    meta_b=(None if tid is not None
+                            else self._add_meta_b(opt, w))))
+            if tid is not None:
+                _attach_reply_span(futs, "client.add_rows", t_send0, tid,
+                                   self.name)
         return self._track(futs)
 
     def add_rows(self, row_ids, values,
@@ -933,12 +1042,19 @@ class AsyncMatrixTable(_AsyncBase):
             # remote peers share one packed meta (with the table's reply
             # wire); the local short-circuit keeps its uncompressed dict
             gw = self._reply_wire()
-            meta_b = wire_mod.pack_meta({"table": self.name, "wire": gw})
+            tid = ttrace.new_id() if ttrace.enabled() else None
+            t_send0 = time.time() if tid is not None else 0.0
+            meta_b = wire_mod.pack_meta(wire_mod.with_trace(
+                {"table": self.name, "wire": gw}, tid))
             futs = [self.ctx.service.request(
                         r, svc.MSG_GET_ROWS,
-                        {"table": self.name, "wire": "none"},
+                        wire_mod.with_trace(
+                            {"table": self.name, "wire": "none"}, tid),
                         [uids[m]], meta_b=meta_b)
                     for r, m in parts]
+            if tid is not None:
+                _attach_reply_span(futs, "client.get_rows", t_send0, tid,
+                                   self.name)
 
             def _assemble(results):
                 buf = self._reply_buffer(out if inv is None else None,
@@ -1355,7 +1471,10 @@ class AsyncSparseKVTable(_SparseGetMixin, _AsyncBase):
         from multiverso_tpu.ps.shard import HashShard
         self._shard = HashShard(self.num_col, self.dtype, self.updater,
                                 name, num_workers=self._n_workers)
-        self.ctx.service.register_handler(name, self._shard.handle)
+        # shard= is stats-only here: hash shards never register natively
+        # (the native gate requires an exact host-backed RowShard)
+        self.ctx.service.register_handler(name, self._shard.handle,
+                                          shard=self._shard)
         self._caches: Dict[int, Any] = {}
         self._caches_lock = threading.Lock()
         self._pull_seq = 0
@@ -1383,10 +1502,12 @@ class AsyncSparseKVTable(_SparseGetMixin, _AsyncBase):
         self._zoo_dirty()
         with monitor(f"table[{self.name}].add_rows"):
             uids, vals, _ = self._prep(keys, values)
+            tid = ttrace.new_id() if ttrace.enabled() else None
             if self._window is not None:
                 # send window: per-owner key batches queue and ship as
                 # one (multi-op) frame — see _SendWindow. Single-owner
                 # batches skip the mask partitioning (small-add hot path).
+                t_enq0 = time.time() if tid is not None else 0.0
                 owners = uids % self.ctx.world
                 r0 = int(owners[0])
                 if uids.size == 1 or not np.any(owners != r0):
@@ -1397,8 +1518,15 @@ class AsyncSparseKVTable(_SparseGetMixin, _AsyncBase):
                 else:
                     parts = [(r, uids[m], vals[m])
                              for r, m in self._by_owner(uids)]
-                return self._track(self._window.submit(parts, opt))
-            meta = {"table": self.name, "opt": opt._asdict()}
+                mid = self._track(self._window.submit(parts, opt, tid))
+                if tid is not None:
+                    ttrace.add_span("client.enqueue", t_enq0, time.time(),
+                                    trace=tid,
+                                    args={"table": self.name,
+                                          "rows": int(uids.size)})
+                return mid
+            meta = wire_mod.with_trace(
+                {"table": self.name, "opt": opt._asdict()}, tid)
             meta_b = wire_mod.pack_meta(meta)
             futs = [self.ctx.service.request(r, svc.MSG_ADD_ROWS, meta,
                                              [uids[m], vals[m]],
@@ -1585,7 +1713,9 @@ class AsyncKVTable(_AsyncBase):
                  ctx: Optional[svc.PSContext] = None):
         super().__init__(ctx, name)
         self._shard = KVShard(name)
-        self.ctx.service.register_handler(name, self._shard.handle)
+        # shard= is stats-only (KV shards are host dicts, never native)
+        self.ctx.service.register_handler(name, self._shard.handle,
+                                          shard=self._shard)
         self.table_id = _maybe_register_in_zoo(self)
 
     def _owner(self, key: int) -> int:

@@ -30,6 +30,16 @@ frame buffer.
 
 Safety: reads are bounded (MAX_META, MAX_BLOB, MAX_FRAME) so a garbage or
 malicious peer can't OOM the process with one header.
+
+Telemetry: a request's trace ID travels in the JSON meta under
+:data:`TRACE_META_KEY` (``"tr"``) — an int minted by telemetry/trace.py
+at the client, echoed into the serve/apply spans at the owning shard.
+MSG_BATCH inner frames each carry their OWN meta (and therefore their own
+trace ID), so a windowed multi-op frame preserves per-SUB-OP correlation
+end to end (a client-merged group ships one sub-op carrying its first
+logical op's ID; the full set rides the client window spans). Absent
+key = untraced request (the default); the binary frame layout is
+unchanged either way.
 """
 
 from __future__ import annotations
@@ -54,6 +64,20 @@ MAX_FRAME = MAX_META + 8 * MAX_BLOB
 
 class WireError(RuntimeError):
     pass
+
+
+# JSON-meta key carrying the per-request trace ID (see module docstring)
+TRACE_META_KEY = "tr"
+
+
+def with_trace(meta: Dict, trace) -> Dict:
+    """Meta dict + trace ID (no-op passthrough for ``trace=None`` so
+    call sites stay branch-free)."""
+    if trace is None:
+        return meta
+    meta = dict(meta)
+    meta[TRACE_META_KEY] = trace
+    return meta
 
 
 ONEBIT_BLOCK = 1024   # per-block scale granularity of the "1bit" wire

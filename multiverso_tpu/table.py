@@ -280,7 +280,9 @@ class Table:
         cache = self._get_cache
         if cache is None or cache[0] != self._version:
             return None
-        Dashboard.get(f"table[{self.name}].get.cached").observe_ms(0.0)
+        # incr, not observe_ms(0.0): a hit COUNTER must not feed fake
+        # 0-ms samples into the monitor's latency histogram
+        Dashboard.get(f"table[{self.name}].get.cached").incr()
         if into is not None:
             np.copyto(into.reshape(self.shape), cache[1])
             return into

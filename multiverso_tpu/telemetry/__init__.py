@@ -1,0 +1,29 @@
+"""Telemetry plane: histogram metrics, wire-correlated trace spans, and
+the periodic metrics exporter.
+
+The reference's observability story was a count/total-ms ``Monitor``
+registry printed at shutdown (ref include/multiverso/dashboard.h:16-73);
+``utils/dashboard.py`` keeps that surface for parity but its Monitors now
+carry a fixed-bucket log-scale latency histogram from this package, so
+the shutdown report (and any exporter) sees p50/p90/p99/max — tail
+regressions on the batched, compressed PS plane do not hide behind a
+stable mean.
+
+Three cooperating pieces:
+
+* :mod:`~multiverso_tpu.telemetry.histogram` — the lock-free (caller-
+  synchronized) log2-bucket histogram every Monitor embeds.
+* :mod:`~multiverso_tpu.telemetry.trace` — per-request trace IDs carried
+  in PS frame meta (``ps/wire.TRACE_META_KEY``) and ``trace_event``-format
+  spans recorded on both endpoints, dumped as JSONL for Perfetto
+  (``tools/dump_metrics.py to-perfetto`` wraps them for the viewer)
+  alongside the XLA traces from ``utils/profiling.py``.
+* :mod:`~multiverso_tpu.telemetry.exporter` — flag-gated background
+  thread (``metrics_interval_s`` / ``metrics_dir``) dumping Dashboard +
+  shard snapshots as JSONL and Prometheus-style text.
+
+See docs/OBSERVABILITY.md for the end-to-end story (including the
+MSG_STATS remote-dashboard RPC in ``ps/service.py``).
+"""
+
+from multiverso_tpu.telemetry.histogram import Histogram  # noqa: F401

@@ -1,0 +1,212 @@
+"""Periodic metrics exporter: Dashboard + shard snapshots to disk.
+
+Flag-gated (``metrics_interval_s`` > 0 and a ``metrics_dir``): a daemon
+thread wakes every interval and writes
+
+* ``metrics-rank<r>.jsonl`` — one JSON object per interval (append):
+  ``{"ts": epoch_s, "rank": r, "monitors": {name: hist-dict}, "shards":
+  {table: stats-dict}, "notes": {...}}`` — the same shape MSG_STATS
+  returns, so ``tools/dump_metrics.py`` prints/diffs either source.
+* ``metrics-rank<r>.prom`` — Prometheus text exposition (atomically
+  replaced each interval), for scrape-style consumption.
+* buffered trace spans (telemetry/trace.py) appended to
+  ``trace-rank<r>.jsonl`` when tracing is on.
+
+Off by default: with ``metrics_interval_s=0`` nothing starts and the
+hot path never sees this module. One exporter per process (started by
+the first PSService or Zoo.start, whichever comes first); ``stop()``
+writes a final snapshot so short runs still leave a record.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from multiverso_tpu.utils import config, log
+
+config.define_string(
+    "metrics_dir", "",
+    "directory for telemetry output (metrics-rank<r>.jsonl JSONL "
+    "snapshots, metrics-rank<r>.prom Prometheus text, trace-rank<r>."
+    "jsonl spans); empty disables file output")
+config.define_float(
+    "metrics_interval_s", 0.0,
+    "seconds between background metrics exports to metrics_dir; "
+    "0 disables the exporter thread (a final snapshot is still written "
+    "at shutdown when metrics_dir is set)")
+
+
+def _prom_name(name: str) -> str:
+    """Monitor name -> a Prometheus-safe label value (names like
+    ``table[we].add_rows`` keep their structure inside the label)."""
+    return name.replace('"', "'").replace("\\", "/")
+
+
+def prometheus_text(payload: Dict) -> str:
+    """Render a stats payload (exporter record / MSG_STATS reply meta)
+    as Prometheus text exposition."""
+    lines = [
+        "# HELP mv_monitor_count samples observed per monitor",
+        "# TYPE mv_monitor_count counter",
+        "# TYPE mv_monitor_total_ms counter",
+        "# TYPE mv_monitor_p50_ms gauge",
+        "# TYPE mv_monitor_p99_ms gauge",
+        "# TYPE mv_monitor_max_ms gauge",
+    ]
+    rank = payload.get("rank", 0)
+    for name in sorted(payload.get("monitors", {})):
+        m = payload["monitors"][name]
+        lbl = f'{{name="{_prom_name(name)}",rank="{rank}"}}'
+        lines.append(f"mv_monitor_count{lbl} {m.get('count', 0)}")
+        lines.append(f"mv_monitor_total_ms{lbl} {m.get('sum_ms', 0.0)}")
+        # percentile gauges only for monitors with TIMED samples: an
+        # incr-only counter (count>0, timed=0) must show "no latency
+        # data", not a fake 0.0 ms latency
+        if m.get("timed", m.get("count")):
+            for k in ("p50_ms", "p99_ms", "max_ms"):
+                lines.append(f"mv_monitor_{k}{lbl} {m.get(k, 0.0)}")
+    for table in sorted(payload.get("shards", {})):
+        s = payload["shards"][table]
+        for k, v in sorted(s.items()):
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                lines.append(
+                    f'mv_shard_{k}{{table="{_prom_name(table)}",'
+                    f'rank="{rank}"}} {v}')
+    return "\n".join(lines) + "\n"
+
+
+class MetricsExporter:
+    """One per process; see module docstring."""
+
+    def __init__(self, rank: int, directory: str, interval_s: float,
+                 stats_fn: Callable[[], Dict]):
+        self.rank = int(rank)
+        self.directory = directory
+        self.interval_s = float(interval_s)
+        self._stats_fn = stats_fn
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # serializes export_once: the periodic thread and export_global
+        # (PSContext.close) share the JSONL/.prom/.tmp files — two
+        # unsynchronized appends can interleave mid-line and corrupt a
+        # record
+        self._io_lock = threading.Lock()
+
+    # ------------------------------------------------------------------ #
+    def start(self) -> "MetricsExporter":
+        if self.interval_s > 0 and self.directory and self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="mv-metrics", daemon=True)
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.export_once()
+            except Exception as e:  # noqa: BLE001 — telemetry must not
+                log.error("metrics export failed: %s", e)  # kill the run
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if self.directory:
+            try:
+                self.export_once()   # final snapshot, even interval=0
+            except Exception as e:  # noqa: BLE001
+                log.error("final metrics export failed: %s", e)
+
+    # ------------------------------------------------------------------ #
+    def export_once(self) -> Dict:
+        """One snapshot -> JSONL append + .prom replace (+ trace drain).
+        Returns the record (tests consume it directly). Serialized on
+        ``_io_lock`` — see __init__."""
+        payload = dict(self._stats_fn())
+        payload["ts"] = round(time.time(), 3)
+        payload.setdefault("rank", self.rank)
+        if not self.directory:
+            return payload
+        with self._io_lock:
+            os.makedirs(self.directory, exist_ok=True)
+            jpath = os.path.join(self.directory,
+                                 f"metrics-rank{self.rank}.jsonl")
+            with open(jpath, "a") as f:
+                f.write(json.dumps(payload) + "\n")
+            ppath = os.path.join(self.directory,
+                                 f"metrics-rank{self.rank}.prom")
+            tmp = ppath + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(prometheus_text(payload))
+            os.replace(tmp, ppath)
+        from multiverso_tpu.telemetry import trace as _trace
+        _trace.dump_to(self.directory)
+        return payload
+
+
+# ------------------------------------------------------------------ #
+# process-global lifecycle (first starter wins; idempotent stop)
+# ------------------------------------------------------------------ #
+_global: Optional[MetricsExporter] = None
+_global_lock = threading.Lock()
+
+
+def default_stats_fn() -> Dict:
+    """Dashboard-only payload for processes without a PSService (the
+    service installs a richer one that adds its shard registry)."""
+    from multiverso_tpu.utils.dashboard import Dashboard
+    return {
+        "monitors": {name: snap.hist_dict()
+                     for name, snap in Dashboard.snapshot().items()},
+        "notes": Dashboard.notes(),
+        "shards": {},
+    }
+
+
+def ensure_started(rank: int,
+                   stats_fn: Optional[Callable[[], Dict]] = None
+                   ) -> Optional[MetricsExporter]:
+    """Start the process exporter if flags enable it (idempotent; the
+    first caller's ``stats_fn`` wins — a PSService starting after Zoo
+    upgrades the Dashboard-only exporter to its richer payload)."""
+    global _global
+    directory = config.get_flag("metrics_dir")
+    interval = config.get_flag("metrics_interval_s")
+    if not directory:
+        return None
+    with _global_lock:
+        if _global is None:
+            _global = MetricsExporter(
+                rank, directory, interval,
+                stats_fn or default_stats_fn).start()
+        elif stats_fn is not None and \
+                _global._stats_fn is default_stats_fn:
+            _global._stats_fn = stats_fn
+        return _global
+
+
+def export_global() -> None:
+    """Write one snapshot through the process exporter WITHOUT stopping
+    it — the per-context shutdown hook (a process may hold several
+    PSContexts; one closing must not kill telemetry for the rest; the
+    daemon thread dies with the process or at :func:`stop_global`)."""
+    with _global_lock:
+        exp = _global
+    if exp is not None and exp.directory:
+        try:
+            exp.export_once()
+        except Exception as e:  # noqa: BLE001 — telemetry never blocks
+            log.error("metrics export at context close failed: %s", e)
+
+
+def stop_global() -> None:
+    global _global
+    with _global_lock:
+        exp, _global = _global, None
+    if exp is not None:
+        exp.stop()

@@ -66,6 +66,14 @@ class Zoo:
         config.parse_cmd_flags(argv)
         log.configure_from_flags()
         self._mesh = mesh if mesh is not None else self._default_mesh()
+        # telemetry plane: adopt the trace_ids flag and start the
+        # flag-gated metrics exporter (both no-ops unless configured; a
+        # PSService starting later upgrades the exporter's payload with
+        # its shard registry)
+        from multiverso_tpu.telemetry import exporter as _exporter
+        from multiverso_tpu.telemetry import trace as _trace
+        _trace.configure(self.rank())
+        _exporter.ensure_started(self.rank())
         self._started = True
         log.info(
             "multiverso_tpu started: process %d/%d, %d devices in mesh %s, "
@@ -90,7 +98,9 @@ class Zoo:
         if config.get_flag("dashboard"):
             # natively-served async ops never cross the Python monitor
             # (that's the point of them), so surface the C++ counters in
-            # the shutdown report alongside the monitored paths
+            # the shutdown report alongside the monitored paths — BEFORE
+            # the final exporter snapshot, so the last metrics record
+            # carries them too
             for table in list(self._tables.values()):
                 shard = getattr(table, "_shard", None)
                 if shard is None or getattr(shard, "_native_ref",
@@ -101,6 +111,19 @@ class Zoo:
                     Dashboard.note(
                         f"ps[{table.name}].native_served",
                         f"adds = {adds}, applies = {applies}")
+        # final telemetry flush while the monitors still hold this run's
+        # numbers (the exporter's stop() writes a last snapshot; buffered
+        # trace spans drain to metrics_dir)
+        from multiverso_tpu.telemetry import exporter as _exporter
+        from multiverso_tpu.telemetry import trace as _trace
+        _exporter.stop_global()
+        d = config.get_flag("metrics_dir")
+        if d:
+            try:
+                _trace.dump_to(d)
+            except OSError as e:
+                log.error("trace dump at shutdown failed: %s", e)
+        if config.get_flag("dashboard"):
             Dashboard.display(log.info)
             # a second init/stop cycle must not reprint this run's
             # counters as its own

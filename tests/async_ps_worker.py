@@ -25,6 +25,14 @@ Modes:
           disjoint row set, interleaved with fenced gets that must
           read its own writes; the converged state must equal the
           integer expectation bit-for-bit on every rank.
+  stats — the PR-3 telemetry plane end to end: trace_ids on, windowed
+          adds to the REMOTE shard, then (a) rank 0 pulls rank 1's
+          server-side stats via the MSG_STATS RPC
+          (table.server_stats), (b) every rank dumps its trace spans
+          as JSONL to MV_METRICS_DIR, (c) the dashboard p50/p99 for
+          add_rows/get_rows land in RESULT. The parent test stitches
+          the two ranks' trace files and asserts a client span and a
+          shard span share one trace ID.
 Prints "RESULT <json>" on success.
 """
 
@@ -280,6 +288,55 @@ def main():
             "table[mp_win].add_rows.windowed").count
         out["flushes"] = Dashboard.get(
             "table[mp_win].add_rows.flushes").count
+        _sync_point(rdv_dir, world, rank, "done")
+
+    elif mode == "stats":
+        from multiverso_tpu.telemetry import trace as ttrace
+        from multiverso_tpu.utils.dashboard import Dashboard
+        metrics_dir = os.environ["MV_METRICS_DIR"]
+        config.set_flag("trace_ids", True)
+        config.set_flag("metrics_dir", metrics_dir)
+        ttrace.configure(rank)   # ctx (and its service) already exist
+        num_row = 8 * world
+        t = AsyncMatrixTable(num_row, 4, name="mp_stats",
+                             send_window_ms=5.0, ctx=ctx)
+        _sync_point(rdv_dir, world, rank, "tables")
+        # windowed adds to the NEXT rank's rows: every span chain crosses
+        # a real socket (overlapping rows force MSG_BATCH sub-ops too)
+        peer = (rank + 1) % world
+        peer_rows = np.arange(8) * world + peer
+        for i in range(20):
+            t.add_rows_async([int(peer_rows[i % 8])],
+                             np.ones((1, 4), np.float32))
+        t.flush()
+        got = t.get_rows(peer_rows)   # fenced read (adds are acked)
+        # all 20 unit deltas landed (window merging may have shipped
+        # them as fewer wire-level sub-ops — that's the point of it)
+        assert float(got.sum()) >= 20 * 4, got
+        _sync_point(rdv_dir, world, rank, "pushed")
+        # (a) remote dashboard: pull the peer's snapshot over MSG_STATS
+        st = t.server_stats(peer)
+        assert st["rank"] == peer, st["rank"]
+        shard = st["shards"]["mp_stats"]
+        assert shard["adds"] >= 3, shard
+        assert shard["applies"] >= 1, shard
+        assert shard["version"] >= 1, shard
+        assert "wave_ops" in shard and "queue_depth" in shard, shard
+        # the peer's serve monitors crossed its dashboard
+        assert any(n.startswith("ps[mp_stats].") for n in st["monitors"])
+        # (c) local client latency histograms: p50/p99 present and sane
+        out["monitors"] = {}
+        for op in ("add_rows", "get_rows"):
+            snap = Dashboard.get(f"table[mp_stats].{op}").snapshot()
+            assert snap.timed > 0 and snap.p99_ms >= snap.p50_ms > 0, snap
+            assert "p50" in snap.info_string(), snap.info_string()
+            out["monitors"][op] = snap.brief_dict()
+        out["shard_adds"] = shard["adds"]
+        out["stats_rank"] = st["rank"]
+        # (b) dump this rank's spans for the parent to stitch
+        n = ttrace.dump_to(metrics_dir)
+        out["spans"] = n
+        assert n > 0
         _sync_point(rdv_dir, world, rank, "done")
 
     elif mode == "ftrl_lr":

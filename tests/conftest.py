@@ -79,3 +79,10 @@ def _fresh_runtime():
         zoo.stop()
     config.reset_flags()
     Dashboard.reset()
+    # telemetry plane: a test that enabled tracing/export must not leak
+    # spans or a running exporter thread into its neighbors
+    from multiverso_tpu.telemetry import exporter as _exporter
+    from multiverso_tpu.telemetry import trace as _trace
+    _exporter.stop_global()
+    _trace.TRACER.reset()
+    _trace.TRACER.enabled = False

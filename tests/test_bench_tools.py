@@ -71,6 +71,56 @@ def test_array_table_bench_smoke():
             assert key in snap and snap[key].count > 0, key
 
 
+def test_dump_metrics_tool(tmp_path):
+    """tools/dump_metrics smoke: show/diff real exporter records and
+    wrap a JSONL trace for Perfetto — the bench-comparison workflow the
+    telemetry plane exists for."""
+    import json
+    import time
+
+    from multiverso_tpu.telemetry.exporter import MetricsExporter
+    from multiverso_tpu.utils.dashboard import Dashboard, monitor
+    from tools.dump_metrics import (diff_records, format_record,
+                                    load_records, main, pick_record,
+                                    to_perfetto)
+
+    def payload():
+        return {"rank": 0,
+                "monitors": {n: s.hist_dict()
+                             for n, s in Dashboard.snapshot().items()},
+                "notes": {"n": "x = 1"},
+                "shards": {"t": {"kind": "row", "adds": 2,
+                                 "queue_depth": 0}}}
+
+    with monitor("tool.op"):
+        time.sleep(0.001)
+    exp = MetricsExporter(0, str(tmp_path), 0.0, payload)
+    exp.export_once()
+    with monitor("tool.op"):
+        pass
+    exp.export_once()
+    path = str(tmp_path / "metrics-rank0.jsonl")
+    recs = load_records(path)
+    assert len(recs) == 2
+    text = format_record(pick_record(recs))
+    assert "tool.op" in text and "p50" in text and "shard[t]" in text
+    dtext = diff_records(recs[0], recs[1])
+    assert "tool.op" in dtext and "p50 b/a" in dtext
+    # trace wrap: JSONL events -> Perfetto envelope
+    tpath = str(tmp_path / "trace.jsonl")
+    with open(tpath, "w") as f:
+        f.write(json.dumps({"name": "s", "ph": "X", "ts": 1, "dur": 2,
+                            "pid": 0, "tid": 1, "args": {}}) + "\n")
+    out = str(tmp_path / "trace.json")
+    assert to_perfetto(tpath, out) == 1
+    with open(out) as f:
+        env = json.load(f)
+    assert env["traceEvents"][0]["name"] == "s"
+    # CLI entry points return 0
+    assert main(["show", path]) == 0
+    assert main(["diff", path, path]) == 0
+
+
 def test_bench_truncation_recording(tmp_path):
     """The SIGTERM salvage exits bench.TRUNCATED_EXIT (documented,
     nonzero, distinct from a hard failure) and tools/run_bench records

@@ -97,6 +97,61 @@ def test_send_window_across_processes(tmp_path, nprocs):
         assert 0 < r["flushes"] <= r["windowed"]
 
 
+def test_stats_and_trace_across_processes(tmp_path):
+    """PR-3 telemetry acceptance at the real OS-process tier: a worker
+    pulls the REMOTE shard's server-side stats via the MSG_STATS RPC, a
+    windowed add's client spans and the owning shard's apply spans share
+    one trace ID across the two ranks' JSONL trace files, and the
+    dashboard histograms report p50/p99 for add_rows and get_rows."""
+    metrics_dir = str(tmp_path / "metrics")
+    os.makedirs(metrics_dir, exist_ok=True)
+    results = _spawn(tmp_path, 2, "stats",
+                     extra_env={"MV_METRICS_DIR": metrics_dir})
+    assert set(results) == {0, 1}
+    for rank, r in results.items():
+        assert r["stats_rank"] == (rank + 1) % 2
+        assert r["shard_adds"] >= 3
+        assert r["spans"] > 0
+        for op in ("add_rows", "get_rows"):
+            m = r["monitors"][op]
+            assert m["count"] > 0 and m["p99_ms"] >= m["p50_ms"] > 0
+    # stitch the two ranks' trace files: a client-side span (enqueue)
+    # minted on one rank must share its trace ID with a shard-side apply
+    # span recorded on the OTHER rank
+    events = []
+    for rank in (0, 1):
+        path = os.path.join(metrics_dir, f"trace-rank{rank}.jsonl")
+        assert os.path.exists(path), path
+        with open(path) as f:
+            events += [json.loads(line) for line in f if line.strip()]
+    def ids(names):
+        out = set()
+        for e in events:
+            if e["name"] in names:
+                out.add(e["args"].get("trace"))
+                out.update(e["args"].get("traces", ()))
+        out.discard(None)
+        return out
+    client = ids({"client.enqueue"})
+    shard = ids({"shard.wave_apply", "shard.apply"})
+    shared = client & shard
+    assert shared, (sorted(e["name"] for e in events)[:20],
+                    len(client), len(shard))
+    # spans are trace_event "complete" events with absolute us timestamps
+    for e in events:
+        assert e["ph"] == "X" and e["ts"] > 0 and e["dur"] >= 0
+        assert e["pid"] in (0, 1)
+    # the client and shard halves of a shared trace came from DIFFERENT
+    # ranks (the ID really crossed the wire)
+    by_trace = {}
+    for e in events:
+        for tid in ([e["args"].get("trace")]
+                    + list(e["args"].get("traces", ()))):
+            if tid in shared:
+                by_trace.setdefault(tid, set()).add(e["pid"])
+    assert any(len(pids) == 2 for pids in by_trace.values()), by_trace
+
+
 @pytest.mark.parametrize("nprocs", [4])
 def test_uncoordinated_sparse_ftrl_lr(tmp_path, nprocs):
     """np=4 sparse FTRL LR through the app, uncoordinated: each rank trains

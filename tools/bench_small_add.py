@@ -106,12 +106,19 @@ def main():
                 "window-off under the identical add stream")
         mon = {k: Dashboard.get(f"table[sa_on].add_rows.{k}").count
                for k in ("windowed", "flushes", "merged_rows")}
+        # telemetry-plane record: the monitors' own latency histograms
+        # (every add_rows call both arms made, warmup included) ride
+        # along with the timed-loop percentiles above — p50/p99/max per
+        # arm instead of a bare mean
+        hist = {arm: Dashboard.get(f"table[{arm}].add_rows")
+                .snapshot().brief_dict()
+                for arm in ("sa_on", "sa_off")}
         for c in ctxs:
             c.close()
 
     print("RESULT " + json.dumps(dict(
         best, iters=iters, passes=passes, window_counters=mon,
-        parity_bit_for_bit=parity)), flush=True)
+        latency_hist=hist, parity_bit_for_bit=parity)), flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,146 @@
+"""Pretty-print / diff telemetry JSONL metric snapshots, and wrap JSONL
+trace files for Perfetto.
+
+The exporter (multiverso_tpu/telemetry/exporter.py) appends one JSON
+record per interval to ``metrics-rank<r>.jsonl``; MSG_STATS replies and
+``table.server_stats(rank)`` return the same shape. This tool makes those
+records comparable across bench runs:
+
+  python tools/dump_metrics.py show  <metrics.jsonl> [--record N]
+  python tools/dump_metrics.py diff  <a.jsonl> <b.jsonl>
+  python tools/dump_metrics.py to-perfetto <trace.jsonl> <out.json>
+
+``show`` prints the chosen record (default: last) as a monitor table
+(count / mean / p50 / p90 / p99 / max) plus the shard stats. ``diff``
+aligns two records by monitor name and reports count deltas and p50/p99
+ratios — the "did this bench run regress the tail" question in one
+screen. ``to-perfetto`` wraps a JSONL trace-event file into the
+``{"traceEvents": [...]}`` envelope the Perfetto UI / chrome://tracing
+expect (events from several ranks' files may be concatenated first; the
+spans carry ``pid`` = rank).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Dict, List, Optional
+
+
+def load_records(path: str) -> List[Dict]:
+    """All JSON records of a JSONL file (blank lines skipped)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    if not out:
+        raise ValueError(f"{path}: no records")
+    return out
+
+
+def pick_record(records: List[Dict], index: Optional[int] = None) -> Dict:
+    return records[-1 if index is None else index]
+
+
+def _fmt(v: float) -> str:
+    return f"{v:>9.3f}"
+
+
+def format_record(rec: Dict) -> str:
+    """One record -> the human table (pure function; tested directly)."""
+    lines = [f"rank {rec.get('rank', '?')}  ts {rec.get('ts', '?')}  "
+             f"addr {rec.get('addr', '-')}"]
+    mons = rec.get("monitors", {})
+    if mons:
+        lines.append(f"{'monitor':<44} {'count':>8} {'mean':>9} "
+                     f"{'p50':>9} {'p90':>9} {'p99':>9} {'max':>9}")
+        for name in sorted(mons):
+            m = mons[name]
+            count = m.get("count", 0)
+            mean = m.get("sum_ms", 0.0) / count if count else 0.0
+            row = f"{name:<44} {count:>8}"
+            if m.get("timed", m.get("count")):
+                row += (f" {_fmt(mean)} {_fmt(m.get('p50_ms', 0))}"
+                        f" {_fmt(m.get('p90_ms', 0))}"
+                        f" {_fmt(m.get('p99_ms', 0))}"
+                        f" {_fmt(m.get('max_ms', 0))}")
+            lines.append(row)
+    for table in sorted(rec.get("shards", {})):
+        s = dict(rec["shards"][table])
+        apply_h = s.pop("apply", None)
+        lines.append(f"shard[{table}]: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(s.items())))
+        if apply_h and apply_h.get("count"):
+            lines.append(
+                f"  apply: count={apply_h['count']} "
+                f"p50={apply_h['p50_ms']:.3f} p99={apply_h['p99_ms']:.3f} "
+                f"max={apply_h['max_ms']:.3f} ms")
+    for name in sorted(rec.get("notes", {})):
+        lines.append(f"note[{name}] {rec['notes'][name]}")
+    return "\n".join(lines)
+
+
+def diff_records(a: Dict, b: Dict) -> str:
+    """Align two records by monitor name; report count delta and
+    p50/p99 ratios (b relative to a — >1 means b is slower)."""
+    am, bm = a.get("monitors", {}), b.get("monitors", {})
+    names = sorted(set(am) | set(bm))
+    lines = [f"{'monitor':<44} {'count a':>8} {'count b':>8} "
+             f"{'p50 b/a':>8} {'p99 b/a':>8}"]
+    for name in names:
+        ma, mb = am.get(name), bm.get(name)
+        if ma is None or mb is None:
+            lines.append(f"{name:<44} "
+                         f"{'-' if ma is None else ma.get('count', 0):>8} "
+                         f"{'-' if mb is None else mb.get('count', 0):>8} "
+                         f"{'only ' + ('b' if ma is None else 'a'):>8}")
+            continue
+        row = (f"{name:<44} {ma.get('count', 0):>8} "
+               f"{mb.get('count', 0):>8}")
+        if ma.get("p50_ms") and mb.get("p50_ms") is not None:
+            row += f" {mb['p50_ms'] / ma['p50_ms']:>8.2f}"
+            if ma.get("p99_ms"):
+                row += f" {mb['p99_ms'] / ma['p99_ms']:>8.2f}"
+        lines.append(row)
+    return "\n".join(lines)
+
+
+def to_perfetto(trace_jsonl: str, out_path: str) -> int:
+    """JSONL trace events -> Perfetto/chrome JSON envelope; returns the
+    event count."""
+    events = load_records(trace_jsonl)
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return len(events)
+
+
+def main(argv: List[str]) -> int:
+    if not argv:
+        print(__doc__)
+        return 2
+    cmd, rest = argv[0], argv[1:]
+    if cmd == "show":
+        idx = None
+        if "--record" in rest:
+            i = rest.index("--record")
+            idx = int(rest[i + 1])
+            rest = rest[:i] + rest[i + 2:]
+        print(format_record(pick_record(load_records(rest[0]), idx)))
+        return 0
+    if cmd == "diff":
+        a = pick_record(load_records(rest[0]))
+        b = pick_record(load_records(rest[1]))
+        print(diff_records(a, b))
+        return 0
+    if cmd == "to-perfetto":
+        n = to_perfetto(rest[0], rest[1])
+        print(f"wrote {n} events to {rest[1]}")
+        return 0
+    print(__doc__)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
