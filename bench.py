@@ -848,6 +848,22 @@ def bench_resnet(depth: int = 32, n_images: int = 50_000):
             "vs_ref_torch_titanx": 20.366 / sec_50k}
 
 
+def _flightrec_salvage_dump(signum) -> "Optional[str]":
+    """Flight-recorder half of the SIGTERM salvage (separate function so
+    tests exercise it without a live signal): record the signal and dump
+    the black box — a truncated run must leave its tape, not just its
+    headline. Returns the dump path (None when no dump directory
+    resolves or the recorder is unavailable)."""
+    try:
+        from multiverso_tpu.telemetry import flightrec
+        flightrec.record(flightrec.EV_SIGNAL,
+                         note=f"bench salvage: signal {signum}")
+        return flightrec.dump_global(f"bench salvage: signal {signum}",
+                                     stacks=True)
+    except BaseException:   # noqa: BLE001 — salvage must keep going
+        return None
+
+
 def main() -> None:
     import signal
 
@@ -866,7 +882,8 @@ def main() -> None:
     # complete one (tools/run_bench.py records the distinction).
     def _salvage(signum, frame):
         ok = False
-        try:
+        _flightrec_salvage_dump(signum)   # black box first: the print
+        try:                              # below may be the thing that dies
             print(json.dumps(_headline(words_per_sec_chip, {
                 "truncated": f"bench interrupted by signal {signum}; "
                              "secondary metrics incomplete",
@@ -979,6 +996,18 @@ def main() -> None:
         dashboard_hist = _dashboard_hist()
     except Exception as e:
         dashboard_hist = {"error": f"{type(e).__name__}: {e}"[:200]}
+    # flight-recorder plane, snapshotted BEFORE shutdown: a non-zero
+    # count here means a FAULT dumped during the run (watchdog trip,
+    # peer death, fatal) — a diagnosable anomaly even when every
+    # sub-bench "succeeded". The routine Zoo.stop tape lands AFTER this
+    # snapshot, so it never pollutes the anomaly signal; it still shows
+    # up in tools/run_bench.py's dump-file listing (whose headers name
+    # each dump's reason).
+    try:
+        from multiverso_tpu.telemetry import flightrec
+        flightrec_dumps = flightrec.dump_stats()
+    except Exception as e:
+        flightrec_dumps = {"error": f"{type(e).__name__}: {e}"[:200]}
     mv.shutdown()
 
     baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -1012,6 +1041,7 @@ def main() -> None:
         "lm_decode_b8_d256_L4": decode_stats,
         "small_add_send_window": small_add_stats,
         "dashboard_hist": dashboard_hist,
+        "flightrec_dumps": flightrec_dumps,
     }
     if _DEGENERATE_DIFFERENTIALS:
         # floored noise-negative slopes (see _differential): the raw pairs

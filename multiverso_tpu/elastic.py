@@ -58,11 +58,26 @@ class Heartbeat:
 
     def beat(self) -> None:
         """Write one beacon now (atomic rename so readers never see a
-        torn write)."""
+        torn write). The beacon carries ``last_health`` — the local
+        watchdog's latest verdict (telemetry/watchdog.py) — whenever the
+        watchdog has run: a beacon that keeps arriving with
+        ``status="stuck"`` is ALIVE BUT WEDGED, which :func:`failed`'s
+        staleness test alone can never distinguish from healthy."""
+        entry = {"rank": self.rank, "step": self._step,
+                 "ts": time.time()}
+        try:
+            from multiverso_tpu.telemetry import watchdog
+            v = watchdog.last_verdict()
+            if v.get("checked"):
+                entry["last_health"] = {
+                    "status": v["status"],
+                    "oldest_inflight_s": v["oldest_inflight_s"],
+                    "inflight": v["inflight"]}
+        except Exception:   # noqa: BLE001 — liveness must not depend on
+            pass            # the health plane
         tmp = self.path + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({"rank": self.rank, "step": self._step,
-                       "ts": time.time()}, f)
+            json.dump(entry, f)
         os.replace(tmp, self.path)
 
     def start(self) -> "Heartbeat":
@@ -93,9 +108,11 @@ def peers(directory: str) -> Dict[int, Dict]:
             continue
         try:
             with open(os.path.join(directory, name)) as f:
-                entry = json.load(f)
-            entry = {"rank": int(entry["rank"]), "step": int(entry["step"]),
-                     "ts": float(entry["ts"])}
+                raw = json.load(f)
+            entry = {"rank": int(raw["rank"]), "step": int(raw["step"]),
+                     "ts": float(raw["ts"])}
+            if isinstance(raw.get("last_health"), dict):
+                entry["last_health"] = raw["last_health"]
             out[entry["rank"]] = entry
         except (ValueError, KeyError, TypeError, json.JSONDecodeError,
                 OSError):
@@ -143,19 +160,43 @@ def _tombstones(directory: str) -> Dict[int, float]:
     return out
 
 
-def failed(directory: str, timeout: float = 30.0) -> List[int]:
+def failed(directory: str, timeout: float = 30.0,
+           beacons: Optional[Dict[int, Dict]] = None) -> List[int]:
     """Ranks considered dead: beacon older than ``timeout`` seconds, OR
     tombstoned by a PS-plane death (:func:`mark_failed`) with no beacon
     newer than the one the tombstone recorded (both timestamps are the
-    subject's own clock — cross-host skew cannot pin a rejoined rank)."""
+    subject's own clock — cross-host skew cannot pin a rejoined rank).
+    ``beacons`` lets a caller that already listed the directory
+    (:func:`health`) skip the second scan of shared storage."""
     now = time.time()
-    beacons = peers(directory)
+    if beacons is None:
+        beacons = peers(directory)
     out = {r for r, e in beacons.items() if now - float(e["ts"]) > timeout}
     for rank, seen_ts in _tombstones(directory).items():
         beacon = beacons.get(rank)
         if beacon is None or float(beacon["ts"]) <= seen_ts:
             out.add(rank)
     return sorted(out)
+
+
+def health(directory: str, timeout: float = 30.0) -> Dict[int, str]:
+    """Per-rank liveness verdict: ``"dead"`` (stale beacon or PS-death
+    tombstone — exactly :func:`failed`'s set), ``"stuck"`` (beacon still
+    FRESH but its ``last_health`` watchdog verdict says the PS plane is
+    wedged), else ``"ok"``. The distinction :func:`failed` alone cannot
+    make: a wedged rank heartbeats forever, so a supervisor keying
+    restarts off staleness would never touch it, while one keying off
+    this verdict can (and a flight-recorder dump is already on its disk
+    — the watchdog trip that set the verdict wrote it)."""
+    beacons = peers(directory)
+    dead = set(failed(directory, timeout, beacons=beacons))
+    out: Dict[int, str] = {r: "dead" for r in dead}
+    for r, e in beacons.items():
+        if r in dead:
+            continue
+        lh = e.get("last_health") or {}
+        out[r] = "stuck" if lh.get("status") == "stuck" else "ok"
+    return out
 
 
 def bind_ps(directory: str, ctx=None) -> None:

@@ -32,6 +32,7 @@ service itself keeps serving live peers — no collective, so nobody hangs.
 from __future__ import annotations
 
 import concurrent.futures as cf
+import itertools
 import os
 import socket
 import threading
@@ -42,7 +43,9 @@ import numpy as np
 
 from multiverso_tpu.ps import wire
 from multiverso_tpu.telemetry import exporter as _exporter
+from multiverso_tpu.telemetry import flightrec as _flight
 from multiverso_tpu.telemetry import trace as _trace
+from multiverso_tpu.telemetry import watchdog as _watchdog
 from multiverso_tpu.utils import config, log
 from multiverso_tpu.utils.dashboard import monitor
 
@@ -72,6 +75,14 @@ MSG_BATCH = 0x1A
 # Surfaced as table.server_stats(rank) / PSService.stats(rank); the
 # native C++ server punts it to Python like any unknown type.
 MSG_STATS = 0x1B
+# compact liveness verdict (flight-recorder plane, PR 4): serve-loop
+# heartbeat age, shard queue depth, oldest in-flight op age, last
+# watchdog verdict — as the REPLY META (pure JSON, no blobs). Cheap by
+# construction (counter reads only, never a shard lock): it must answer
+# even when the data plane is wedged, which is exactly when it is
+# asked. Surfaced as table.server_health(rank) / PSService.health(rank);
+# the native server punts it like MSG_STATS.
+MSG_HEALTH = 0x1C
 
 config.define_string("ps_rendezvous", "",
                      "directory for async-PS rank rendezvous (empty = use "
@@ -126,6 +137,14 @@ config.define_bool("ps_native", True,
                    "clients send framed adds/gets straight from C. "
                    "Anything the native side cannot serve punts to the "
                    "Python handlers unchanged. Off = pure-Python plane")
+config.define_float("ps_health_timeout", 5.0,
+                    "MSG_HEALTH probe reply timeout seconds. Deliberately "
+                    "watchdog-scale, NOT ps_timeout (300 s): a SIGSTOPPED "
+                    "rank's kernel still completes the TCP handshake from "
+                    "the listen backlog, and the probe must classify "
+                    "'alive but wedged' in seconds — blocking a "
+                    "supervisor's poll loop for 5 minutes against the "
+                    "exact rank it is triaging would defeat the probe")
 config.define_float("ps_shutdown_grace", 60.0,
                     "seconds a rank keeps its shards served at shutdown "
                     "while waiting for peers to ALSO reach shutdown (the "
@@ -254,6 +273,9 @@ class JaxRendezvous:
 # ---------------------------------------------------------------------- #
 # client side: one persistent connection per remote rank
 # ---------------------------------------------------------------------- #
+_peer_gen = itertools.count()   # per-incarnation msg-id bases (below)
+
+
 class _Peer:
     def __init__(self, rank: int, addr: str, connect_timeout: float,
                  io_timeout: float,
@@ -283,7 +305,13 @@ class _Peer:
         self._send_lock = threading.Lock()
         self._pending: Dict[int, cf.Future] = {}
         self._pending_lock = threading.Lock()
-        self._next_id = 0
+        # msg ids start at a per-INCARNATION base (generation << 32):
+        # the flight recorder keys in-flight ops by (rank, msg_id), and
+        # a reconnected incarnation restarting at 0 would collide with
+        # the dying one's unswept ids — its death sweep could then erase
+        # the fresh incarnation's live entries (correlation is the outer
+        # frame's job either way; the server just echoes the id)
+        self._next_id = next(_peer_gen) << 32
         self._dead: Optional[Exception] = None
         self._recv_thread = threading.Thread(
             target=self._recv_loop, name=f"ps-peer-{rank}", daemon=True)
@@ -304,6 +332,8 @@ class _Peer:
                     fut = self._pending.pop(msg_id, None)
                 if fut is None:
                     continue
+                _flight.end_op(self.rank, msg_id,
+                               ok=msg_type != MSG_REPLY_ERR)
                 if msg_type == MSG_REPLY_ERR:
                     fut.set_exception(PSError(
                         f"rank {self.rank}: {meta.get('error', '?')}"))
@@ -314,6 +344,21 @@ class _Peer:
             self._dead = err
             with self._pending_lock:
                 pending, self._pending = self._pending, {}
+            # black box FIRST, while THIS incarnation's unacked ops are
+            # still in the recorder's in-flight table: the dump is the
+            # artifact that names this dead rank's oldest unacked msg
+            # for postmortem. Only a death with unacked traffic is a
+            # diagnostic event — a quiet conn dying at shutdown must not
+            # write dumps. The sweep is scoped to OUR msg ids: a
+            # reconnected fresh incarnation may already have live ops
+            # under the same rank during the dump window.
+            _flight.record(_flight.EV_PEER_DEAD, peer=self.rank,
+                           note=str(e)[:120])
+            if pending:
+                _flight.dump_global(
+                    f"peer rank {self.rank} connection lost with "
+                    "requests in flight")
+            _flight.RECORDER.fail_peer(self.rank, msg_ids=list(pending))
             for fut in pending.values():
                 if not fut.done():
                     fut.set_exception(err)
@@ -331,17 +376,35 @@ class _Peer:
             self._next_id += 1
             with self._pending_lock:
                 self._pending[msg_id] = fut
+            # probes are tracked in flight (stuck probes should age) but
+            # keep their send/ack edges out of the ring — supervisor
+            # polling must not wrap the tape (server-side rule mirrored)
+            _flight.begin_op(self.rank, msg_id, msg_type,
+                             sum(getattr(a, "nbytes", 0) for a in arrays),
+                             record=msg_type not in (MSG_PING, MSG_STATS))
             try:
                 wire.send(self._sock, msg_type, msg_id, meta, arrays)
             except OSError as e:
                 err = PSPeerError(f"rank {self.rank} send failed: {e}")
                 self._dead = err
+                _flight.end_op(self.rank, msg_id, ok=False)
                 with self._pending_lock:
                     self._pending.pop(msg_id, None)
                 fut.set_exception(err)
                 if self._on_death is not None:
                     self._on_death(self, err)
                 return fut
+            except BaseException:
+                # encode/packing failure (bad meta, exotic array): not a
+                # peer-death signal — unwind THIS op's bookkeeping and
+                # re-raise. Leaving the recorder entry would age into a
+                # permanent spurious "stuck" verdict (fail_peer never
+                # sweeps a live peer), and leaving the pending future
+                # would hold its waiter to the full ps_timeout.
+                _flight.end_op(self.rank, msg_id, ok=False)
+                with self._pending_lock:
+                    self._pending.pop(msg_id, None)
+                raise
         # the recv loop may have died BETWEEN the entry _dead check and the
         # _pending insert (it fails only futures it saw in _pending when it
         # swept) — re-check so this future fails fast instead of dangling
@@ -349,6 +412,13 @@ class _Peer:
         if self._dead is not None:
             with self._pending_lock:
                 still = self._pending.pop(msg_id, None)
+            # close the recorder's entry UNCONDITIONALLY (end_op is
+            # idempotent): the recv loop's fail_peer sweep may have run
+            # BEFORE begin_op registered this op — in that interleaving
+            # the sweep also already took _pending[msg_id], so gating on
+            # `still` would skip the close and the orphaned entry would
+            # age forever: a permanent spurious "stuck" verdict
+            _flight.end_op(self.rank, msg_id, ok=False)
             if still is not None and not fut.done():
                 fut.set_exception(self._dead)
         return fut
@@ -397,8 +467,13 @@ class PSService:
         self._shards: Dict[str, Any] = {}
         self._handlers_cv = threading.Condition()
         # telemetry: adopt the trace_ids flag under this service's rank
-        # (the exporter starts at the END of __init__, once addr exists)
+        # (the exporter starts at the END of __init__, once addr exists);
+        # the always-on flight recorder pins the same rank and the
+        # watchdog thread starts (flag-gated) to age its in-flight table
         _trace.configure(rank)
+        _flight.configure(rank)
+        log.set_rank(rank)
+        _watchdog.ensure_started()
         self._peers: Dict[int, _Peer] = {}
         self._peers_lock = threading.Lock()
         self._peer_locks: Dict[int, threading.Lock] = {}
@@ -530,6 +605,16 @@ class PSService:
                 log.debug("ps native punt: ERR reply for malformed frame "
                           "failed; dropping")
             return
+        # the serve beat AND the ring edges mark DATA-PLANE liveness:
+        # health/stats/ping probes refresh neither — a wedged server
+        # polled at 2 Hz must report a growing serve_age_s, and probe
+        # noise must not wrap the ring past the pre-wedge evidence
+        # before the operator reads the (refreshed-in-place) fault dump
+        probe = msg_type in (MSG_PING, MSG_STATS, MSG_HEALTH)
+        if not probe:
+            _flight.beat("serve")
+            _flight.record(_flight.EV_RECV, msg_type=msg_type,
+                           msg_id=msg_id)
         try:
             if msg_type == MSG_PING:       # native serves PING; belt only
                 reply = wire.encode(MSG_REPLY_OK, msg_id,
@@ -537,6 +622,9 @@ class PSService:
             elif msg_type == MSG_STATS:    # remote dashboard pull
                 reply = wire.encode(MSG_REPLY_OK, msg_id,
                                     self.stats_payload())
+            elif msg_type == MSG_HEALTH:   # liveness verdict pull
+                reply = wire.encode(MSG_REPLY_OK, msg_id,
+                                    self.health_payload())
             else:
                 handler = self._wait_handler(meta["table"])
                 tr = (meta.get(wire.TRACE_META_KEY)
@@ -556,6 +644,9 @@ class PSService:
         # _native_raw, not _native: close() clears the latter while punts
         # may still be in flight; the raw handle stays valid until
         # server_free (which runs after this conn thread is joined)
+        if not probe:
+            _flight.record(_flight.EV_REPLY, msg_type=msg_type,
+                           msg_id=msg_id, nbytes=len(reply))
         ps_native.send_raw(self._native_raw, conn_id, reply)
 
     # ----------------------------- telemetry -------------------------- #
@@ -593,6 +684,119 @@ class PSService:
         meta, _ = await_reply(
             fut, timeout or config.get_flag("ps_timeout"),
             f"stats from rank {rank}")
+        return meta
+
+    def health_payload(self) -> Dict:
+        """This rank's compact liveness verdict (the MSG_HEALTH reply
+        meta): serve-loop heartbeat age, summed shard apply-queue depth,
+        oldest in-flight op age, and the last watchdog verdict. Counter
+        reads ONLY — no shard lock, no native crossing: a health probe
+        must answer even when the data plane is wedged."""
+        with self._handlers_cv:
+            shards = list(self._shards.values())
+        queue_depth = 0
+        for s in shards:
+            depth = getattr(s, "queue_depth", None)   # RowShard's lock-
+            if callable(depth):                       # free accessor;
+                queue_depth += depth()                # KV shards: none
+        # ONE in-flight snapshot serves both fields (oldest + count):
+        # this path contends the hot-path ring lock and is polled, so it
+        # must not copy the table twice per probe
+        snap = _flight.RECORDER.inflight_snapshot()
+        oldest = (max(snap, key=lambda e: e[2]) if snap else None)
+        wd = _watchdog.last_verdict()
+        serve_age = _flight.RECORDER.beat_age("serve")
+        apply_age = _flight.RECORDER.beat_age("apply")
+        return {
+            "rank": self.rank, "addr": self.addr,
+            "ts": round(time.time(), 3),
+            # beat ages: PYTHON-plane liveness only. None = that loop
+            # never ran (no python-plane traffic yet), a growing number
+            # = how long it has been quiet. Probe traffic (PING/STATS/
+            # HEALTH) does not refresh them, and neither do natively-
+            # served ops (zero-Python path, same rule as tracing) — the
+            # "native" flag below tells consumers to discount quiet
+            # beats on a native-serving rank rather than read them as a
+            # wedge (the in-flight/watchdog fields are plane-agnostic).
+            "native": self._native_raw is not None,
+            "serve_age_s": (None if serve_age is None
+                            else round(serve_age, 3)),
+            "apply_age_s": (None if apply_age is None
+                            else round(apply_age, 3)),
+            "queue_depth": queue_depth,
+            "inflight": len(snap),
+            "oldest_inflight_s": (round(oldest[2], 3) if oldest else 0.0),
+            "oldest_inflight": ({"peer": oldest[0], "msg_id": oldest[1],
+                                 "type": oldest[3]} if oldest else None),
+            "watchdog": wd,
+            # headline verdict: the watchdog's view when it has run, else
+            # "ok" (an unwatched plane that answered this RPC is serving)
+            "status": wd["status"] if wd.get("checked") else "ok",
+        }
+
+    def health(self, rank: int, timeout: Optional[float] = None) -> Dict:
+        """Pull ``rank``'s liveness verdict over MSG_HEALTH (local rank
+        short-circuits). The probe rides its OWN one-shot connection,
+        never the shared data conn: per-conn FIFO would queue it behind
+        the very data op that is wedged (and behind this caller's own
+        outstanding traffic), turning "alive but stuck" into a 300 s
+        timeout — the opposite of a liveness probe. A fresh conn gets a
+        fresh handler thread on the Python server (and a fresh C++
+        serving thread on the native one), so the answer only requires
+        the accept loop to be alive — and the reply wait defaults to
+        ps_health_timeout (seconds), not ps_timeout: a fully frozen
+        rank accepts the handshake in-kernel and then never answers,
+        and the probe must return in triage time, not 5 minutes. Raises
+        PSPeerError for a dead/unresponsive rank — which IS the 'not
+        serving' answer, typed."""
+        if rank == self.rank:
+            return self.health_payload()
+        # address WITHOUT the data-plane peer registry's liveness gate:
+        # _peer() fails fast inside the reconnect-backoff window, which
+        # would report a rank "dead" during exactly the transient the
+        # probe exists to classify — and a health-only caller must not
+        # construct a full persistent peer (socket + recv thread) just
+        # to learn an address. A healthy cached peer donates its addr;
+        # otherwise the rendezvous re-resolves (so a restarted
+        # incarnation's fresh address is honored).
+        with self._peers_lock:
+            peer = self._peers.get(rank)
+        if peer is not None and peer._dead is None:
+            addr = peer.addr
+        elif self._rendezvous is not None:
+            try:
+                addr = self._rendezvous.lookup(
+                    rank, min(config.get_flag("ps_connect_timeout"),
+                              config.get_flag("ps_health_timeout")))
+            except PSError:
+                if peer is None:
+                    raise
+                addr = peer.addr   # dead peer's last known address
+        elif peer is not None:
+            addr = peer.addr
+        else:
+            raise PSError("no rendezvous configured for remote ranks")
+        host, port = addr.rsplit(":", 1)
+        timeout = timeout or config.get_flag("ps_health_timeout")
+        try:
+            # connect is budgeted like the reply: a partitioned host
+            # (SYN dropped, no RST) must not hold the triage loop for
+            # the data plane's 30 s connect timeout
+            with socket.create_connection(
+                    (host, int(port)),
+                    timeout=min(timeout,
+                                config.get_flag("ps_connect_timeout"))
+                    ) as s:
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                s.settimeout(timeout)
+                wire.send(s, MSG_HEALTH, 0, {})
+                msg_type, _mid, meta, _ = wire.recv(s)
+        except (OSError, wire.WireError, TimeoutError) as e:
+            raise PSPeerError(
+                f"health probe to rank {rank} at {addr} failed: {e}"
+            ) from e
+        if msg_type == MSG_REPLY_ERR:
+            raise PSError(f"rank {rank}: {meta.get('error', '?')}")
         return meta
 
     def _wait_handler(self, table: str, timeout: float = 20.0) -> Callable:
@@ -636,14 +840,24 @@ class PSService:
         try:
             while not self._closed:
                 msg_type, msg_id, meta, arrays = wire.recv(conn)
+                # serve-loop heartbeat + request edge for the black box
+                # (natively-served ops bypass Python and stay unrecorded,
+                # same rule as tracing). Probes neither beat nor hit the
+                # ring: see _punt.
+                if msg_type not in (MSG_PING, MSG_STATS, MSG_HEALTH):
+                    _flight.beat("serve")
+                    _flight.record(_flight.EV_RECV, msg_type=msg_type,
+                                   msg_id=msg_id)
                 if msg_type == MSG_PING:
                     with send_lock:
                         wire.send(conn, MSG_REPLY_OK, msg_id,
                                   {"rank": self.rank})
                     continue
-                if msg_type == MSG_STATS:   # remote dashboard pull
+                if msg_type in (MSG_STATS, MSG_HEALTH):  # telemetry pulls
                     try:
-                        payload = self.stats_payload()
+                        payload = (self.stats_payload()
+                                   if msg_type == MSG_STATS
+                                   else self.health_payload())
                     except Exception as e:  # noqa: BLE001
                         with send_lock:
                             wire.send(conn, MSG_REPLY_ERR, msg_id,
@@ -668,15 +882,32 @@ class PSService:
                                               "type": msg_type})
                     with send_lock:
                         wire.send(conn, MSG_REPLY_OK, msg_id, rmeta, rarrays)
+                    _flight.record(_flight.EV_REPLY, msg_type=msg_type,
+                                   msg_id=msg_id)
                 except Exception as e:  # reply errors, don't kill the conn
                     log.debug("ps handler error: %s", e)
                     with send_lock:
                         wire.send(conn, MSG_REPLY_ERR, msg_id,
                                   {"error": f"{type(e).__name__}: {e}"})
+                    # the ERR reply is a reply edge too (the punt path
+                    # records both): without it a handler error reads as
+                    # "received, never answered" — a wedged-server
+                    # signature — in postmortem timelines
+                    _flight.record(_flight.EV_REPLY, msg_type=msg_type,
+                                   msg_id=msg_id, note="err")
         except (wire.WireError, OSError):
             pass  # client went away; its shard traffic simply stops
         finally:
             conn.close()
+            # drop the registry entry too: one-shot health probes open a
+            # conn per poll, and an append-only list would leak a dead
+            # socket object per probe for process lifetime (close() only
+            # clears the list at teardown)
+            with self._conns_lock:
+                try:
+                    self._conns.remove(conn)
+                except ValueError:
+                    pass   # already cleared by close()
 
     # ----------------------------- client side ----------------------- #
     def add_death_hook(self, fn: Callable[[int], None]) -> None:

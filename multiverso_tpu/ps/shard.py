@@ -30,6 +30,7 @@ import numpy as np
 from multiverso_tpu.ps import service as svc
 from multiverso_tpu.ps import wire
 from multiverso_tpu.table import _ceil_to
+from multiverso_tpu.telemetry import flightrec as _flight
 from multiverso_tpu.tables.matrix_table import _bucket_size
 from multiverso_tpu.telemetry import trace as _trace
 from multiverso_tpu.updaters import AddOption, Updater
@@ -280,6 +281,13 @@ class RowShard:
             out["dirty_rows"] = dirty_rows   # sparse-protocol staleness
         return out
 
+    def queue_depth(self) -> int:
+        """Lock-free apply-queue depth for the health plane (len() is
+        GIL-atomic; the verdict tolerates ±1). MSG_HEALTH must never
+        take a shard lock — it answers precisely when the shard is
+        wedged — so this is deliberately NOT the stats() path."""
+        return len(self._addq)
+
     @property
     def scratch(self) -> int:
         return self.n
@@ -455,6 +463,11 @@ class RowShard:
                 self._dirty[:, local] = True   # stale for everyone
         self._version += 1
         self._mon_apply.observe_ms((time.perf_counter() - t0) * 1e3)
+        # black box: one apply edge + the shard-liveness heartbeat (a
+        # queue that stops draining shows up as a stale "apply" beat in
+        # MSG_HEALTH even before any request ages past the watchdog)
+        _flight.beat("apply")
+        _flight.record(_flight.EV_APPLY, nbytes=vals.nbytes)
 
     # shared continuation pool for drain hand-off (class-level: shards are
     # many, the pool is one; drain passes never block on anything but the
@@ -613,6 +626,9 @@ class RowShard:
                           and any(e.trace is not None for _, e in wave))
                 t0 = time.time() if traced else 0.0
                 self._record_wave(len(wave))
+                _flight.record(_flight.EV_WAVE,
+                               nbytes=sum(e.vals.nbytes for _, e in wave),
+                               note=f"ops={len(wave)}")
                 try:
                     if len(wave) == 1:
                         e = wave[0][1]

@@ -30,6 +30,7 @@ from multiverso_tpu import updaters as updaters_lib
 from multiverso_tpu.ps import service as svc
 from multiverso_tpu.ps import wire as wire_mod
 from multiverso_tpu.ps.shard import KVShard, RowShard
+from multiverso_tpu.telemetry import flightrec as _flight
 from multiverso_tpu.telemetry import trace as ttrace
 from multiverso_tpu.updaters import AddOption
 from multiverso_tpu.utils import config, log
@@ -264,7 +265,8 @@ def _window_loop(ref: "weakref.ref") -> None:
 
 
 def _complete_window_futures(batch_fut: cf.Future,
-                             group_futs: List[List[cf.Future]]) -> None:
+                             group_futs: List[List[cf.Future]],
+                             owner: int = -1) -> None:
     """Fan a window frame's single ack out to the per-entry placeholder
     futures the callers are tracking (runs on the peer's recv thread).
     ``group_futs`` is aligned with the frame's sub-ops: a partially
@@ -283,6 +285,9 @@ def _complete_window_futures(batch_fut: cf.Future,
                 meta = res[0]
     except (cf.CancelledError, Exception) as e:   # defensive
         exc = e
+    # black box: the window ack edge (runs on the peer's recv thread)
+    _flight.record(_flight.EV_WIN_ACK, peer=owner,
+                   note=None if exc is None else str(exc)[:120])
     failed = set(meta.get("failed", ()))
     ferr = (svc.PSError("batched add failed at the shard: "
                         f"{meta.get('error', '?')}") if failed else None)
@@ -391,6 +396,10 @@ class _SendWindow:
                  opt: AddOption, trace: Optional[int] = None) -> cf.Future:
         fut: cf.Future = cf.Future()
         ship = False
+        # black box: the enqueue edge (flightrec is always on; one ring
+        # write ~1 us against the ~30-60 us windowed-add budget)
+        _flight.record(_flight.EV_WIN_ENQ, peer=owner,
+                       nbytes=ids.nbytes + vals.nbytes)
         with self._cv:
             q = self._pending.setdefault(owner, [])
             q.append((ids, vals, opt, fut, trace))
@@ -492,6 +501,12 @@ class _SendWindow:
             return
         traced = ttrace.enabled()
         t_flush0 = time.time() if traced else 0.0
+        # flush edge: per-flush (not per-add), so the f-string note is
+        # off the hot path
+        _flight.record(_flight.EV_WIN_FLUSH, peer=owner,
+                       nbytes=sum(e[0].nbytes + e[1].nbytes
+                                  for e in entries),
+                       note=f"ops={len(entries)}")
         w = t._wire_for(owner)
         # merging conditions, ALL required for bit-transparency: an
         # elementwise wire ("none"/"bf16" — 1bit/topk mix values across
@@ -525,6 +540,11 @@ class _SendWindow:
                        np.concatenate(g[1]) if len(g[1]) > 1 else g[1][0],
                        g[2], g[5]) for g in groups]
         except Exception as e:   # merge failure must not orphan waiters
+            # close the flush edge too: an unmatched win.flush in a dump
+            # is the wedged-window signature, and this window failed
+            # FAST, not wedged
+            _flight.record(_flight.EV_WIN_FLUSH_END, peer=owner,
+                           note=f"merge failed: {e}"[:120])
             for g in groups:
                 for f in g[3]:
                     if not f.done():
@@ -588,7 +608,7 @@ class _SendWindow:
                               for tid in tids]
 
                 def _done(bf, gf=gfuts, ts=t_send, ct=chunk_tids):
-                    _complete_window_futures(bf, gf)
+                    _complete_window_futures(bf, gf, owner=owner)
                     ttrace.add_span(
                         "window.ack", ts, time.time(),
                         trace=ct[0] if ct else None,
@@ -597,7 +617,10 @@ class _SendWindow:
                 req.add_done_callback(_done)
             else:
                 req.add_done_callback(
-                    lambda bf, gf=gfuts: _complete_window_futures(bf, gf))
+                    lambda bf, gf=gfuts:
+                        _complete_window_futures(bf, gf, owner=owner))
+        _flight.record(_flight.EV_WIN_FLUSH_END, peer=owner,
+                       note=f"frames={-(-len(packed) // wire_mod.MAX_BATCH_OPS)}")
         if merged_rows:
             self._mon_merged.incr(merged_rows)
         if traced and all_tids:
@@ -771,6 +794,17 @@ class _AsyncBase:
         :class:`~multiverso_tpu.ps.service.PSPeerError` for a dead rank,
         like any other request."""
         return self.ctx.service.stats(
+            self.ctx.rank if rank is None else int(rank))
+
+    def server_health(self, rank: Optional[int] = None) -> Dict:
+        """Liveness probe (MSG_HEALTH): pull ``rank``'s compact verdict
+        — serve-loop heartbeat age, shard queue depth, oldest in-flight
+        op age, last watchdog verdict — distinguishing 'alive but
+        stuck' from 'dead' (the latter raises the usual typed
+        :class:`~multiverso_tpu.ps.service.PSPeerError`). ``rank=None``
+        reads the local rank without touching the socket. See
+        docs/OBSERVABILITY.md 'Postmortem debugging'."""
+        return self.ctx.service.health(
             self.ctx.rank if rank is None else int(rank))
 
 

@@ -118,18 +118,37 @@ class SSPClock:
     def wait(self) -> None:
         """Block until the slowest live worker is within ``staleness`` of
         this worker's clock. Raises :class:`SSPTimeout` after ``timeout``
-        seconds (None = wait forever)."""
+        seconds (None = wait forever) — the exception message carries the
+        full per-worker clock snapshot (and which workers were excluded
+        as dead) so a fleet-wide stall is attributable from the error
+        alone, and the flight recorder gets the same snapshot before the
+        raise (a worker that dies ON this exception still leaves the
+        evidence in its dump)."""
+        from multiverso_tpu.telemetry import flightrec
         deadline = (None if self.timeout is None
                     else time.monotonic() + self.timeout)
         warned = False
         while self._min_live_clock() < self._clock - self.staleness:
             if deadline is not None and time.monotonic() > deadline:
+                clocks = self.peer_clocks()
+                dead = sorted(self._ignore()) if self._ignore else []
+                snapshot = (f"clock {self._clock}, staleness "
+                            f"{self.staleness}, peer clocks {clocks}, "
+                            f"ignored-dead {dead}")
+                flightrec.record(flightrec.EV_SSP_TIMEOUT,
+                                 note=snapshot[:200])
                 raise SSPTimeout(
-                    f"worker {self.worker_id} at clock {self._clock} waited "
-                    f">{self.timeout}s for stragglers "
-                    f"(peer clocks: {self.peer_clocks()})")
+                    f"worker {self.worker_id} waited >{self.timeout}s "
+                    f"for stragglers ({snapshot})")
             if not warned:
                 log.debug(f"[ssp] worker {self.worker_id} clock "
                           f"{self._clock} waiting on stragglers")
+                flightrec.record(flightrec.EV_SSP_WAIT,
+                                 msg_id=self._clock)
                 warned = True
             time.sleep(self.poll)
+        if warned:   # the blocked wait resolved: close the edge (its
+            # own kind — a barrier.exit here would read as an unmatched
+            # barrier edge in postmortem timelines)
+            flightrec.record(flightrec.EV_SSP_RESOLVED,
+                             msg_id=self._clock)

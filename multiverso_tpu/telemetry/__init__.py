@@ -9,7 +9,7 @@ the shutdown report (and any exporter) sees p50/p90/p99/max — tail
 regressions on the batched, compressed PS plane do not hide behind a
 stable mean.
 
-Three cooperating pieces:
+Five cooperating pieces:
 
 * :mod:`~multiverso_tpu.telemetry.histogram` — the lock-free (caller-
   synchronized) log2-bucket histogram every Monitor embeds.
@@ -21,9 +21,17 @@ Three cooperating pieces:
 * :mod:`~multiverso_tpu.telemetry.exporter` — flag-gated background
   thread (``metrics_interval_s`` / ``metrics_dir``) dumping Dashboard +
   shard snapshots as JSONL and Prometheus-style text.
+* :mod:`~multiverso_tpu.telemetry.flightrec` — the ALWAYS-ON black box:
+  a fixed-slot ring of the last N wire events / state transitions plus
+  the live in-flight request table, dumped atomically as JSONL at fault
+  time (fatal log, SIGTERM/SIGABRT, peer death, watchdog trip,
+  Zoo.stop); ``tools/postmortem.py`` merges per-rank dumps.
+* :mod:`~multiverso_tpu.telemetry.watchdog` — per-request slow/stuck
+  deadlines over the recorder's in-flight table; its verdict feeds the
+  ``MSG_HEALTH`` RPC and ``elastic.Heartbeat`` beacons.
 
 See docs/OBSERVABILITY.md for the end-to-end story (including the
-MSG_STATS remote-dashboard RPC in ``ps/service.py``).
+MSG_STATS / MSG_HEALTH RPCs in ``ps/service.py``).
 """
 
 from multiverso_tpu.telemetry.histogram import Histogram  # noqa: F401

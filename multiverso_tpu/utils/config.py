@@ -221,6 +221,10 @@ define_int("num_servers", 0, "logical server shards; 0 = one per device")
 define_string("mesh_axis", "mv", "name of the table-sharding mesh axis")
 define_string("log_level", "info", "debug|info|error|fatal")
 define_string("log_file", "", "optional log file path ('' = stdout only)")
+define_bool("log_jsonl", False,
+            "write the log FILE as structured JSONL (ts/mono/level/rank/"
+            "name/msg) so tools/postmortem.py can interleave log lines "
+            "with flight-recorder dumps; console output stays text")
 define_bool("dashboard", True, "collect Monitor timings and display at shutdown")
 # Reference CLI-parity no-ops (mechanism owned by XLA / the JAX runtime):
 define_int("omp_threads", 4, "no-op: shard updates are VPU-parallel under XLA "

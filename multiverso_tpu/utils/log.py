@@ -5,14 +5,27 @@ TPU-native equivalent of the reference logging layer
 leveled messages (DEBUG/INFO/ERROR/FATAL) to stdout and an optional file, a
 ``is_kill_fatal`` toggle deciding whether FATAL raises, and ``CHECK`` /
 ``CHECK_NOTNULL`` assertion helpers.
+
+Beyond the reference (PR 4, observability):
+
+* ``reset_log_file(path, jsonl=True)`` makes the file sink STRUCTURED —
+  one JSON object per line with ``ts`` (wall), ``mono`` (monotonic),
+  ``level``, ``rank``, ``name``, ``msg`` — so log lines interleave with
+  flight-recorder dumps on one timeline in ``tools/postmortem.py``. The
+  text format stays the default (and stdout/stderr always stay text).
+* ``Logger.fatal`` dumps the flight recorder (best-effort, no-op unless
+  a dump directory resolves) BEFORE raising: a FATAL is exactly the
+  moment the black box must reach disk.
 """
 
 from __future__ import annotations
 
 import datetime
 import enum
+import json
 import sys
 import threading
+import time
 from typing import Any, IO, Optional
 
 from multiverso_tpu.utils import config
@@ -48,14 +61,21 @@ class Logger:
         self.level = level
         self.name = name
         self.kill_fatal = kill_fatal
+        self.rank = 0              # stamped into jsonl records (set_rank)
         self._file = file
+        self._jsonl = False
         self._lock = threading.Lock()
 
-    def reset_log_file(self, path: str) -> None:
+    def reset_log_file(self, path: str, jsonl: bool = False) -> None:
+        """Point the file sink at ``path`` (empty = none). ``jsonl=True``
+        switches the FILE format to one JSON object per line
+        (ts/mono/level/rank/name/msg) for postmortem interleaving; the
+        console stays text either way."""
         with self._lock:
             if self._file is not None:
                 self._file.close()
             self._file = open(path, "a") if path else None
+            self._jsonl = bool(jsonl)
 
     def write(self, level: LogLevel, msg: str, *args: Any) -> None:
         if level < self.level:
@@ -67,9 +87,25 @@ class Logger:
         with self._lock:
             print(line, file=sys.stderr if level >= LogLevel.ERROR else sys.stdout)
             if self._file is not None:
-                self._file.write(line + "\n")
+                if self._jsonl:
+                    self._file.write(json.dumps({
+                        "ts": round(time.time(), 6),
+                        "mono": round(time.monotonic(), 6),
+                        "level": _LEVEL_NAMES[level], "rank": self.rank,
+                        "name": self.name, "msg": msg}) + "\n")
+                else:
+                    self._file.write(line + "\n")
                 self._file.flush()
         if level == LogLevel.FATAL and self.kill_fatal:
+            # black box before the raise: a FATAL is a fault-time event,
+            # and the dump must not depend on anyone catching FatalError
+            # (best-effort; no-op unless a dump directory resolves)
+            try:
+                from multiverso_tpu.telemetry import flightrec
+                flightrec.record(flightrec.EV_FATAL, note=msg[:200])
+                flightrec.dump_global(f"fatal: {msg[:120]}", stacks=True)
+            except Exception:   # noqa: BLE001 — never mask the FATAL
+                pass
             raise FatalError(msg)
 
     def debug(self, msg: str, *args: Any) -> None:
@@ -89,17 +125,39 @@ _default = Logger()
 
 
 def configure_from_flags() -> None:
-    """Apply the log_level / log_file flags to the default logger."""
+    """Apply the log_level / log_file / log_jsonl flags to the default
+    logger."""
     level = _LEVEL_FROM_STRING.get(config.get_flag("log_level").lower())
     if level is not None:
         _default.level = level
     path = config.get_flag("log_file")
     if path:
-        _default.reset_log_file(path)
+        _default.reset_log_file(path, jsonl=config.get_flag("log_jsonl"))
 
 
 def set_level(level: LogLevel) -> None:
     _default.level = level
+
+
+_rank_pinned = False
+
+
+def set_rank(rank: int) -> None:
+    """Stamp this process's PS rank into structured log records (called
+    from Zoo.start / PSService init; first caller wins like the tracer,
+    so in-process multi-rank tests keep one attribution)."""
+    global _rank_pinned
+    if not _rank_pinned:
+        _default.rank = int(rank)
+        _rank_pinned = True
+
+
+def reset_rank() -> None:
+    """Unpin the rank stamp (test isolation — the public counterpart of
+    flightrec.reset()/Tracer.reset(), which unpin their ranks too)."""
+    global _rank_pinned
+    _rank_pinned = False
+    _default.rank = 0
 
 
 def debug(msg: str, *args: Any) -> None:

@@ -71,9 +71,17 @@ class Zoo:
         # PSService starting later upgrades the exporter's payload with
         # its shard registry)
         from multiverso_tpu.telemetry import exporter as _exporter
+        from multiverso_tpu.telemetry import flightrec as _flightrec
         from multiverso_tpu.telemetry import trace as _trace
         _trace.configure(self.rank())
         _exporter.ensure_started(self.rank())
+        # flight-recorder plane: pin the rank, give the structured log
+        # sink the same rank, and dump the black box if a fault signal
+        # lands (a later handler — e.g. bench.py's SIGTERM salvage —
+        # replaces this one and dumps on its own)
+        _flightrec.configure(self.rank())
+        log.set_rank(self.rank())
+        _flightrec.install_signal_handlers()
         self._started = True
         log.info(
             "multiverso_tpu started: process %d/%d, %d devices in mesh %s, "
@@ -115,8 +123,15 @@ class Zoo:
         # numbers (the exporter's stop() writes a last snapshot; buffered
         # trace spans drain to metrics_dir)
         from multiverso_tpu.telemetry import exporter as _exporter
+        from multiverso_tpu.telemetry import flightrec as _flightrec
         from multiverso_tpu.telemetry import trace as _trace
         _exporter.stop_global()
+        # final black-box dump (no-op unless a dump directory resolves):
+        # a run that hung AFTER stop began still leaves its last tape.
+        # routine=True: if a FAULT dump (watchdog trip, peer death,
+        # fatal) was already written this process, keep it — the healthy
+        # shutdown tape must never overwrite the fault evidence
+        _flightrec.dump_global("Zoo.stop", routine=True)
         d = config.get_flag("metrics_dir")
         if d:
             try:
@@ -192,6 +207,11 @@ class Zoo:
 
     def barrier(self) -> None:
         self._barrier_count += 1
+        # black-box edges: a rank that dies INSIDE the barrier leaves
+        # "enter without exit" as the last record of its tape
+        from multiverso_tpu.telemetry import flightrec as _flightrec
+        _flightrec.record(_flightrec.EV_BARRIER_ENTER,
+                          msg_id=self._barrier_count, note="zoo.barrier")
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices(
@@ -209,6 +229,8 @@ class Zoo:
                     jax.tree.map(
                         lambda a: a.block_until_ready()
                         if isinstance(a, jax.Array) else a, value)
+        _flightrec.record(_flightrec.EV_BARRIER_EXIT,
+                          msg_id=self._barrier_count, note="zoo.barrier")
 
     # ------------------------------------------------------------------ #
     # table registry (ref zoo.h RegisterTable / table_factory ownership)

@@ -25,6 +25,12 @@ Modes:
           disjoint row set, interleaved with fenced gets that must
           read its own writes; the converged state must equal the
           integer expectation bit-for-bit on every rank.
+  flightrec — the PR-4 black box at the real OS-process tier: rank 1
+          wedges itself (SIGSTOP: alive, sockets open, serving nothing)
+          while rank 0 has gets in flight to it; rank 0's watchdog must
+          trip "stuck" and dump its flight recorder, whose in-flight
+          table names rank 1's oldest unacked msg id — the parent
+          SIGKILLs rank 1 and runs tools/postmortem.py over the dumps.
   stats — the PR-3 telemetry plane end to end: trace_ids on, windowed
           adds to the REMOTE shard, then (a) rank 0 pulls rank 1's
           server-side stats via the MSG_STATS RPC
@@ -289,6 +295,53 @@ def main():
         out["flushes"] = Dashboard.get(
             "table[mp_win].add_rows.flushes").count
         _sync_point(rdv_dir, world, rank, "done")
+
+    elif mode == "flightrec":
+        import signal as _signal
+
+        from multiverso_tpu.telemetry import flightrec, watchdog
+        frdir = os.environ["MV_FLIGHTREC_DIR"]
+        config.set_flag("flightrec_dir", frdir)
+        config.set_flag("watchdog_slow_ms", 100.0)
+        config.set_flag("watchdog_stuck_s", 0.8)
+        config.set_flag("watchdog_interval_s", 0.1)
+        watchdog.ensure_started()   # service already started it; idempotent
+        num_row = 8 * world
+        t = AsyncMatrixTable(num_row, 2, name="fr", ctx=ctx)
+        _sync_point(rdv_dir, world, rank, "tables")
+        peer = (rank + 1) % world
+        # warm + ack the python conn to the peer's shard
+        t.add_rows([peer * 8], np.ones((1, 2), np.float32))
+        _sync_point(rdv_dir, world, rank, "warm")
+        if rank == world - 1:
+            # wedge, don't die: SIGSTOP freezes every thread with the
+            # sockets OPEN — the "alive but stuck" failure that leaves
+            # no error anywhere. The parent SIGKILLs this rank later.
+            os.kill(os.getpid(), _signal.SIGSTOP)
+            out["wedged"] = True
+        else:
+            time.sleep(1.0)   # let the victim reach its SIGSTOP
+            # two unacked gets: "oldest per (src,dst)" must pick the first
+            t.get_rows_async([peer * 8])
+            t.get_rows_async([peer * 8 + 1])
+            path = os.path.join(frdir, f"flightrec-rank{rank}.jsonl")
+            deadline = time.monotonic() + 25
+            while time.monotonic() < deadline:
+                v = watchdog.last_verdict()
+                if v.get("status") == "stuck" and os.path.exists(path):
+                    break
+                time.sleep(0.05)
+            v = watchdog.last_verdict()
+            assert v["status"] == "stuck", v
+            h = t.server_health()   # local probe sees the wedge too
+            assert h["status"] == "stuck" and h["inflight"] >= 2, h
+            age, p, mid, _ = flightrec.RECORDER.oldest_inflight()
+            out["stuck_peer"] = p
+            out["stuck_msg_id"] = mid
+            out["oldest_age_s"] = round(age, 3)
+            out["dump"] = path
+        # NOT syncing here: the victim is frozen and never reaches a
+        # barrier; survivors just finish (their dumps are on disk)
 
     elif mode == "stats":
         from multiverso_tpu.telemetry import trace as ttrace

@@ -82,7 +82,17 @@ def _fresh_runtime():
     # telemetry plane: a test that enabled tracing/export must not leak
     # spans or a running exporter thread into its neighbors
     from multiverso_tpu.telemetry import exporter as _exporter
+    from multiverso_tpu.telemetry import flightrec as _flightrec
     from multiverso_tpu.telemetry import trace as _trace
+    from multiverso_tpu.telemetry import watchdog as _watchdog
     _exporter.stop_global()
     _trace.TRACER.reset()
     _trace.TRACER.enabled = False
+    # flight-recorder plane: drop the ring/in-flight table and stop the
+    # watchdog so one test's wedged ops can't trip a neighbor's verdict;
+    # unpin the logger's rank stamp too (first-caller-wins, like the
+    # tracer — a rank-R test must not stamp every later test's records)
+    _watchdog.reset()
+    _flightrec.reset()
+    from multiverso_tpu.utils import log as _log
+    _log.reset_rank()

@@ -147,3 +147,37 @@ def test_bench_truncation_recording(tmp_path):
     rec3 = record(0, out)
     assert rec3["truncated"] and not rec3["complete"]
     assert last_json_line("no json here") is None
+
+
+def test_flightrec_dumps_recorded(tmp_path, monkeypatch):
+    """PR-4 CI satellite: the bench SIGTERM salvage dumps the flight
+    recorder, and tools/run_bench records which dump files a run left —
+    a truncated run is diagnosable from the recorded artifact alone."""
+    import json
+
+    from multiverso_tpu.telemetry import flightrec
+    from tools.run_bench import collect_flightrec_dumps, record
+
+    # the salvage hook itself (separable from the live signal handler)
+    monkeypatch.setenv("MV_FLIGHTREC_DIR", str(tmp_path))
+    flightrec.record(flightrec.EV_STATE, note="pre-salvage traffic")
+    path = bench._flightrec_salvage_dump(15)
+    assert path is not None and os.path.exists(path)
+    with open(path) as f:
+        recs = [json.loads(x) for x in f]
+    assert recs[0]["reason"].startswith("bench salvage: signal 15")
+    assert any(r.get("ev") == "signal" for r in recs)
+    # ...and the recording side: the dump listing lands in the artifact
+    dumps = collect_flightrec_dumps(str(tmp_path))
+    assert dumps == [os.path.basename(path)]
+    rec = record(bench.TRUNCATED_EXIT, "{}", flightrec_dumps=dumps)
+    assert rec["truncated"] and rec["flightrec_dumps"] == dumps
+    # a clean run with no dump dir records an empty listing, not a crash
+    assert collect_flightrec_dumps(str(tmp_path / "never-made")) == []
+    assert record(0, "{}")["flightrec_dumps"] == []
+    # review regression: the dump dir is reused across runs — a stale
+    # dump from a PREVIOUS run must not be attributed to this one
+    import time as _time
+    assert collect_flightrec_dumps(str(tmp_path),
+                                   since=_time.time() + 60) == []
+    assert collect_flightrec_dumps(str(tmp_path), since=0.0) == dumps

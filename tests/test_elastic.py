@@ -56,6 +56,53 @@ class TestHeartbeat:
         (tmp_path / "heartbeat.9.json").write_text("{not json")
         assert elastic.peers(str(tmp_path)) == {}
 
+    def test_beacon_carries_watchdog_verdict(self, tmp_path):
+        """PR-4 satellite: the beacon embeds the local watchdog verdict
+        (last_health) once the watchdog has run — an ALIVE beacon can
+        then still report a wedged PS plane."""
+        import time as _time
+
+        from multiverso_tpu.telemetry import flightrec, watchdog
+        from multiverso_tpu.utils import config
+        hb = elastic.Heartbeat(str(tmp_path), interval=60, rank=1)
+        hb.beat()
+        assert "last_health" not in elastic.peers(str(tmp_path))[1]
+        config.set_flag("watchdog_slow_ms", 50.0)
+        config.set_flag("watchdog_stuck_s", 2.0)
+        flightrec.RECORDER.begin_op(0, 5, 0x12)
+        with flightrec.RECORDER._lock:   # backdate: wedged for 5 s
+            t0, *rest = flightrec.RECORDER._inflight[(0, 5)]
+            flightrec.RECORDER._inflight[(0, 5)] = (t0 - 5.0, *rest)
+        assert watchdog.check_once()["status"] == "stuck"
+        hb.beat()
+        lh = elastic.peers(str(tmp_path))[1]["last_health"]
+        assert lh["status"] == "stuck" and lh["oldest_inflight_s"] >= 5.0
+
+    def test_health_distinguishes_dead_from_stuck(self, tmp_path):
+        """PR-4 satellite regression, both paths: a STALE beacon is dead
+        (elastic.failed semantics unchanged), a FRESH beacon carrying a
+        stuck last_health is 'stuck' — alive, never in failed(), but a
+        supervisor can act on it."""
+        import json
+        import os
+
+        now = time.time()
+        rows = [
+            (0, {"rank": 0, "step": 1, "ts": now}),                # ok
+            (1, {"rank": 1, "step": 1, "ts": now - 999}),          # dead
+            (2, {"rank": 2, "step": 1, "ts": now,                  # stuck
+                 "last_health": {"status": "stuck",
+                                 "oldest_inflight_s": 42.0,
+                                 "inflight": 3}}),
+        ]
+        for rank, entry in rows:
+            with open(os.path.join(tmp_path,
+                                   f"heartbeat.{rank}.json"), "w") as f:
+                json.dump(entry, f)
+        assert elastic.failed(str(tmp_path), timeout=30) == [1]
+        verdicts = elastic.health(str(tmp_path), timeout=30)
+        assert verdicts == {0: "ok", 1: "dead", 2: "stuck"}
+
 
 class TestElasticLoop:
     def _train(self, table, loop, start, stop):

@@ -1,0 +1,401 @@
+"""Flight recorder + watchdog + health plane (PR 4): ring wrap/overflow
+semantics, dump-on-fatal, watchdog slow/stuck thresholds against a
+deliberately wedged op, the MSG_HEALTH RPC on a live 2-rank PS (native
+punt + python path via the two_ranks params), postmortem merging on
+synthetic dumps, and the 2-OS-process kill-one-rank acceptance: the
+survivor's dump names the dead rank's oldest unacked (src, dst, msg id)
+and tools/postmortem.py reads it out with no other logs."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.telemetry import flightrec, watchdog
+from multiverso_tpu.utils import config
+from multiverso_tpu.utils import log as mvlog
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_DIR)
+sys.path.insert(0, _REPO)
+
+from tools import postmortem  # noqa: E402
+
+
+# ---------------------------------------------------------------------- #
+# ring-buffer semantics
+# ---------------------------------------------------------------------- #
+class TestRing:
+    def test_wrap_keeps_last_n_in_order(self):
+        fr = flightrec.FlightRecorder(slots=16)
+        for i in range(40):
+            fr.record(flightrec.EV_STATE, msg_id=i)
+        snap = fr.snapshot()
+        assert len(snap) == 16
+        assert [s[5] for s in snap] == list(range(24, 40))   # last 16
+        assert [s[0] for s in snap] == sorted(s[0] for s in snap)
+        # monotonic stamps never go backwards within the ring
+        ts = [s[1] for s in snap]
+        assert ts == sorted(ts)
+
+    def test_partial_fill_returns_only_recorded(self):
+        fr = flightrec.FlightRecorder(slots=16)
+        fr.record(flightrec.EV_SEND, peer=2, msg_id=7, nbytes=64)
+        [s] = fr.snapshot()
+        assert (s[2], s[3], s[5], s[6]) == (flightrec.EV_SEND, 2, 7, 64)
+
+    def test_fixed_slots_no_growth(self):
+        fr = flightrec.FlightRecorder(slots=16)
+        before = len(fr._slots)
+        for _ in range(1000):
+            fr.record(flightrec.EV_STATE)
+        assert len(fr._slots) == before == 16
+
+    def test_inflight_begin_end(self):
+        fr = flightrec.FlightRecorder(slots=32)
+        fr.begin_op(1, 10, 0x12, nbytes=100)
+        fr.begin_op(2, 11, 0x11, nbytes=200)
+        assert len(fr.inflight_snapshot()) == 2
+        fr.end_op(1, 10)
+        (age, peer, mid, mt) = fr.oldest_inflight()
+        assert (peer, mid, mt) == (2, 11, 0x11) and age >= 0
+        fr.end_op(2, 11, ok=False)
+        assert fr.oldest_inflight() is None
+        evs = [s[2] for s in fr.snapshot()]
+        assert evs == [flightrec.EV_SEND, flightrec.EV_SEND,
+                       flightrec.EV_ACK, flightrec.EV_ERR]
+
+    def test_fail_peer_drops_only_that_peer(self):
+        fr = flightrec.FlightRecorder(slots=32)
+        fr.begin_op(1, 1, 0x12)
+        fr.begin_op(2, 1, 0x12)
+        assert fr.fail_peer(1) == 1
+        [(peer, *_)] = fr.inflight_snapshot()
+        assert peer == 2
+
+
+# ---------------------------------------------------------------------- #
+# dumps
+# ---------------------------------------------------------------------- #
+class TestDump:
+    def test_dump_contents_and_atomicity(self, tmp_path):
+        fr = flightrec.FlightRecorder(slots=32)
+        fr.rank = 3
+        fr.record(flightrec.EV_STATE, note="hello")
+        fr.begin_op(1, 42, 0x12, nbytes=512)
+        path = fr.dump("unit test", directory=str(tmp_path), stacks=True)
+        assert path.endswith("flightrec-rank3.jsonl")
+        assert not [n for n in os.listdir(tmp_path) if n.endswith(".tmp")]
+        with open(path) as f:
+            recs = [json.loads(x) for x in f]
+        header = recs[0]
+        assert header["kind"] == "header" and header["rank"] == 3
+        assert header["reason"] == "unit test"
+        assert any(r["kind"] == "event" and r.get("note") == "hello"
+                   for r in recs)
+        infl = [r for r in recs if r["kind"] == "inflight"]
+        assert infl and infl[0]["peer"] == 1 and infl[0]["msg_id"] == 42
+        stacks = [r for r in recs if r["kind"] == "stack"]
+        assert stacks and any("test_dump_contents" in ln
+                              for s in stacks for ln in s["frames"])
+
+    def test_dump_without_directory_is_noop(self):
+        fr = flightrec.FlightRecorder(slots=16)
+        fr.record(flightrec.EV_STATE)
+        assert fr.dump("nowhere") is None   # no flag/env/metrics_dir
+
+    def test_second_dump_replaces_with_full_ring(self, tmp_path):
+        fr = flightrec.FlightRecorder(slots=32)
+        fr.record(flightrec.EV_STATE, note="a")
+        fr.dump("first", directory=str(tmp_path))
+        fr.record(flightrec.EV_STATE, note="b")
+        path = fr.dump("second", directory=str(tmp_path))
+        d = postmortem.load_dump(path)
+        assert d["header"]["reason"] == "second"
+        assert [e["note"] for e in d["events"]] == ["a", "b"]
+
+    def test_routine_dump_never_replaces_fault_evidence(self, tmp_path):
+        """Review regression: the Zoo.stop last tape (routine=True) must
+        not overwrite a fault dump's stacks/in-flight evidence — and
+        must still write when nothing ever faulted."""
+        fr = flightrec.FlightRecorder(slots=16)
+        fr.begin_op(1, 3, 0x12)
+        path = fr.dump("watchdog stuck: x", directory=str(tmp_path),
+                       stacks=True)
+        assert fr.dump("Zoo.stop", directory=str(tmp_path),
+                       routine=True) is None
+        assert postmortem.load_dump(path)["header"]["reason"] \
+            == "watchdog stuck: x"
+        # a later FAULT dump still refreshes the tape
+        assert fr.dump("fatal: y", directory=str(tmp_path)) == path
+        assert postmortem.load_dump(path)["header"]["reason"] == "fatal: y"
+        # no fault ever: the routine tape writes normally
+        fr2 = flightrec.FlightRecorder(slots=16)
+        fr2.rank = 7
+        fr2.record(flightrec.EV_STATE)
+        p2 = fr2.dump("Zoo.stop", directory=str(tmp_path), routine=True)
+        assert p2 and postmortem.load_dump(p2)["header"]["rank"] == 7
+
+    def test_fatal_triggers_dump_before_raising(self, tmp_path):
+        config.set_flag("flightrec_dir", str(tmp_path))
+        with pytest.raises(mvlog.FatalError):
+            mvlog.fatal("shard exploded (%d)", 7)
+        path = tmp_path / "flightrec-rank0.jsonl"
+        assert path.exists()
+        d = postmortem.load_dump(str(path))
+        assert d["header"]["reason"].startswith("fatal:")
+        assert any(e["ev"] == "fatal" and "shard exploded (7)" in
+                   (e.get("note") or "") for e in d["events"])
+
+
+# ---------------------------------------------------------------------- #
+# structured JSONL log sink (satellite)
+# ---------------------------------------------------------------------- #
+class TestJsonlLogSink:
+    def test_jsonl_records_and_text_default(self, tmp_path):
+        lg = mvlog.Logger(kill_fatal=False, name="t")
+        lg.rank = 2
+        jpath = str(tmp_path / "run.jsonl")
+        lg.reset_log_file(jpath, jsonl=True)
+        lg.info("step %d done", 5)
+        lg.error("bad thing")
+        with open(jpath) as f:
+            recs = [json.loads(x) for x in f]
+        assert [r["level"] for r in recs] == ["INFO", "ERROR"]
+        assert recs[0]["msg"] == "step 5 done"
+        assert recs[0]["rank"] == 2
+        assert recs[0]["ts"] > 0 and recs[0]["mono"] > 0
+        # default stays text
+        tpath = str(tmp_path / "run.log")
+        lg.reset_log_file(tpath)
+        lg.info("plain")
+        with open(tpath) as f:
+            assert "[INFO]" in f.read()
+
+    def test_postmortem_interleaves_log_lines(self, tmp_path):
+        fr = flightrec.FlightRecorder(slots=16)
+        fr.record(flightrec.EV_STATE, note="ring event")
+        dump = fr.dump("mix", directory=str(tmp_path))
+        lg = mvlog.Logger(kill_fatal=False)
+        lg.reset_log_file(str(tmp_path / "worker.jsonl"), jsonl=True)
+        lg.info("a log line")
+        dumps, logs = postmortem._expand([str(tmp_path)])
+        assert dumps == [dump]
+        assert logs == [str(tmp_path / "worker.jsonl")]
+        lines = [rec for p in logs for rec in postmortem.load_log_lines(p)]
+        tl = postmortem.timeline(postmortem.load_dumps(dumps), lines)
+        kinds = {r.get("ev") for r in tl}
+        assert "state" in kinds and "log.info" in kinds
+        assert [r["ts"] for r in tl] == sorted(r["ts"] for r in tl)
+
+
+# ---------------------------------------------------------------------- #
+# watchdog thresholds (deterministic: a deliberately wedged op)
+# ---------------------------------------------------------------------- #
+class TestWatchdog:
+    def _wedge(self, age_s, peer=3, msg_id=9):
+        """Backdate an in-flight op so thresholds trip without sleeping."""
+        flightrec.RECORDER.begin_op(peer, msg_id, 0x12, nbytes=128)
+        with flightrec.RECORDER._lock:
+            t0, *rest = flightrec.RECORDER._inflight[(peer, msg_id)]
+            flightrec.RECORDER._inflight[(peer, msg_id)] = (
+                t0 - age_s, *rest)
+
+    def test_ok_when_nothing_in_flight(self):
+        v = watchdog.check_once()
+        assert v["status"] == "ok" and v["inflight"] == 0 and v["checked"]
+
+    def test_slow_threshold_logs_once(self):
+        config.set_flag("watchdog_slow_ms", 50.0)
+        config.set_flag("watchdog_stuck_s", 1e6)
+        self._wedge(0.5)
+        v = watchdog.check_once()
+        assert v["status"] == "slow"
+        assert v["oldest_inflight_s"] >= 0.5
+        slow = [s for s in flightrec.RECORDER.snapshot()
+                if s[2] == flightrec.EV_SLOW]
+        assert len(slow) == 1 and slow[0][3] == 3 and slow[0][5] == 9
+        watchdog.check_once()   # same op: no second structured record
+        assert len([s for s in flightrec.RECORDER.snapshot()
+                    if s[2] == flightrec.EV_SLOW]) == 1
+
+    def test_stuck_threshold_dumps_ring_and_stacks(self, tmp_path):
+        config.set_flag("flightrec_dir", str(tmp_path))
+        config.set_flag("watchdog_slow_ms", 50.0)
+        config.set_flag("watchdog_stuck_s", 2.0)
+        self._wedge(5.0, peer=1, msg_id=4)
+        v = watchdog.check_once()
+        assert v["status"] == "stuck"
+        path = tmp_path / "flightrec-rank0.jsonl"
+        assert path.exists()
+        d = postmortem.load_dump(str(path))
+        assert d["header"]["reason"].startswith("watchdog stuck")
+        assert any(e["ev"] == "watchdog.stuck" for e in d["events"])
+        assert d["inflight"] and d["inflight"][0]["msg_id"] == 4
+        assert d["stacks"]   # sys._current_frames made it to disk
+        # verdict is what MSG_HEALTH / heartbeats serve
+        assert watchdog.last_verdict()["status"] == "stuck"
+
+
+# ---------------------------------------------------------------------- #
+# MSG_HEALTH on a live 2-rank PS (native punt + python path via params)
+# ---------------------------------------------------------------------- #
+class TestHealthRPC:
+    def test_round_trip(self, two_ranks):
+        from multiverso_tpu.ps.tables import AsyncMatrixTable
+        t0 = AsyncMatrixTable(16, 4, name="hl", ctx=two_ranks[0])
+        AsyncMatrixTable(16, 4, name="hl", ctx=two_ranks[1])
+        t0.add_rows([9], np.ones((1, 4), np.float32))   # remote-owned
+        h = t0.server_health(1)
+        assert h["rank"] == 1
+        assert h["status"] in ("ok", "slow")
+        assert h["queue_depth"] == 0
+        assert h["oldest_inflight_s"] >= 0.0
+        assert "watchdog" in h and "apply_age_s" in h
+        # the add above was data-plane traffic: on the PYTHON plane it
+        # beats the serve loop (the native C++ fast path is unrecorded,
+        # same rule as tracing, so serve_age_s stays None there) — and
+        # the health PROBE itself must never refresh the beat (review
+        # regression: a wedged-but-probing server must AGE, not reset)
+        if not config.get_flag("ps_native") or h["serve_age_s"] is not None:
+            assert h["serve_age_s"] is not None
+            assert t0.server_health(1)["serve_age_s"] >= h["serve_age_s"]
+        json.dumps(h)   # pure JSON meta
+        # local short-circuit: no socket, same shape
+        local = t0.server_health()
+        assert local["rank"] == 0 and "status" in local
+
+    def test_probe_answers_while_data_conn_wedged(self, two_ranks):
+        """Review regression: the health probe rides its OWN one-shot
+        connection — a data op blocked in its handler on the shared
+        conn (per-conn FIFO) must not starve the probe into a
+        ps_timeout, or 'alive but stuck' would read as unreachable."""
+        import threading as th
+
+        release = th.Event()
+
+        def blocking_handler(msg_type, meta, arrays):
+            release.wait(20.0)
+            return {}, []
+
+        two_ranks[1].service.register_handler("wedge", blocking_handler)
+        try:
+            # occupy the shared conn's serving thread (fire-and-forget)
+            two_ranks[0].service.request(
+                1, 0x11, {"table": "wedge"}, [np.zeros(1)])
+            t0 = time.monotonic()
+            h = two_ranks[0].service.health(1)
+            took = time.monotonic() - t0
+            assert h["rank"] == 1
+            assert took < 5.0, f"probe starved behind wedged conn ({took}s)"
+        finally:
+            release.set()
+
+    def test_dead_rank_raises_typed(self, two_ranks):
+        from multiverso_tpu.ps import service as svc
+        from multiverso_tpu.ps.tables import AsyncMatrixTable
+        t0 = AsyncMatrixTable(16, 4, name="hd", ctx=two_ranks[0])
+        AsyncMatrixTable(16, 4, name="hd", ctx=two_ranks[1])
+        config.set_flag("ps_timeout", 4.0)
+        config.set_flag("ps_connect_timeout", 2.0)
+        two_ranks[1].service.close()
+        with pytest.raises(svc.PSPeerError):
+            t0.server_health(1)
+
+
+# ---------------------------------------------------------------------- #
+# postmortem on synthetic dumps
+# ---------------------------------------------------------------------- #
+class TestPostmortem:
+    def test_stuck_pairs_and_suspects(self, tmp_path):
+        fr = flightrec.FlightRecorder(slots=32)
+        fr.rank = 0
+        fr.begin_op(1, 3, 0x12, nbytes=64)    # newer
+        fr.begin_op(1, 2, 0x12, nbytes=64)
+        with fr._lock:                         # backdate msg 2: oldest
+            t0, *rest = fr._inflight[(1, 2)]
+            fr._inflight[(1, 2)] = (t0 - 9.0, *rest)
+        fr.record(flightrec.EV_PEER_DEAD, peer=1)
+        fr.dump("test", directory=str(tmp_path))
+        dumps = postmortem.load_dumps(str(tmp_path))
+        assert len(dumps) == 1
+        pairs = postmortem.stuck_pairs(dumps)
+        assert pairs[0]["src"] == 0 and pairs[0]["dst"] == 1
+        assert pairs[0]["msg_id"] == 2   # the OLDEST unacked, not the last
+        suspects = postmortem.dead_suspects(dumps)
+        assert [s["rank"] for s in suspects] == [1]
+        report = postmortem.render_report(dumps)
+        assert "rank 0 -> rank 1: msg 2" in report
+        assert "suspect dead/stuck" in report
+        assert postmortem.main([str(tmp_path)]) == 0
+        assert postmortem.main([str(tmp_path), "--json"]) == 0
+
+    def test_multi_rank_timeline_merges_by_wall_clock(self, tmp_path):
+        a = flightrec.FlightRecorder(slots=16)
+        a.rank = 0
+        a.record(flightrec.EV_STATE, note="first")
+        time.sleep(0.02)
+        b = flightrec.FlightRecorder(slots=16)
+        b.rank = 1
+        b.record(flightrec.EV_STATE, note="second")
+        pa = a.dump("a", directory=str(tmp_path / "a"))
+        pb = b.dump("b", directory=str(tmp_path / "b"))
+        tl = postmortem.timeline(postmortem.load_dumps([pa, pb]))
+        assert [e["note"] for e in tl] == ["first", "second"]
+        assert tl[0]["rank"] == 0 and tl[1]["rank"] == 1
+
+    def test_no_dumps_exits_nonzero(self, tmp_path):
+        assert postmortem.main([str(tmp_path)]) == 1
+
+
+# ---------------------------------------------------------------------- #
+# the acceptance: kill one rank of a 2-process run, postmortem from dumps
+# ---------------------------------------------------------------------- #
+def test_kill_one_rank_postmortem(tmp_path):
+    """Rank 1 wedges (SIGSTOP — alive but serving nothing) with rank 0's
+    gets in flight; rank 0's watchdog trips stuck and dumps. The parent
+    SIGKILLs rank 1 and must identify the dead rank and the oldest
+    unacked (src, dst, msg id) from the dumps ALONE — no stdout, no
+    other logs."""
+    rdv = str(tmp_path / "rdv")
+    frdir = str(tmp_path / "fr")
+    os.makedirs(rdv)
+    os.makedirs(frdir)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["MV_FLIGHTREC_DIR"] = frdir
+    env["MV_PS_NATIVE"] = "0"   # in-flight tracking lives on the python
+    #                             conns (native fast path is unrecorded
+    #                             by design, like tracing)
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.join(_DIR, "async_ps_worker.py"),
+         rdv, "2", str(r), "flightrec"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env, text=True)
+        for r in range(2)]
+    try:
+        out0, err0 = procs[0].communicate(timeout=120)
+    except subprocess.TimeoutExpired:
+        procs[0].kill()
+        pytest.fail("survivor rank timed out")
+    finally:
+        procs[1].kill()   # SIGKILL the wedged victim
+        procs[1].communicate(timeout=30)
+    assert procs[0].returncode == 0, f"{err0[-2000:]}"
+    result = next(json.loads(ln[len("RESULT "):])
+                  for ln in out0.splitlines() if ln.startswith("RESULT "))
+    assert result["stuck_peer"] == 1
+    # --- postmortem from the dump directory alone ---
+    dumps = postmortem.load_dumps(frdir)
+    assert [d["header"]["rank"] for d in dumps] == [0]   # victim left none
+    pairs = postmortem.stuck_pairs(dumps)
+    pair = next(p for p in pairs if p["src"] == 0 and p["dst"] == 1)
+    assert pair["msg_id"] == result["stuck_msg_id"]
+    suspects = postmortem.dead_suspects(dumps)
+    assert any(s["rank"] == 1 for s in suspects)
+    report = postmortem.render_report(dumps)
+    assert f"rank 0 -> rank 1: msg {result['stuck_msg_id']}" in report
+    assert "MSG_GET_ROWS" in report   # type resolved, not a raw code

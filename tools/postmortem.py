@@ -1,0 +1,286 @@
+#!/usr/bin/env python
+"""Merge per-rank flight-recorder dumps into one timeline and answer
+"who was stuck on whom".
+
+Every rank's flight recorder (multiverso_tpu/telemetry/flightrec.py)
+dumps ``flightrec-rank<r>.jsonl`` at fault time: a header (with the
+rank's monotonic->wall anchor), the event ring, the in-flight request
+table, and — on watchdog trips/signals — per-thread Python stacks. This
+tool is the read side: point it at the dump directory (or explicit
+files) and it
+
+* merges every rank's events onto ONE wall-clock timeline (each rank's
+  monotonic stamps shifted by its own header anchor), interleaving any
+  structured JSONL log files (``utils/log.py`` ``jsonl=True`` sink —
+  records carrying a ``level`` field) found alongside;
+* reports the oldest unacked (src, dst, msg id) per rank pair from the
+  in-flight tables — the "rank 0 has been waiting 12 s on rank 3's
+  msg 41" line that localizes a hang without a repro;
+* names suspect ranks: peers that appear as the dst of unacked traffic
+  or in peer-death events but produced no dump of their own (a rank
+  that died hard never got to write one — its absence IS the finding).
+
+    python tools/postmortem.py DIR_OR_FILES... [--json] [--tail N]
+
+Exit status: 0 with a report, 1 when no dumps were found.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _msg_names() -> Dict[int, str]:
+    """MSG_* code -> name map off ps/service.py (jax-free import); falls
+    back to raw codes if the package is unimportable (e.g. the tool is
+    run against dumps on a bare host)."""
+    try:
+        from multiverso_tpu.ps import service as svc
+        return {v: k for k, v in vars(svc).items()
+                if k.startswith("MSG_") and isinstance(v, int)}
+    except Exception:   # noqa: BLE001
+        return {}
+
+
+def load_dump(path: str) -> Optional[Dict]:
+    """One dump file -> {"header", "events", "inflight", "stacks"};
+    None for an unreadable/foreign file."""
+    header, events, inflight, stacks = None, [], [], []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                kind = rec.get("kind")
+                if kind == "header":
+                    header = rec
+                elif kind == "event":
+                    events.append(rec)
+                elif kind == "inflight":
+                    inflight.append(rec)
+                elif kind == "stack":
+                    stacks.append(rec)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if header is None:
+        return None
+    return {"header": header, "events": events, "inflight": inflight,
+            "stacks": stacks, "path": path}
+
+
+def _expand(args: List[str]) -> (List[str], List[str]):
+    """Paths/dirs -> (dump files, jsonl log files). A directory
+    contributes its flightrec-rank*.jsonl dumps plus any other *.jsonl
+    whose first record carries a ``level`` field (the structured log
+    sink); trace/metrics JSONL files are skipped by that probe."""
+    dumps, logs = [], []
+    for a in args:
+        if os.path.isdir(a):
+            dumps.extend(sorted(glob.glob(
+                os.path.join(a, "flightrec-rank*.jsonl"))))
+            for p in sorted(glob.glob(os.path.join(a, "*.jsonl"))):
+                if os.path.basename(p).startswith(
+                        ("flightrec-rank", "trace-rank", "metrics-rank")):
+                    continue
+                if _is_log_file(p):
+                    logs.append(p)
+        elif os.path.basename(a).startswith("flightrec-"):
+            dumps.append(a)
+        elif _is_log_file(a):
+            logs.append(a)
+        else:
+            dumps.append(a)   # explicit file: trust the caller
+    return dumps, logs
+
+
+def _is_log_file(path: str) -> bool:
+    try:
+        with open(path) as f:
+            first = f.readline().strip()
+        return bool(first) and "level" in json.loads(first)
+    except (OSError, json.JSONDecodeError):
+        return False
+
+
+def load_dumps(args) -> List[Dict]:
+    if isinstance(args, str):
+        args = [args]
+    paths, _ = _expand(list(args))
+    return [d for d in (load_dump(p) for p in paths) if d is not None]
+
+
+def load_log_lines(path: str) -> List[Dict]:
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if "level" in rec and "ts" in rec:
+                    out.append(rec)
+    except OSError:
+        pass
+    return out
+
+
+def timeline(dumps: List[Dict], log_lines: List[Dict] = ()
+             ) -> List[Dict]:
+    """All ranks' events + log lines, one list sorted by wall time.
+    Events gain ``ts`` (wall seconds) via their dump's monotonic
+    anchor and ``rank``; log lines pass through (they already carry
+    wall ``ts`` and ``rank``)."""
+    rows: List[Dict] = []
+    for d in dumps:
+        anchor = float(d["header"].get("mono_to_wall", 0.0))
+        rank = d["header"].get("rank", -1)
+        for e in d["events"]:
+            r = dict(e)
+            r["ts"] = round(float(e.get("mono", 0.0)) + anchor, 6)
+            r["rank"] = rank
+            rows.append(r)
+    for rec in log_lines:
+        r = dict(rec)
+        r.setdefault("ev", f"log.{rec.get('level', '?').lower()}")
+        rows.append(r)
+    rows.sort(key=lambda r: r.get("ts", 0.0))
+    return rows
+
+
+def stuck_pairs(dumps: List[Dict]) -> List[Dict]:
+    """Oldest unacked request per (src, dst) rank pair, oldest first."""
+    best: Dict[tuple, Dict] = {}
+    for d in dumps:
+        src = d["header"].get("rank", -1)
+        for e in d["inflight"]:
+            key = (src, e.get("peer", -1))
+            if key not in best or e.get("age_s", 0) > best[key]["age_s"]:
+                best[key] = {"src": src, "dst": e.get("peer", -1),
+                             "msg_id": e.get("msg_id", -1),
+                             "type": e.get("type", 0),
+                             "age_s": float(e.get("age_s", 0.0)),
+                             "nbytes": e.get("nbytes", 0)}
+    return sorted(best.values(), key=lambda p: -p["age_s"])
+
+
+def dead_suspects(dumps: List[Dict]) -> List[Dict]:
+    """Ranks implicated without a dump of their own: the dst of unacked
+    traffic, or named in a peer-death event. A hard-killed rank never
+    writes a dump — its absence plus a survivor's pointer is the
+    verdict."""
+    have = {d["header"].get("rank", -1) for d in dumps}
+    why: Dict[int, set] = {}
+    for p in stuck_pairs(dumps):
+        if p["dst"] not in have:
+            why.setdefault(p["dst"], set()).add(
+                f"rank {p['src']} has unacked traffic to it "
+                f"(oldest msg {p['msg_id']}, {p['age_s']:.1f}s)")
+    for d in dumps:
+        src = d["header"].get("rank", -1)
+        for e in d["events"]:
+            if e.get("ev") == "peer.dead" and e.get("peer", -1) not in have:
+                why.setdefault(e["peer"], set()).add(
+                    f"rank {src} observed its connection die")
+    return [{"rank": r, "evidence": sorted(v)}
+            for r, v in sorted(why.items())]
+
+
+def render_report(dumps: List[Dict], log_lines: List[Dict] = (),
+                  tail: int = 40) -> str:
+    names = _msg_names()
+
+    def mname(t):
+        return names.get(t, f"0x{t:X}" if isinstance(t, int) else str(t))
+
+    lines = []
+    ranks = sorted(d["header"].get("rank", -1) for d in dumps)
+    lines.append(f"postmortem over {len(dumps)} dump(s): ranks {ranks}")
+    for d in dumps:
+        h = d["header"]
+        lines.append(
+            f"  rank {h.get('rank')}: reason={h.get('reason')!r} "
+            f"events={len(d['events'])} inflight={len(d['inflight'])} "
+            f"stacks={len(d['stacks'])} ({d['path']})")
+    suspects = dead_suspects(dumps)
+    if suspects:
+        lines.append("suspect dead/stuck ranks (no dump of their own):")
+        for s in suspects:
+            lines.append(f"  rank {s['rank']}:")
+            for ev in s["evidence"]:
+                lines.append(f"    - {ev}")
+    pairs = stuck_pairs(dumps)
+    if pairs:
+        lines.append("oldest unacked request per (src, dst):")
+        for p in pairs:
+            lines.append(
+                f"  rank {p['src']} -> rank {p['dst']}: "
+                f"msg {p['msg_id']} ({mname(p['type'])}, "
+                f"{p['age_s']:.1f}s unacked, {p['nbytes']} bytes)")
+    else:
+        lines.append("no unacked requests at dump time")
+    tl = timeline(dumps, log_lines)
+    if tl:
+        lines.append(f"timeline (last {min(tail, len(tl))} of "
+                     f"{len(tl)} records):")
+        for r in tl[-tail:]:
+            what = r.get("ev", "?")
+            detail = ""
+            if r.get("msg_id", -1) != -1:
+                detail += f" msg={r['msg_id']}"
+            if r.get("peer", -1) != -1:
+                detail += f" peer={r['peer']}"
+            if r.get("type"):
+                detail += f" {mname(r['type'])}"
+            if r.get("note"):
+                detail += f" note={r['note']!r}"
+            if r.get("msg"):
+                detail += f" {r['msg']}"
+            lines.append(f"  {r.get('ts', 0):.6f} rank{r.get('rank', '?')}"
+                         f" {what}{detail}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+",
+                    help="dump directory or flightrec/log JSONL files")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report")
+    ap.add_argument("--tail", type=int, default=40,
+                    help="timeline records to print")
+    args = ap.parse_args(argv)
+    dump_paths, log_paths = _expand(args.paths)
+    dumps = [d for d in (load_dump(p) for p in dump_paths)
+             if d is not None]
+    if not dumps:
+        print("no flight-recorder dumps found", file=sys.stderr)
+        return 1
+    log_lines = [rec for p in log_paths for rec in load_log_lines(p)]
+    if args.json:
+        print(json.dumps({
+            "ranks": sorted(d["header"].get("rank", -1) for d in dumps),
+            "suspects": dead_suspects(dumps),
+            "stuck_pairs": stuck_pairs(dumps),
+            "timeline": timeline(dumps, log_lines)[-args.tail:],
+        }, indent=1))
+    else:
+        print(render_report(dumps, log_lines, tail=args.tail))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

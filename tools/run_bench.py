@@ -21,6 +21,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
@@ -40,9 +41,13 @@ def last_json_line(text: str):
     return None
 
 
-def record(returncode: int, stdout: str) -> dict:
+def record(returncode: int, stdout: str, flightrec_dumps=()) -> dict:
     """Shape a bench run into the recorded artifact (pure: testable
-    without spawning the real 20-minute bench)."""
+    without spawning the real 20-minute bench). ``flightrec_dumps`` is
+    the dump-file listing produced during the run — the postmortem entry
+    point: each dump's header names its reason (routine Zoo.stop tape
+    vs. a watchdog trip / peer death / SIGTERM salvage), so a truncated
+    or faulted run is diagnosable from the recorded artifact alone."""
     headline = last_json_line(stdout)
     # truncated iff the salvage path exited, OR the headline itself
     # carries the salvage marker (belt: a wrapper that lost the exit
@@ -56,8 +61,30 @@ def record(returncode: int, stdout: str) -> dict:
         # never both: the belt case (exit status lost, salvage marker
         # present) must read as truncated, not complete
         "complete": returncode == 0 and not truncated,
+        "flightrec_dumps": sorted(flightrec_dumps),
         "headline": headline,
     }
+
+
+def collect_flightrec_dumps(directory: str, since: float = 0.0):
+    """Dump files under a run's flight-recorder directory (basenames;
+    [] when the directory never materialized — no dump was written).
+    ``since`` (epoch seconds) excludes files older than the run being
+    recorded: the directory is reused across runs, and a stale dump
+    from run N-1 must not be attributed to run N."""
+    if not os.path.isdir(directory):
+        return []
+    out = []
+    for n in os.listdir(directory):
+        if not (n.startswith("flightrec-") and n.endswith(".jsonl")):
+            continue
+        try:
+            if os.path.getmtime(os.path.join(directory, n)) < since:
+                continue
+        except OSError:
+            continue
+        out.append(n)
+    return sorted(out)
 
 
 def main(argv) -> int:
@@ -66,16 +93,41 @@ def main(argv) -> int:
         out_path, argv = argv[1], argv[2:]
     if argv[:1] == ["--"]:
         argv = argv[1:]
+    # give the bench a dump directory so fault-time black boxes (SIGTERM
+    # salvage, watchdog trips, peer deaths) land somewhere recordable; an
+    # operator override via the env wins. Absolute: the bench child runs
+    # with cwd=_REPO, and a relative -o path would make it dump where
+    # the collector below never looks
+    frdir = os.path.abspath(out_path) + ".flightrec"
+    env = dict(os.environ)
+    env.setdefault("MV_FLIGHTREC_DIR", frdir)
+    # absolute EITHER way: a relative operator-supplied dir would
+    # resolve against the bench child's cwd (_REPO) while the collector
+    # below resolves it against THIS process's cwd — dumps written
+    # where the listing never looks. An EMPTY value stays empty: that is
+    # the documented "no dump files" setting, and abspath("") would
+    # silently re-enable dumps into the collector's cwd
+    if env["MV_FLIGHTREC_DIR"]:
+        env["MV_FLIGHTREC_DIR"] = os.path.abspath(env["MV_FLIGHTREC_DIR"])
+    start = time.time()
     proc = subprocess.run([sys.executable, os.path.join(_REPO, "bench.py"),
-                           *argv], cwd=_REPO, capture_output=True, text=True)
-    rec = record(proc.returncode, proc.stdout)
+                           *argv], cwd=_REPO, capture_output=True,
+                          text=True, env=env)
+    # 2s slack: coarse-mtime filesystems floor a dump written just
+    # after start below time.time()'s sub-second reading, and a real
+    # fault dump filtered as "stale" is the diagnosability this exists
+    # to provide
+    rec = record(proc.returncode, proc.stdout,
+                 collect_flightrec_dumps(env["MV_FLIGHTREC_DIR"],
+                                         since=start - 2.0))
     if rec["headline"] is None:
         sys.stderr.write(proc.stderr[-2000:])
     with open(out_path, "w") as f:
         json.dump(rec, f, indent=1)
     print(json.dumps({"recorded": os.path.relpath(out_path, _REPO),
                       "truncated": rec["truncated"],
-                      "complete": rec["complete"]}))
+                      "complete": rec["complete"],
+                      "flightrec_dumps": rec["flightrec_dumps"]}))
     return proc.returncode
 
 
