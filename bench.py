@@ -426,12 +426,11 @@ def bench_async_ps(seconds: float = 4.0):
     return out
 
 
-def bench_small_add_window(iters: int = 400):
-    """Small-add (1-row) p50 per-call latency with the client send window
-    on vs off (ISSUE 2 acceptance metric) — subprocess so the 2-rank PS
-    world and the CPU backend never touch this process's runtime. The
-    worker interleaves both arms over the same ids/values and refuses to
-    report latency unless the final states match bit-for-bit."""
+def _run_result_worker(script: str, args, timeout: float = 300):
+    """Spawn a tools/ bench worker in a subprocess (so its 2-rank PS
+    world and CPU backend never touch this process's runtime) and parse
+    its "RESULT <json>" line — the one worker-spawn contract shared by
+    the small-add and get-rows benches."""
     import subprocess
     import sys
 
@@ -439,16 +438,32 @@ def bench_small_add_window(iters: int = 400):
     env = dict(os.environ)
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
     out = subprocess.run(
-        [sys.executable, os.path.join(repo, "tools", "bench_small_add.py"),
-         str(iters)],
-        capture_output=True, text=True, timeout=300, env=env, cwd=repo)
+        [sys.executable, os.path.join(repo, "tools", script),
+         *[str(a) for a in args]],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=repo)
     if out.returncode != 0:
-        raise RuntimeError(f"small-add bench rc={out.returncode}: "
+        raise RuntimeError(f"{script} rc={out.returncode}: "
                            f"{out.stderr[-300:]}")
     for line in out.stdout.splitlines():
         if line.startswith("RESULT "):
             return json.loads(line[len("RESULT "):])
-    raise RuntimeError("small-add bench produced no RESULT line")
+    raise RuntimeError(f"{script} produced no RESULT line")
+
+
+def bench_small_add_window(iters: int = 400):
+    """Small-add (1-row) p50 per-call latency with the client send window
+    on vs off (ISSUE 2 acceptance metric). The worker interleaves both
+    arms over the same ids/values and refuses to report latency unless
+    the final states match bit-for-bit."""
+    return _run_result_worker("bench_small_add.py", [iters])
+
+
+def bench_get_rows_plane(iters: int = 300):
+    """PS read-path bench (ISSUE 5): small-get p50/p99 with the client
+    get coalescer on vs off, the concurrent fan-in dedupe ratio, and a
+    large get plain vs chunk-streamed. The worker refuses to report
+    latency unless both parity checks held bit-for-bit."""
+    return _run_result_worker("bench_get_rows.py", [iters])
 
 
 def bench_array_table_nontunnel(size: int = 1_000_000, iters: int = 10):
@@ -605,6 +620,19 @@ def bench_array_table(size: int = 1_000_000, iters: int = 10):
         rep.append(time.perf_counter() - t0)
     get_cached_ms = _percentile_ms(rep)
     get_cache_hits = cache_mon.count - hits_before
+    # in-run bit-parity of the read path (ISSUE 5 acceptance): whatever
+    # served the gets above — blocking transfer, version cache, or the
+    # write-triggered snapshot prefetch — the returned bytes must equal
+    # the live table's exactly. A latency number without this is
+    # meaningless, so parity failure FAILS the bench.
+    host_now = t.get()
+    raw_now = np.asarray(t.raw())[: size].reshape(host_now.shape)
+    if not np.array_equal(host_now, raw_now):
+        raise AssertionError(
+            "bench_array get parity broke: the read path returned "
+            "different bytes than the live device table")
+    get_prefetch_hits = Dashboard.get(
+        "table[bench_array].get.prefetched").count
     # device plane: delta already resident (the real TPU deployment shape —
     # grads are produced on device; host numbers above are tunnel-bound)
     import jax
@@ -650,6 +678,8 @@ def bench_array_table(size: int = 1_000_000, iters: int = 10):
         "wire_filtered": wf,
         "get_repeat_cached_ms": get_cached_ms,
         "get_cache_hits": int(get_cache_hits),
+        "get_prefetch_hits": int(get_prefetch_hits),
+        "get_parity_bit_for_bit": True,   # asserted above, else raise
         "device_add_ms": dev_add_s * 1e3,
         "device_add_gbps": nbytes / dev_add_s / 1e9,
         "fixed_overhead_ms": dev_intercept * 1e3,
@@ -990,6 +1020,10 @@ def main() -> None:
         small_add_stats = bench_small_add_window()
     except Exception as e:
         small_add_stats = {"error": f"{type(e).__name__}: {e}"[:200]}
+    try:
+        get_rows_stats = bench_get_rows_plane()
+    except Exception as e:
+        get_rows_stats = {"error": f"{type(e).__name__}: {e}"[:200]}
     # telemetry-plane record: latency HISTOGRAMS of every monitored op
     # this process ran (shutdown resets the dashboard, so snapshot now)
     try:
@@ -1040,6 +1074,7 @@ def main() -> None:
         "matrix_sparse_row_add": rows_stats,
         "lm_decode_b8_d256_L4": decode_stats,
         "small_add_send_window": small_add_stats,
+        "get_rows_plane": get_rows_stats,
         "dashboard_hist": dashboard_hist,
         "flightrec_dumps": flightrec_dumps,
     }

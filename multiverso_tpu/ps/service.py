@@ -52,6 +52,15 @@ from multiverso_tpu.utils.dashboard import monitor
 # message types (request side; replies reuse the id space below 0x100)
 MSG_REPLY_OK = 1
 MSG_REPLY_ERR = 2
+# one sub-frame of a chunk-streamed get reply (wire.ChunkedReply): N of
+# these precede the stream's closing MSG_REPLY_OK, all under the
+# request's msg_id (per-conn FIFO orders them). The client decodes and
+# scatters each as it lands — reply decode overlaps the network receive
+# instead of buffering one mega-frame. Sent only when the REQUEST asked
+# (meta "chunk"), so a client never sees one it can't handle; the native
+# C++ server punts chunk-requesting gets to Python (its meta whitelist
+# rejects the "chunk" key), exactly like MSG_BATCH.
+MSG_REPLY_CHUNK = 3
 MSG_PING = 0x10
 MSG_ADD_ROWS = 0x11
 MSG_GET_ROWS = 0x12
@@ -328,6 +337,33 @@ class _Peer:
                     # fut.result(timeout), not connection lifetime — a
                     # healthy-but-quiet peer must not be declared dead
                     continue
+                if msg_type == MSG_REPLY_CHUNK:
+                    # one sub-frame of a streamed reply: feed the
+                    # requester's sink NOW (decode + scatter overlap the
+                    # receive) — the entry stays pending until the
+                    # stream's closing MSG_REPLY_OK. A sink failure is
+                    # remembered and surfaces on the final frame (the
+                    # caller must never consume a half-scattered buffer
+                    # as complete).
+                    with self._pending_lock:
+                        fut = self._pending.get(msg_id)
+                    if fut is not None:
+                        sink = getattr(fut, "_mv_chunk_sink", None)
+                        try:
+                            if sink is None:
+                                # chunks only arrive when the REQUEST
+                                # asked for them, and every asking
+                                # caller registers a sink — a sink-less
+                                # chunk is a caller bug that must fail
+                                # the op, not resolve it with a silently
+                                # discarded payload
+                                raise PSError(
+                                    "chunked reply frame without a "
+                                    "registered chunk sink")
+                            sink(meta, arrays)
+                        except Exception as e:  # noqa: BLE001
+                            fut._mv_chunk_err = e
+                    continue
                 with self._pending_lock:
                     fut = self._pending.pop(msg_id, None)
                 if fut is None:
@@ -338,7 +374,13 @@ class _Peer:
                     fut.set_exception(PSError(
                         f"rank {self.rank}: {meta.get('error', '?')}"))
                 else:
-                    fut.set_result((meta, arrays))
+                    cerr = getattr(fut, "_mv_chunk_err", None)
+                    if cerr is not None:
+                        fut.set_exception(PSError(
+                            f"rank {self.rank}: chunk sink failed: "
+                            f"{type(cerr).__name__}: {cerr}"))
+                    else:
+                        fut.set_result((meta, arrays))
         except Exception as e:  # socket death: fail everything in flight
             err = PSPeerError(f"rank {self.rank} connection lost: {e}")
             self._dead = err
@@ -366,8 +408,13 @@ class _Peer:
                 self._on_death(self, err)
 
     def request(self, msg_type: int, meta: Dict,
-                arrays: Sequence[np.ndarray]) -> cf.Future:
+                arrays: Sequence[np.ndarray],
+                chunk_sink: Optional[Callable] = None) -> cf.Future:
         fut: cf.Future = cf.Future()
+        if chunk_sink is not None:
+            # attached BEFORE the pending insert: the recv loop may see
+            # the first chunk the instant the request hits the wire
+            fut._mv_chunk_sink = chunk_sink
         if self._dead is not None:
             fut.set_exception(self._dead)
             return fut
@@ -636,6 +683,18 @@ class PSService:
                     _trace.add_span("ps.serve", t0, time.time(), trace=tr,
                                     args={"table": meta["table"],
                                           "type": msg_type})
+                if isinstance(rarrays, wire.ChunkedReply):
+                    # streamed reply over the native conn: each chunk
+                    # goes through send_raw (the conn's C++ write lock
+                    # orders them); the closing OK is the `reply` below
+                    for cmeta, carrays in rarrays.chunks:
+                        ps_native.send_raw(
+                            self._native_raw, conn_id,
+                            wire.encode(MSG_REPLY_CHUNK, msg_id, cmeta,
+                                        carrays))
+                        _flight.record(_flight.EV_GET_CHUNK,
+                                       msg_type=msg_type, msg_id=msg_id)
+                    rmeta, rarrays = rarrays.meta, ()
                 reply = wire.encode(MSG_REPLY_OK, msg_id, rmeta, rarrays)
         except Exception as e:
             log.debug("ps handler error: %s", e)
@@ -880,6 +939,19 @@ class PSService:
                                         trace=tr,
                                         args={"table": meta["table"],
                                               "type": msg_type})
+                    if isinstance(rarrays, wire.ChunkedReply):
+                        # streamed get reply: one MSG_REPLY_CHUNK per
+                        # sub-frame as the generator yields (encode of
+                        # chunk k+1 overlaps chunk k draining into the
+                        # socket), closed by the ordinary OK
+                        for cmeta, carrays in rarrays.chunks:
+                            with send_lock:
+                                wire.send(conn, MSG_REPLY_CHUNK, msg_id,
+                                          cmeta, carrays)
+                            _flight.record(_flight.EV_GET_CHUNK,
+                                           msg_type=msg_type,
+                                           msg_id=msg_id)
+                        rmeta, rarrays = rarrays.meta, ()
                     with send_lock:
                         wire.send(conn, MSG_REPLY_OK, msg_id, rmeta, rarrays)
                     _flight.record(_flight.EV_REPLY, msg_type=msg_type,
@@ -1063,22 +1135,38 @@ class PSService:
 
     def request(self, rank: int, msg_type: int, meta: Dict,
                 arrays: Sequence[np.ndarray] = (),
-                meta_b: Optional[bytes] = None) -> cf.Future:
+                meta_b: Optional[bytes] = None,
+                chunk_sink: Optional[Callable] = None) -> cf.Future:
         """Uncoordinated request to ``rank``; local rank short-circuits the
         socket but keeps async dispatch order via the local executor.
         ``meta_b`` (wire.pack_meta) lets a fan-out op serialize its meta
         once instead of once per remote peer; the local path always uses
-        the dict. NEVER raises: a dead/unreachable rank yields a future
-        carrying PSPeerError, so fire-and-forget callers stay
-        fire-and-forget and multi-owner ops keep their live-shard
-        futures."""
+        the dict. ``chunk_sink(meta, arrays)`` consumes the sub-frames of
+        a chunk-streamed reply as they land on the peer's recv thread
+        (the final OK then carries no payload). NEVER raises: a
+        dead/unreachable rank yields a future carrying PSPeerError, so
+        fire-and-forget callers stay fire-and-forget and multi-owner ops
+        keep their live-shard futures."""
         if rank == self.rank:
             fut: cf.Future = cf.Future()
 
             def _run():
                 try:
                     handler = self._wait_handler(meta["table"])
-                    fut.set_result(handler(msg_type, meta, arrays))
+                    rmeta, rarrays = handler(msg_type, meta, arrays)
+                    if isinstance(rarrays, wire.ChunkedReply):
+                        # local short-circuit: drive the sink inline (no
+                        # socket to overlap, but the caller's scatter
+                        # contract holds); clients normally skip the
+                        # chunk request for the local rank entirely
+                        if chunk_sink is None:
+                            raise PSError(
+                                "chunked reply without a chunk sink on "
+                                "the local path")
+                        for cmeta, carrays in rarrays.chunks:
+                            chunk_sink(cmeta, carrays)
+                        rmeta, rarrays = rarrays.meta, []
+                    fut.set_result((rmeta, rarrays))
                 except Exception as e:
                     fut.set_exception(e)
 
@@ -1086,7 +1174,8 @@ class PSService:
             return fut
         try:
             return self._peer(rank).request(
-                msg_type, meta if meta_b is None else meta_b, arrays)
+                msg_type, meta if meta_b is None else meta_b, arrays,
+                chunk_sink=chunk_sink)
         except PSError as e:
             fut = cf.Future()
             fut.set_exception(e if isinstance(e, PSPeerError)

@@ -15,6 +15,21 @@ tables/matrix_table.py) so there is one compiled program per bucket size.
 Thread-safety: requests arrive on per-connection service threads; a lock
 serializes state transitions (JAX arrays are immutable, so readers always
 see a consistent snapshot; the lock orders the donated updates).
+
+Read path (off-lock snapshot serving): gets do NOT hold the lock across
+the row gather, the device->host transfer, or the reply wire-encode.
+A reader briefly takes the lock to PIN the current data epoch (a
+refcounted handle on the buffer object, :meth:`RowShard._pin_data`) and
+then computes outside it. The apply path donates its input buffer only
+when no reader pins the current epoch; while pinned it updates into a
+FRESH buffer instead (non-donating jit / numpy copy-on-write), so the
+pinned snapshot stays valid and the last releasing reader simply drops
+the retired buffer to the GC. Applies therefore never wait on a reader,
+and a multi-hundred-ms gather/encode no longer serializes the shard —
+the read/write symmetry the reference's one-Server-actor-thread design
+never had. Shards registered with the native C++ server keep the locked
+path: C++ holds the raw buffer pointer, so the buffer must never be
+swapped (the punt path already serializes on the native shard mutex).
 """
 
 from __future__ import annotations
@@ -44,6 +59,19 @@ from multiverso_tpu.utils.dashboard import Dashboard
 from multiverso_tpu.updaters import (OPT_INSENSITIVE as _OPT_INSENSITIVE,
                                      ROW_LOCAL_STATE as _ROW_LOCAL_STATE,
                                      STATELESS_LINEAR as _LINEAR_SIGN)
+
+
+class _DataPin:
+    """A pinned read epoch of a shard's data buffer: holds the buffer
+    object alive (plain Python reference) and marks it so the apply path
+    neither donates nor mutates it in place while any reader computes on
+    it. Release via :meth:`RowShard._release_data` — dropping the last
+    pin of a retired epoch frees the buffer through ordinary GC."""
+
+    __slots__ = ("data", "version")
+
+    def __init__(self, data, version: int):
+        self.data, self.version = data, version
 
 
 class _PendingAdd:
@@ -162,6 +190,17 @@ class RowShard:
         self._version = 0
         self._wave_ops: Dict[int, int] = {}
         self._wave_max = 0
+        # off-lock read epochs: _cur_pins counts readers pinning _pin_buf
+        # (identity-checked against the live _data, so a buffer swap
+        # implicitly retires the count — no per-site bookkeeping). The
+        # counters feed stats(): cow_applies = applies that had to copy /
+        # skip donation because a reader held the epoch; served gets and
+        # streamed chunks measure the read plane.
+        self._pin_buf: Optional[Any] = None
+        self._cur_pins = 0
+        self._stat_cow = 0
+        self._stat_gets = 0
+        self._stat_chunks = 0
         # apply latency histogram (the p50/p99 of one updater dispatch)
         self._mon_apply = Dashboard.get(f"ps[{name}].apply")
         # native shard PIN once the native server serves this shard's hot
@@ -276,6 +315,13 @@ class RowShard:
             "wave_ops": wave_ops,       # pow2-bucketed ops-per-apply
             "wave_max_ops": wave_max,
             "apply": self._mon_apply.snapshot().hist_dict(),
+            # read plane: gets served off-lock, chunks streamed, applies
+            # that copied/skipped donation because a reader pinned the
+            # epoch, and readers pinning it right now
+            "gets": self._stat_gets,
+            "get_chunks": self._stat_chunks,
+            "cow_applies": self._stat_cow,
+            "read_pins": self._cur_pins,
         }
         if dirty_rows is not None:
             out["dirty_rows"] = dirty_rows   # sparse-protocol staleness
@@ -292,6 +338,57 @@ class RowShard:
     def scratch(self) -> int:
         return self.n
 
+    # ------------------------------------------------------------------ #
+    # off-lock read epochs (snapshot serving)
+    # ------------------------------------------------------------------ #
+    def _pin_data_locked(self) -> _DataPin:
+        """Pin the current data epoch (caller holds ``self._lock``): the
+        returned handle references the live buffer, and the apply path
+        will not donate/mutate that buffer in place while the pin count
+        is non-zero. The count is tied to BUFFER IDENTITY (_pin_buf), so
+        any site that rebinds ``self._data`` implicitly retires it —
+        stale releases become no-ops and retired buffers free through
+        the pins' own references."""
+        if self._pin_buf is not self._data:
+            self._pin_buf = self._data
+            self._cur_pins = 0
+        self._cur_pins += 1
+        return _DataPin(self._data, self._version)
+
+    def _pin_data(self) -> _DataPin:
+        with self._lock:
+            return self._pin_data_locked()
+
+    def _release_data(self, pin: _DataPin) -> None:
+        with self._lock:
+            if pin.data is self._pin_buf and self._cur_pins > 0:
+                self._cur_pins -= 1
+                if self._cur_pins == 0:
+                    # drop the identity anchor too: after a copy-on-write
+                    # swap it would otherwise keep the RETIRED buffer
+                    # alive until the next pin — a full extra table of
+                    # memory in an add-heavy, rarely-read workload
+                    self._pin_buf = None
+        pin.data = None   # last holder of a retired epoch frees it
+
+    def _data_pinned(self) -> bool:
+        """True when a reader pins the LIVE buffer (caller holds the
+        lock): the apply must then swap to a fresh buffer instead of
+        donating or mutating in place."""
+        return self._pin_buf is self._data and self._cur_pins > 0
+
+    def _writable_data(self):
+        """The buffer an in-place numpy mutation may write (caller holds
+        ``self._lock``): copy-on-write when a reader pins the current
+        epoch. Natively-registered shards never swap — C++ holds the raw
+        pointer — and never need to: every python-plane op on them runs
+        under the native shard mutex, so a pin cannot coexist with an
+        apply there."""
+        if self._native_ref is None and self._data_pinned():
+            self._data = self._data.copy()
+            self._stat_cow += 1
+        return self._data
+
     def _state_row_axis(self, leaf) -> int:
         """Axis of ``leaf`` matching the table row axis; -1 = row-free leaf
         (-1, not None: None is not a pytree leaf, so it would corrupt the
@@ -301,8 +398,12 @@ class RowShard:
             return nd - pd
         return -1
 
-    def _row_update_fn(self, bucket: int):
-        key = ("row_update", bucket)
+    def _row_update_fn(self, bucket: int, donate: bool = True):
+        """Jitted row update; ``donate=False`` compiles a variant that
+        does NOT donate the data buffer (updater state still donates —
+        no reader ever pins it) for applies racing a pinned read epoch:
+        the pinned snapshot must survive the update."""
+        key = ("row_update", bucket, donate)
         fn = self._jit.get(key)
         if fn is not None:
             return fn
@@ -328,19 +429,21 @@ class RowShard:
             ustate = jax.tree.map(scatter, ustate, new_gstate, row_axes)
             return data, ustate
 
-        fn = jax.jit(_update, donate_argnums=(0, 1))
+        fn = jax.jit(_update, donate_argnums=(0, 1) if donate else (1,))
         self._jit[key] = fn
         return fn
 
-    def _full_update_fn(self):
-        fn = self._jit.get("full")
+    def _full_update_fn(self, donate: bool = True):
+        key = ("full", donate)
+        fn = self._jit.get(key)
         if fn is None:
             updater = self.updater
 
             def _update(data, ustate, delta, opt):
                 return updater.apply(data, ustate, delta, opt)
 
-            fn = self._jit["full"] = jax.jit(_update, donate_argnums=(0, 1))
+            fn = self._jit[key] = jax.jit(
+                _update, donate_argnums=(0, 1) if donate else (1,))
         return fn
 
     def _get_fn(self, bucket: int):
@@ -374,15 +477,21 @@ class RowShard:
         local = self._localize_raw(ids)
         return self._pad_to_bucket(local), local.size
 
-    def _gather_rows(self, local: np.ndarray) -> np.ndarray:
-        """Gather shard rows for a reply (caller holds the lock). Host-
-        backed shards read via numpy off the zero-copy view; device-backed
-        shards run the bucketed jitted take."""
+    def _gather_rows(self, local: np.ndarray,
+                     data: Optional[Any] = None) -> np.ndarray:
+        """Gather shard rows for a reply from ``data`` (a pinned epoch
+        buffer; defaults to the live buffer for callers that hold the
+        lock). Host-backed shards read via numpy off the zero-copy view;
+        device-backed shards run the bucketed jitted take. Always returns
+        an OWNED host array (fancy indexing / np.asarray of a jit result
+        copy), so the caller may release its pin before encoding."""
+        if data is None:
+            data = self._data
         if self._host_serve:
-            return np.asarray(self._data)[local]
+            return np.asarray(data)[local]
         padded = self._pad_to_bucket(local)
         return np.asarray(
-            self._get_fn(padded.size)(self._data, padded))[: local.size]
+            self._get_fn(padded.size)(data, padded))[: local.size]
 
     # ------------------------------------------------------------------ #
     # coalescing apply queue (ps_coalesce)
@@ -443,11 +552,12 @@ class RowShard:
         histogram and bumps the shard mutation version."""
         t0 = time.perf_counter()
         if self._np_mode:
+            data = self._writable_data()   # copy-on-write vs pinned reads
             sign = _LINEAR_SIGN[type(self.updater)]
             if sign > 0:
-                self._data[local] += vals   # merged ids are unique
+                data[local] += vals   # merged ids are unique
             else:
-                self._data[local] -= vals
+                data[local] -= vals
             if self._dirty is not None:
                 self._dirty[:, local] = True
         else:
@@ -457,8 +567,14 @@ class RowShard:
                     [vals,
                      np.zeros((ids.size - vals.shape[0], self.num_col),
                               self.dtype)])
-            self._data, self._ustate = self._row_update_fn(ids.size)(
-                self._data, self._ustate, ids, vals, opt)
+            # a pinned read epoch forbids donating the data buffer: the
+            # non-donating variant writes a fresh buffer and the pinned
+            # one retires to its readers (freed on their last release)
+            donate = not self._data_pinned()
+            if not donate:
+                self._stat_cow += 1
+            self._data, self._ustate = self._row_update_fn(
+                ids.size, donate)(self._data, self._ustate, ids, vals, opt)
             if self._dirty is not None:
                 self._dirty[:, local] = True   # stale for everyone
         self._version += 1
@@ -716,6 +832,114 @@ class RowShard:
                 self._stat_applies += 1
 
     # ------------------------------------------------------------------ #
+    # off-lock get serving (snapshot pin -> gather -> encode, all outside
+    # the shard lock; applies keep flowing while a reply is computed)
+    # ------------------------------------------------------------------ #
+    def _serve_get_rows(self, meta: Dict, arrays: Sequence[np.ndarray]
+                        ) -> Tuple[Dict, Any]:
+        local = self._localize_raw(arrays[0])
+        tr = meta.get(wire.TRACE_META_KEY) if _trace.enabled() else None
+        t0 = time.time() if tr is not None else 0.0
+        pin = self._pin_data()
+        if tr is not None:
+            _trace.add_span("shard.get_pin", t0, time.time(), trace=tr,
+                            args={"table": self.name,
+                                  "rows": int(local.size)})
+        return self._serve_rows_from_pin(pin, local, meta, tr)
+
+    def _serve_rows_from_pin(self, pin: _DataPin, local: np.ndarray,
+                             meta: Dict, tr: Optional[int]
+                             ) -> Tuple[Dict, Any]:
+        """The shared off-lock serve body once an epoch is pinned and
+        ids resolved (RowShard localizes, HashShard translates key->slot
+        atomically with its pin): flight edge, gather off-lock, release,
+        counters, encode. ONE implementation, so new read-path
+        instrumentation cannot drift between the planes."""
+        _flight.record(_flight.EV_GET_SERVE,
+                       nbytes=local.size * self.num_col
+                       * self.dtype.itemsize)
+        t1 = time.time() if tr is not None else 0.0
+        try:
+            rows = self._gather_rows(local, data=pin.data)
+        finally:
+            self._release_data(pin)
+        self._stat_gets += 1
+        if tr is not None:
+            _trace.add_span("shard.get_gather", t1, time.time(), trace=tr,
+                            args={"table": self.name})
+        return self._encode_reply(rows, meta, tr)
+
+    def _serve_get_full(self, meta: Dict) -> Tuple[Dict, Any]:
+        tr = meta.get(wire.TRACE_META_KEY) if _trace.enabled() else None
+        t0 = time.time() if tr is not None else 0.0
+        pin = self._pin_data()
+        _flight.record(_flight.EV_GET_SERVE,
+                       nbytes=self.n * self.num_col * self.dtype.itemsize)
+        try:
+            # np_mode: the pin guarantees the buffer is not mutated in
+            # place while held (copy-on-write applies swap instead), but
+            # the reply outlives the pin — own the bytes. Device-backed:
+            # np.asarray is already an owned host copy.
+            full = (pin.data[: self.n].copy() if self._np_mode
+                    else np.asarray(pin.data)[: self.n])
+        finally:
+            self._release_data(pin)
+        self._stat_gets += 1
+        if tr is not None:
+            _trace.add_span("shard.get_gather", t0, time.time(), trace=tr,
+                            args={"table": self.name, "full": True})
+        return self._encode_reply(full, meta, tr)
+
+    def _encode_reply(self, rows: np.ndarray, meta: Dict,
+                      tr: Optional[int]) -> Tuple[Dict, Any]:
+        """Wire-encode a gathered get reply — chunk-streamed when the
+        client asked for it (meta["chunk"] rows per sub-frame) and the
+        reply is big enough, one payload otherwise. Runs OFF the shard
+        lock either way."""
+        w = meta.get("wire", "none")
+        chunk = int(meta.get("chunk", 0) or 0)
+        if chunk > 0 and rows.shape[0] > chunk:
+            return self._chunked_reply(rows, w, chunk, tr)
+        t0 = time.time() if tr is not None else 0.0
+        payload = wire.encode_payload(rows, w)
+        if tr is not None:
+            _trace.add_span("shard.get_encode", t0, time.time(), trace=tr,
+                            args={"table": self.name, "wire": w})
+        return {}, payload
+
+    def _chunked_reply(self, rows: np.ndarray, w: str, chunk: int,
+                       tr: Optional[int]) -> Tuple[Dict, Any]:
+        """Stream a big get as self-describing sub-frames: the service
+        sends each (MSG_REPLY_CHUNK) as the generator yields, so the
+        client's decode + out= scatter overlaps the network receive
+        instead of buffering one mega-frame. Encode is lazy per chunk —
+        chunk k+1 encodes while chunk k drains into the socket."""
+        n = rows.shape[0]
+        nchunks = -(-n // chunk)
+        self._stat_chunks += nchunks
+        shard = self
+
+        def gen():
+            for i in range(nchunks):
+                a, b = i * chunk, min((i + 1) * chunk, n)
+                cmeta: Dict = {"seq": i, "row0": a, "rows": b - a}
+                if w != "none":
+                    cmeta["wire"] = w
+                t0 = time.time() if tr is not None else 0.0
+                payload = wire.encode_payload(rows[a:b], w)
+                if tr is not None:
+                    _trace.add_span("shard.get_encode", t0, time.time(),
+                                    trace=tr,
+                                    args={"table": shard.name, "wire": w,
+                                          "seq": i})
+                yield cmeta, payload
+
+        final = {"chunks": nchunks, "rows": n}
+        if w != "none":
+            final["wire"] = w
+        return final, wire.ChunkedReply(final, gen())
+
+    # ------------------------------------------------------------------ #
     # request handler (runs on service connection threads)
     # ------------------------------------------------------------------ #
     def handle(self, msg_type: int, meta: Dict,
@@ -747,31 +971,40 @@ class RowShard:
                     raise svc.PSError(
                         f"{self.name} was not created with num_workers; "
                         "sparse gets need dirty-bit tracking")
+                # mask snapshot + clear ATOMIC with the epoch pin: an add
+                # applying after this lock releases re-SETS bits on rows
+                # we serve from the pinned (older) epoch, so the next get
+                # re-pulls them — nothing lost. Pinning outside this hold
+                # (or clearing after it) would open a set-then-lose
+                # window: an apply between clear and gather could mutate
+                # rows whose cleared bits claim THIS reply carries them.
                 mask = self._dirty[wid, local].copy()
                 self._dirty[wid, local] = False
+                pin = self._pin_data_locked()
+            _flight.record(_flight.EV_GET_SERVE,
+                           nbytes=int(mask.sum()) * self.num_col
+                           * self.dtype.itemsize)
+            try:
                 stale = local[mask]
                 if stale.size:
-                    rows = self._gather_rows(stale)
+                    rows = self._gather_rows(stale, data=pin.data)
                 else:
                     rows = np.zeros((0, self.num_col), self.dtype)
+            finally:
+                self._release_data(pin)
+            self._stat_gets += 1
             return {}, [mask, rows]
         if msg_type == svc.MSG_GET_ROWS:
-            local = self._localize_raw(arrays[0])
-            # gather + host transfer stay under the lock: adds donate (and
-            # delete) the data buffer, so a get computing on a snapshot
-            # outside the lock would race a concurrent add into "Array has
-            # been deleted" on TPU. Per-shard serialization is the
-            # reference's semantics anyway (one Server actor thread).
-            with self._lock:
-                rows = self._gather_rows(local)
-            return {}, wire.encode_payload(rows, meta.get("wire", "none"))
+            return self._serve_get_rows(meta, arrays)
         if msg_type == svc.MSG_SET_ROWS:
             ids, k = self._localize(arrays[0])
             vals = np.asarray(arrays[1], self.dtype)[:k]
             with self._lock:
                 if self._np_mode:
-                    self._data[ids[:k]] = vals
+                    self._writable_data()[ids[:k]] = vals
                 else:
+                    # eager .at[].set: non-donating — pinned epochs stay
+                    # valid; the rebind retires their pin count
                     self._data = self._data.at[ids[:k]].set(
                         jnp.asarray(vals))
                 if self._dirty is not None:
@@ -784,29 +1017,27 @@ class RowShard:
                                         (self.n, self.num_col), self.dtype)
             with self._lock:
                 if self._np_mode:
+                    data = self._writable_data()
                     sign = _LINEAR_SIGN[type(self.updater)]
                     if sign > 0:
-                        self._data[: self.n] += delta
+                        data[: self.n] += delta
                     else:
-                        self._data[: self.n] -= delta
+                        data[: self.n] -= delta
                 else:
                     padded = np.zeros(self._padded, self.dtype)
                     padded[: self.n] = delta
-                    self._data, self._ustate = self._full_update_fn()(
-                        self._data, self._ustate, jnp.asarray(padded),
-                        opt)
+                    donate = not self._data_pinned()
+                    if not donate:
+                        self._stat_cow += 1
+                    self._data, self._ustate = self._full_update_fn(
+                        donate)(self._data, self._ustate,
+                                jnp.asarray(padded), opt)
                 if self._dirty is not None:
                     self._dirty[:] = True
                 self._version += 1
             return {}, []
         if msg_type == svc.MSG_GET_FULL:
-            with self._lock:   # same donation race as MSG_GET_ROWS
-                # numpy-mode data is the LIVE buffer: copy under the lock
-                # so the reply can't tear against a concurrent add
-                full = (self._data[: self.n].copy() if self._np_mode
-                        else np.asarray(self._data))
-            return {}, wire.encode_payload(full[: self.n],
-                                           meta.get("wire", "none"))
+            return self._serve_get_full(meta)
         if msg_type == svc.MSG_GET_STATE:
             # updater-state leaves, full precision (checkpoint plumbing:
             # the sync table persists ustate, table.py store(); async
@@ -982,6 +1213,26 @@ class HashShard(RowShard):
                                 args={"table": self.name,
                                       "traces": [entry.trace]})
             return {}, []
+        if msg_type == svc.MSG_GET_ROWS and not meta.get("sparse"):
+            # allocation-free read: unknown keys gather the scratch row,
+            # which is invariantly zeros (padded adds apply zero deltas
+            # to it). Key->slot translation is atomic with the epoch pin
+            # (one lock hold); the gather + encode run off-lock like the
+            # range-sharded shard's.
+            keys = self._validate_keys(arrays[0])
+            tr = (meta.get(wire.TRACE_META_KEY) if _trace.enabled()
+                  else None)
+            t0 = time.time() if tr is not None else 0.0
+            with self._lock:
+                slots = np.array(
+                    [self._slot_of.get(k, self.n)
+                     for k in keys.tolist()], np.int64)
+                pin = self._pin_data_locked()
+            if tr is not None:
+                _trace.add_span("shard.get_pin", t0, time.time(),
+                                trace=tr, args={"table": self.name,
+                                                "rows": int(keys.size)})
+            return self._serve_rows_from_pin(pin, slots, meta, tr)
         with self._lock:   # reentrant: key->slot stays atomic w/ the update
             if msg_type == svc.MSG_GET_STATE and meta.get("dump"):
                 return self._dump()
@@ -989,16 +1240,6 @@ class HashShard(RowShard):
                 return self._restore(arrays)
             if msg_type in (svc.MSG_GET_ROWS, svc.MSG_SET_ROWS):
                 keys = self._validate_keys(arrays[0])
-                if msg_type == svc.MSG_GET_ROWS and not meta.get("sparse"):
-                    # allocation-free read: unknown keys gather the scratch
-                    # row, which is invariantly zeros (padded adds apply
-                    # zero deltas to it)
-                    slots = np.array(
-                        [self._slot_of.get(k, self.n)
-                         for k in keys.tolist()], np.int64)
-                    rows = self._gather_rows(slots)
-                    return {}, wire.encode_payload(
-                        rows, meta.get("wire", "none"))
                 slots = self._slots_for(keys)
                 arrays = [slots] + list(arrays[1:])
             return super().handle(msg_type, meta, arrays)

@@ -632,6 +632,222 @@ class _SendWindow:
                       "frames": nframes, "traces": all_tids})
 
 
+def _chunk_scatter(buf: np.ndarray, idx: Optional[np.ndarray],
+                   ncol: int, dtype):
+    """Sink for a chunk-streamed get reply (service.request chunk_sink):
+    decode each sub-frame as it lands on the peer's recv thread and
+    scatter it straight into ``buf`` — at ``idx[row0:row0+rows]``
+    positions when the part is a row subset, contiguously at
+    ``[row0:row0+rows]`` when it is a whole range. This is the overlap
+    the chunking exists for: chunk k decodes + scatters while chunk
+    k+1's bytes are still in flight."""
+    def sink(cmeta, arrays):
+        a, k = int(cmeta["row0"]), int(cmeta["rows"])
+        rows = wire_mod.decode_payload(arrays, cmeta.get("wire", "none"),
+                                       (k, ncol), dtype)
+        if idx is None:
+            buf[a:a + k] = rows
+        else:
+            buf[idx[a:a + k]] = rows
+    return sink
+
+
+class _GetWindow:
+    """Client-side get coalescer (the read-path mirror of
+    :class:`_SendWindow`), one per windowed table: concurrent
+    ``get_rows_async`` calls dedupe overlapping row ids per owner into
+    single-flight batched fetches.
+
+    Shape: a get to an owner with NO outstanding fetch dispatches
+    IMMEDIATELY — serial gets pay nothing for the window. Gets arriving
+    while that owner's fetch is on the wire queue here; their ids dedupe
+    into ONE follow-up frame dispatched the moment the outstanding reply
+    lands, or when the oldest queued entry ages past ``get_window_ms``
+    (the starvation bound: a 1-row get must not wait out a long chunked
+    fetch). Each waiter's future resolves to ITS OWN row block sliced
+    from the batch reply, so N concurrent pullers cost one frame, one
+    shard serve, and one reply instead of N.
+
+    Read-your-writes: every caller fences its SEND window before
+    reaching :meth:`fetch`, and a batch's frame reaches the conn only
+    AFTER the join — per-owner conn FIFO then orders the fetch behind
+    the caller's adds. Joining an already-dispatched fetch is impossible
+    by construction (dispatch atomically consumes the queue)."""
+
+    _IDLE_WAIT_S = 5.0
+
+    def __init__(self, table, window_ms: float):
+        self._table_ref = weakref.ref(table)
+        self._table_name = table.name
+        self.window_s = float(window_ms) / 1e3
+        self._cv = threading.Condition()
+        # owner -> [(unique ids, waiter future)], join order
+        self._queued: Dict[int, List[Tuple[np.ndarray, cf.Future]]] = {}
+        self._q_t0: Dict[int, float] = {}
+        self._inflight: Dict[int, int] = {}
+        # batches due NOW (a completed fetch released them): dispatched
+        # by the flusher thread, never on the peer's recv thread — a
+        # send from the recv callback could head-of-line-block (or, with
+        # both TCP buffers full, deadlock) the very reply plane that
+        # completes fetches
+        self._ready: List[Tuple[int, List[Tuple]]] = []
+        self._thread: Optional[threading.Thread] = None
+        base = f"table[{table.name}].get_rows"
+        self._mon_windowed = Dashboard.get(base + ".windowed")
+        self._mon_fetches = Dashboard.get(base + ".fetches")
+        self._mon_merged = Dashboard.get(base + ".merged_rows")
+
+    def fetch(self, owner: int, ids: np.ndarray) -> cf.Future:
+        """One caller's rows from ``owner`` (``ids`` unique, caller
+        order — the ``_prep`` contract); resolves to the
+        (len(ids), num_col) host block in that order."""
+        fut: cf.Future = cf.Future()
+        self._mon_windowed.incr()
+        with self._cv:
+            if self._inflight.get(owner, 0) > 0:
+                q = self._queued.setdefault(owner, [])
+                if not q:
+                    self._q_t0[owner] = time.monotonic()
+                q.append((ids, fut))
+                self._ensure_thread_locked()
+                self._cv.notify()
+                return fut
+            self._inflight[owner] = self._inflight.get(owner, 0) + 1
+        self._dispatch(owner, [(ids, fut)])
+        return fut
+
+    def _ensure_thread_locked(self) -> None:
+        """Start the flusher thread (caller holds ``self._cv``) — the
+        shared :func:`_window_loop` body over a weakref, here both aging
+        queued batches and dispatching released ones."""
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=_window_loop, args=(weakref.ref(self),),
+                daemon=True, name=f"ps-getwin-{self._table_name}")
+            self._thread.start()
+
+    def _step(self) -> bool:
+        """One flusher cycle (the :func:`_window_loop` body): dispatch
+        batches released by a completed fetch, plus queued batches whose
+        oldest entry aged past the window."""
+        with self._cv:
+            batches, self._ready = self._ready, []
+            if not batches and not self._q_t0:
+                self._cv.wait(self._IDLE_WAIT_S)
+                return False
+            now = time.monotonic()
+            due = [o for o, t0 in self._q_t0.items()
+                   if now - t0 >= self.window_s]
+            if not due and not batches:
+                soonest = min(self._q_t0.values()) + self.window_s - now
+                self._cv.wait(min(max(soonest, 0.001),
+                                  self._IDLE_WAIT_S))
+                return False
+            for o in due:
+                q = self._queued.pop(o, None)
+                self._q_t0.pop(o, None)
+                if q:
+                    self._inflight[o] = self._inflight.get(o, 0) + 1
+                    batches.append((o, q))
+        for o, q in batches:
+            self._dispatch(o, q)
+        return True
+
+    def _release(self, owner: int) -> None:
+        """A fetch completed: drop its flight and hand whatever queued
+        behind it to the FLUSHER as the next single-flight batch. Never
+        dispatches here: _release runs on the peer's recv thread (the
+        reply callback), and a socket send from there could block the
+        reply plane behind its own follow-up frame."""
+        with self._cv:
+            self._inflight[owner] = max(
+                self._inflight.get(owner, 1) - 1, 0)
+            if self._inflight[owner] == 0:
+                q = self._queued.pop(owner, None)
+                self._q_t0.pop(owner, None)
+                if q:
+                    self._inflight[owner] = 1
+                    self._ready.append((owner, q))
+                    self._ensure_thread_locked()
+                    self._cv.notify()
+
+    def _dispatch(self, owner: int, entries: List[Tuple]) -> None:
+        try:
+            self._dispatch_inner(owner, entries)
+        except Exception as e:   # noqa: BLE001 — waiters must never hang
+            for _, fut in entries:
+                if not fut.done():
+                    fut.set_exception(e)
+            self._release(owner)
+
+    def _dispatch_inner(self, owner: int, entries: List[Tuple]) -> None:
+        t = self._table_ref()
+        if t is None:
+            raise svc.PSError(
+                f"table[{self._table_name}] was garbage-collected with "
+                "coalesced gets still queued")
+        if len(entries) == 1:
+            # single-flight of one: ship the caller's ids as-is (caller
+            # order — _prep's no-dup path does NOT sort) and hand the
+            # reply block straight back
+            uids = entries[0][0]
+        else:
+            # merged batch: a SORTED unique union, so each waiter's
+            # (arbitrary-order) ids resolve by searchsorted below
+            cat = np.concatenate([ids for ids, _ in entries])
+            uids = np.unique(cat)
+            self._mon_merged.incr(int(cat.size - uids.size))
+        gw = t._get_wire_for(owner)
+        chunk = int(config.get_flag("get_chunk_rows"))
+        buf = np.empty((uids.size, t.num_col), t.dtype)
+        meta: Dict = {"table": t.name}
+        if gw != "none":
+            meta["wire"] = gw
+        sink = None
+        if chunk > 0 and uids.size > chunk and owner != t.ctx.rank:
+            meta["chunk"] = chunk
+            sink = _chunk_scatter(buf, None, t.num_col, t.dtype)
+        _flight.record(_flight.EV_GET_WIN, peer=owner,
+                       note=f"ops={len(entries)}")
+        self._mon_fetches.incr()
+        req = t.ctx.service.request(owner, svc.MSG_GET_ROWS, meta,
+                                    [uids], chunk_sink=sink)
+        chunked = sink is not None
+
+        def _done(bf, entries=entries, uids=uids, buf=buf, gw=gw,
+                  owner=owner, chunked=chunked, ncol=t.num_col,
+                  dt=t.dtype):
+            exc: Optional[BaseException] = None
+            try:
+                exc = bf.exception()
+                if exc is None:
+                    rmeta, arrays = bf.result()
+                    if not (chunked and rmeta.get("chunks")):
+                        buf[:] = wire_mod.decode_payload(
+                            arrays, gw, (uids.size, ncol), dt)
+            except (cf.CancelledError, Exception) as e:   # defensive
+                exc = e
+            try:
+                for ids, fut in entries:
+                    if fut.done():
+                        continue
+                    if exc is not None:
+                        fut.set_exception(exc)
+                    elif len(entries) == 1:
+                        fut.set_result(buf)   # reply IS this block
+                    else:
+                        # uids is sorted-unique here; fancy-index copy
+                        # gives each waiter its block in ITS id order
+                        fut.set_result(buf[np.searchsorted(uids, ids)])
+            finally:
+                # ALWAYS drop the flight: a slicing bug above must fail
+                # this batch, not wedge every later get behind a flight
+                # count that never returns to zero
+                self._release(owner)
+
+        req.add_done_callback(_done)
+
+
 def _maybe_register_in_zoo(table) -> Optional[int]:
     """Async tables join the Zoo registry (checkpoint walk, C ABI) when the
     runtime is up; standalone PSContext tests run without a Zoo."""
@@ -818,6 +1034,7 @@ class AsyncMatrixTable(_AsyncBase):
                  seed: Optional[int] = None, init_scale: float = 0.0,
                  shard_workers: int = 0, wire: str = "none",
                  send_window_ms: Optional[float] = None,
+                 get_window_ms: Optional[float] = None,
                  ctx: Optional[svc.PSContext] = None):
         """``shard_workers > 0`` enables per-worker dirty-bit tracking on
         the owned shard (the sparse stale-row protocol; set by
@@ -841,7 +1058,13 @@ class AsyncMatrixTable(_AsyncBase):
         this table: > 0 buffers ``add_rows_async`` client-side and ships
         each owner's queue as one (multi-op) frame — see _SendWindow.
         Gets/flush/waits fence the window, so results are bit-identical
-        to window-off; only the moment an add reaches the wire changes."""
+        to window-off; only the moment an add reaches the wire changes.
+
+        ``get_window_ms`` overrides the ``get_window_ms`` flag: > 0
+        installs the client get coalescer (single-flight per-owner
+        fetches deduping concurrent pullers' row ids into one frame —
+        see _GetWindow). Values are unchanged; only how many frames a
+        burst of concurrent gets costs."""
         super().__init__(ctx, name)
         if wire not in ("none", "bf16", "1bit", "topk"):
             raise ValueError(f"unknown wire {wire!r}")
@@ -889,11 +1112,18 @@ class AsyncMatrixTable(_AsyncBase):
                         for r in range(world)]
         self._ranges = [(r, a, b) for r, a, b in self._ranges if b > a]
         self._make_window(send_window_ms)
-        if self._window is not None:
-            # windowed adds ride the python conns; every other op must
-            # share that per-conn FIFO for the fence to mean
-            # read-your-writes, so the native fast path (its own socket =
-            # no cross-plane ordering) stays off for this table
+        # client get coalescer (flag get_window_ms / per-table override):
+        # None = every get is its own frame (the default)
+        self._get_window: Optional[_GetWindow] = None
+        gm = (config.get_flag("get_window_ms") if get_window_ms is None
+              else float(get_window_ms))
+        if gm > 0:
+            self._get_window = _GetWindow(self, gm)
+        if self._window is not None or self._get_window is not None:
+            # windowed adds/coalesced gets ride the python conns; every
+            # other op must share that per-conn FIFO for the fences to
+            # mean read-your-writes, so the native fast path (its own
+            # socket = no cross-plane ordering) stays off for this table
             self._native_ok = False
         self.table_id = _maybe_register_in_zoo(self)
 
@@ -1034,16 +1264,23 @@ class AsyncMatrixTable(_AsyncBase):
                  opt: Optional[AddOption] = None) -> None:
         self.wait(self.add_rows_async(row_ids, values, opt))
 
+    def _can_take_reply(self, out: Optional[np.ndarray],
+                        rows: int) -> bool:
+        """True when the caller's buffer can take reply rows directly
+        (right shape/dtype, C-contiguous) — the one predicate behind
+        both the scatter-target choice and the chunked commit."""
+        return (out is not None and isinstance(out, np.ndarray)
+                and out.dtype == self.dtype
+                and out.shape == (rows, self.num_col)
+                and out.flags.c_contiguous)
+
     def _reply_buffer(self, out: Optional[np.ndarray], rows: int
                       ) -> np.ndarray:
         """Scatter target for a get's per-owner replies: the CALLER's
-        buffer when it can take them directly (right shape/dtype,
-        C-contiguous), else a fresh array. Avoids the extra (rows x cols)
-        allocation + copy per get on the steady-state training loop."""
-        if (out is not None and isinstance(out, np.ndarray)
-                and out.dtype == self.dtype
-                and out.shape == (rows, self.num_col)
-                and out.flags.c_contiguous):
+        buffer when it can take them directly, else a fresh array.
+        Avoids the extra (rows x cols) allocation + copy per get on the
+        steady-state training loop."""
+        if self._can_take_reply(out, rows):
             return out
         return np.empty((rows, self.num_col), self.dtype)
 
@@ -1073,32 +1310,89 @@ class AsyncMatrixTable(_AsyncBase):
 
                 return self._track(futs, _assemble_native)
             parts = list(self._by_owner(uids))
+            if self._get_window is not None:
+                # coalesced single-flight fetches: each part resolves to
+                # its own row block (possibly served by a batch shared
+                # with concurrent callers)
+                futs = [self._get_window.fetch(int(r), uids[m])
+                        for r, m in parts]
+
+                def _assemble_win(results):
+                    buf = self._reply_buffer(out if inv is None else None,
+                                             uids.size)
+                    for (r, m), rows in zip(parts, results):
+                        buf[m] = rows
+                    if inv is None:
+                        return buf
+                    dest = self._reply_buffer(out, inv.size)
+                    np.take(buf, inv, axis=0, out=dest)
+                    return dest
+
+                return self._track(futs, _assemble_win)
             # remote peers share one packed meta (with the table's reply
             # wire); the local short-circuit keeps its uncompressed dict
             gw = self._reply_wire()
+            chunk = int(config.get_flag("get_chunk_rows"))
             tid = ttrace.new_id() if ttrace.enabled() else None
             t_send0 = time.time() if tid is not None else 0.0
             meta_b = wire_mod.pack_meta(wire_mod.with_trace(
                 {"table": self.name, "wire": gw}, tid))
-            futs = [self.ctx.service.request(
+            will_chunk = {r for r, m in parts
+                          if (chunk > 0
+                              and int(np.count_nonzero(m)) > chunk
+                              and r != self.ctx.rank)}
+            # the scatter target exists BEFORE dispatch when a part may
+            # stream back chunked: the sinks decode each sub-frame on
+            # the recv thread straight into it, overlapping the receive.
+            # With chunking live the target is PRIVATE even when the
+            # caller passed out= — a stream failing mid-way must raise
+            # with the caller's buffer untouched, not torn across two
+            # epochs; _assemble commits into out only on full success.
+            buf = self._reply_buffer(
+                out if inv is None and not will_chunk else None,
+                uids.size)
+            futs = []
+            chunked: Dict[int, bool] = {}
+            for r, m in parts:
+                if r in will_chunk:
+                    futs.append(self.ctx.service.request(
+                        r, svc.MSG_GET_ROWS,
+                        wire_mod.with_trace(
+                            {"table": self.name, "wire": gw,
+                             "chunk": chunk}, tid),
+                        [uids[m]],
+                        chunk_sink=_chunk_scatter(
+                            buf, np.flatnonzero(m), self.num_col,
+                            self.dtype)))
+                    chunked[r] = True
+                else:
+                    futs.append(self.ctx.service.request(
                         r, svc.MSG_GET_ROWS,
                         wire_mod.with_trace(
                             {"table": self.name, "wire": "none"}, tid),
-                        [uids[m]], meta_b=meta_b)
-                    for r, m in parts]
+                        [uids[m]], meta_b=meta_b))
             if tid is not None:
                 _attach_reply_span(futs, "client.get_rows", t_send0, tid,
                                    self.name)
 
             def _assemble(results):
-                buf = self._reply_buffer(out if inv is None else None,
-                                         uids.size)
-                for (r, m), (_, arrays) in zip(parts, results):
+                for (r, m), (rmeta, arrays) in zip(parts, results):
+                    if chunked.get(r) and rmeta.get("chunks"):
+                        continue   # the sinks already scattered this part
                     w = "none" if r == self.ctx.rank else gw
                     buf[m] = wire_mod.decode_payload(
                         arrays, w, (int(np.count_nonzero(m)),
                                     self.num_col), self.dtype)
                 if inv is None:
+                    if (out is not None and buf is not out
+                            and self._can_take_reply(out, uids.size)):
+                        # chunked scatter used a private buffer: commit
+                        # to the caller's ONLY now, after every part
+                        # completed successfully. A shape-valid but
+                        # dtype/layout-unsuitable out skips this — the
+                        # get_rows fallback does the one cast-copy.
+                        np.copyto(out, buf)
+                        return out
                     return buf
                 # re-expand duplicates to original order, into the
                 # caller's buffer when it fits
@@ -1110,11 +1404,34 @@ class AsyncMatrixTable(_AsyncBase):
 
     def get_rows(self, row_ids, out: Optional[np.ndarray] = None
                  ) -> np.ndarray:
+        flat_out = None
+        if out is not None:
+            # validate the SHAPE up front: the old reshape-then-copyto
+            # fallback silently accepted ANY out whose size matched — a
+            # (cols, rows) buffer would be filled transposed and read
+            # back as garbage rows. Accepted: the exact (n, cols) shape,
+            # or an unambiguous FLAT (n*cols,) buffer (the legacy
+            # reference-binding surface, handlers.py — row-major fill is
+            # its only meaning). Everything else raises.
+            want = (np.asarray(row_ids).reshape(-1).size, self.num_col)
+            shape = getattr(out, "shape", None)
+            if (shape == (want[0] * want[1],)
+                    and out.flags.c_contiguous):
+                # contiguity required: reshape on a strided 1-D view
+                # would COPY, and the fill would never reach the caller
+                flat_out, out = out, None   # fill via the copy fallback
+            elif shape != want:
+                raise ValueError(
+                    f"get_rows(out=): out has shape {shape}, required "
+                    f"{want} (or flat ({want[0] * want[1]},))")
         host = self.wait(self.get_rows_async(row_ids, out=out))
+        if flat_out is not None:
+            np.copyto(flat_out.reshape(host.shape), host)
+            return flat_out
         if out is not None and host is not out:
-            # fallback for shape/dtype/layout mismatches the reply
-            # scatter could not take directly
-            np.copyto(out.reshape(host.shape), host)
+            # fallback for dtype/layout mismatches the reply scatter
+            # could not take directly (shapes already validated equal)
+            np.copyto(out, host)
             return out
         return host
 
@@ -1223,6 +1540,8 @@ class AsyncMatrixTable(_AsyncBase):
         self._flush_window()   # read-your-writes for windowed adds
         with monitor(f"table[{self.name}].get"):
             ranges = list(self._ranges)
+            host = np.empty(self.shape, self.dtype)
+            chunked: Dict[int, bool] = {}
             if self._native_ok:
                 futs = [_native_get(self.ctx.service, r, svc.MSG_GET_FULL,
                                     self._plain_meta_b, None,
@@ -1230,19 +1549,35 @@ class AsyncMatrixTable(_AsyncBase):
                                              self.dtype))
                         for r, a, b in ranges]
             else:
-                futs = [self.ctx.service.request(
+                chunk = int(config.get_flag("get_chunk_rows"))
+                futs = []
+                for r, a, b in ranges:
+                    w = self._get_wire_for(r)
+                    if (chunk > 0 and (b - a) > chunk
+                            and r != self.ctx.rank):
+                        # streamed whole-shard pull: sub-frames scatter
+                        # into this range's rows as they land
+                        futs.append(self.ctx.service.request(
                             r, svc.MSG_GET_FULL,
-                            {"table": self.name,
-                             "wire": self._get_wire_for(r)})
-                        for r, _, _ in ranges]
+                            {"table": self.name, "wire": w,
+                             "chunk": chunk},
+                            chunk_sink=_chunk_scatter(
+                                host[a:b], None, self.num_col,
+                                self.dtype)))
+                        chunked[r] = True
+                    else:
+                        futs.append(self.ctx.service.request(
+                            r, svc.MSG_GET_FULL,
+                            {"table": self.name, "wire": w}))
 
             def _assemble(results):
-                out = np.empty(self.shape, self.dtype)
-                for (r, a, b), (_, arrays) in zip(ranges, results):
-                    out[a:b] = wire_mod.decode_payload(
+                for (r, a, b), (rmeta, arrays) in zip(ranges, results):
+                    if chunked.get(r) and rmeta.get("chunks"):
+                        continue   # scattered by the sinks already
+                    host[a:b] = wire_mod.decode_payload(
                         arrays, self._get_wire_for(r),
                         (b - a, self.num_col), self.dtype)
-                return out
+                return host
 
         return self._track(futs, _assemble)
 
@@ -1464,6 +1799,7 @@ class AsyncSparseMatrixTable(_SparseGetMixin, AsyncMatrixTable):
                  init=None, seed=None, init_scale: float = 0.0,
                  num_workers: Optional[int] = None,
                  send_window_ms: Optional[float] = None,
+                 get_window_ms: Optional[float] = None,
                  ctx: Optional[svc.PSContext] = None):
         ctx = ctx if ctx is not None else svc.default_context()
         self._n_workers = num_workers or max(ctx.world, 1)
@@ -1471,7 +1807,8 @@ class AsyncSparseMatrixTable(_SparseGetMixin, AsyncMatrixTable):
                          name=name, init=init, seed=seed,
                          init_scale=init_scale,
                          shard_workers=self._n_workers,
-                         send_window_ms=send_window_ms, ctx=ctx)
+                         send_window_ms=send_window_ms,
+                         get_window_ms=get_window_ms, ctx=ctx)
         self._caches: Dict[int, Any] = {}
         self._caches_lock = threading.Lock()
         self._pull_seq = 0

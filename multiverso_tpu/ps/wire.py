@@ -83,6 +83,25 @@ def with_trace(meta: Dict, trace) -> Dict:
 ONEBIT_BLOCK = 1024   # per-block scale granularity of the "1bit" wire
 
 
+class ChunkedReply:
+    """A streamed get reply: ``meta`` is the FINAL frame's meta (carries
+    ``chunks``/``rows`` so the client knows the stream's shape) and
+    ``chunks`` an iterator of ``(chunk_meta, chunk_arrays)`` sub-frames.
+    A handler returns one of these instead of a blob list when the
+    client asked for a chunk-streamed reply (request meta ``"chunk"``);
+    the service sends each sub-frame as ``MSG_REPLY_CHUNK`` under the
+    request's msg_id as the iterator yields — so the peer's decode +
+    ``out=`` scatter overlaps the network receive — and closes the
+    stream with an ordinary ``MSG_REPLY_OK`` carrying ``meta``. An
+    exception raised mid-iteration becomes a ``MSG_REPLY_ERR`` like any
+    handler failure; the client discards accumulated chunks on ERR."""
+
+    __slots__ = ("meta", "chunks")
+
+    def __init__(self, meta: Dict, chunks):
+        self.meta, self.chunks = meta, chunks
+
+
 def to_wire(arr: np.ndarray, wire: str) -> np.ndarray:
     """Single-blob codec for a wire mode ("none" | "bf16"): shared by
     client sends and shard replies. The receiving side decodes implicitly

@@ -60,6 +60,24 @@ config.define_bool(
     "host-plane ops are collective and identical on every process, so "
     "versions advance in lockstep and all ranks hit or miss together")
 
+config.define_bool(
+    "table_get_prefetch", True,
+    "write-triggered snapshot prefetch for whole-table Get on a "
+    "tunneled/remote device: once a Get-after-Add pattern is observed, "
+    "each whole-table Add also dispatches a non-donating snapshot of "
+    "the post-update data and starts its device->host copy "
+    "IMMEDIATELY, so the transfer streams while the caller is still "
+    "waiting out the Add's own round-trip — the next Get at that "
+    "version waits only the residual instead of paying the full "
+    "dispatch RTT + transfer (BENCH_r05: ~226 ms blocking get on a "
+    "~105 ms-RTT tunnel). Bit-exact: the snapshot is the same bytes a "
+    "blocking Get would pull at that version; a version mismatch "
+    "(another mutation landed first) discards it. Costs one extra "
+    "table-sized device buffer + one background transfer per "
+    "prefetching Add, so it self-disarms when two Adds pass with no "
+    "Get consuming the snapshot. Single-controller only (multi-host "
+    "pulls stay collective)")
+
 
 class _HostAdd:
     """One queued client-side add awaiting the coalescing applier."""
@@ -187,6 +205,22 @@ class Table:
         # dispatch + device->host transfer entirely (flag table_get_cache)
         self._version = 0
         self._get_cache: Optional[Tuple[int, np.ndarray]] = None
+        # write-triggered snapshot prefetch (flag table_get_prefetch):
+        # (version, in-flight device snapshot) dispatched by the LAST
+        # whole-table add, consumed by the next Get at that version.
+        # _prefetch_armed latches on the first Get and drops when a
+        # prefetch goes unconsumed (two adds, no get), so add-only
+        # workloads never pay the extra snapshot. All under the
+        # dispatch lock.
+        self._get_prefetch: Optional[Tuple[int, jax.Array]] = None
+        self._prefetch_armed = False
+        # unconsumed-prefetch backoff: each wasted snapshot doubles how
+        # many arming opportunities are skipped (capped), and one
+        # CONSUMED prefetch resets it — a mixed add,add,get cadence
+        # decays to ~no wasted transfers instead of burning one
+        # table-sized device->host copy per cycle
+        self._prefetch_backoff = 0
+        self._prefetch_skip = 0
         # Serializes op *dispatch* (not device execution): a donating add on
         # one thread must not delete the data buffer while another thread
         # (e.g. an AsyncBuffer prefetch pull) is snapshotting it.
@@ -287,6 +321,58 @@ class Table:
             np.copyto(into.reshape(self.shape), cache[1])
             return into
         return cache[1].copy()
+
+    def _maybe_prefetch(self) -> None:
+        """Write-triggered snapshot prefetch (caller holds the dispatch
+        lock, right after a whole-table update dispatched): snapshot the
+        post-update data (non-donating) and start its device->host copy
+        NOW, so the bytes stream back concurrently with the caller's own
+        wait on the add — the read path's half of the off-lock snapshot
+        theme, applied to the tunneled-device seam. Armed only while a
+        Get-after-Add pattern holds: an unconsumed prefetch (two adds,
+        no get between) disarms it, so add-only workloads pay nothing."""
+        if self._get_prefetch is not None:
+            # the previous prefetch was never consumed: this workload is
+            # not in a clean get-after-add regime — drop it, disarm, and
+            # back off exponentially (a Get re-arms, but a thrashing
+            # add,add,get cadence must not buy one wasted table-sized
+            # transfer per cycle forever)
+            self._prefetch_armed = False
+            self._get_prefetch = None
+            self._prefetch_backoff = min(self._prefetch_backoff * 2 + 1,
+                                         16)
+            self._prefetch_skip = self._prefetch_backoff
+            return
+        if (not self._prefetch_armed
+                or not config.get_flag("table_get_prefetch")
+                or self._zoo.size() > 1):
+            return
+        if self._prefetch_skip > 0:
+            self._prefetch_skip -= 1
+            return
+        snap = (self._bf16_cast_fn()(self._data) if self._wire != "none"
+                else self._snapshot_fn()(self._data))
+        try:
+            snap.copy_to_host_async()
+        except AttributeError:
+            pass
+        self._get_prefetch = (self._version, snap)
+
+    def _take_prefetch(self) -> Optional[jax.Array]:
+        """The in-flight prefetched snapshot for the CURRENT version, or
+        None (caller holds the dispatch lock). A stale snapshot (another
+        mutation landed after it) is dropped — its bytes are not the
+        bytes a Get at this version must return."""
+        self._prefetch_armed = True
+        pf = self._get_prefetch
+        if pf is None:
+            return None
+        self._get_prefetch = None
+        if pf[0] != self._version:
+            return None
+        self._prefetch_backoff = 0   # consumed: the regime is real
+        Dashboard.get(f"table[{self.name}].get.prefetched").incr()
+        return pf[1]
 
     def _store_get_cache(self, version: int, host: np.ndarray) -> None:
         """Caller holds the dispatch lock. An older-version store (a slow
@@ -578,6 +664,9 @@ class Table:
                 self._data, self._ustate, token = self._full_update_fn()(
                     self._data, self._ustate, delta_dev, batch[0].opt)
                 self._version_applied()
+                # prefetch BEFORE the waiters wake: the snapshot's
+                # device->host copy streams while they block on the token
+                self._maybe_prefetch()
             for e in batch:
                 e.token = token
         except Exception as err:   # pragma: no cover - device failure
@@ -657,6 +746,7 @@ class Table:
                 self._data, self._ustate, token = self._full_update_fn()(
                     self._data, self._ustate, delta_dev, opt)
                 self._version_applied()
+                self._maybe_prefetch()
         return self._track(token)
 
     def _add_async_wire(self, delta: ArrayLike, opt: AddOption) -> int:
@@ -716,6 +806,7 @@ class Table:
                 jax.device_put(idx, self._replicated),
                 jax.device_put(vals, self._replicated), opt)
         self._version_applied()
+        self._maybe_prefetch()
         return token
 
     def add(self, delta: ArrayLike, opt: Optional[AddOption] = None) -> None:
@@ -734,13 +825,18 @@ class Table:
             if cached is not None:
                 return self._track((), lambda _: cached)
             version = self._version
-            snap = (self._bf16_cast_fn()(self._data)
-                    if self._wire != "none"
-                    else self._snapshot_fn()(self._data))
-            try:
-                snap.copy_to_host_async()
-            except AttributeError:
-                pass
+            # a write-triggered prefetch at this version already has its
+            # transfer in flight — adopt it instead of dispatching a
+            # fresh snapshot (same bytes by construction)
+            snap = self._take_prefetch()
+            if snap is None:
+                snap = (self._bf16_cast_fn()(self._data)
+                        if self._wire != "none"
+                        else self._snapshot_fn()(self._data))
+                try:
+                    snap.copy_to_host_async()
+                except AttributeError:
+                    pass
 
             def _finalize(s, _v=version):
                 host = self._to_host(s)[: self.shape[0]]
@@ -772,7 +868,14 @@ class Table:
             if hit is not None:
                 return hit
             version = self._version
-            if self._wire != "none":
+            snap = self._take_prefetch()
+            if snap is not None:
+                # the prefetched transfer has been streaming since the
+                # add dispatched it: wait out only the residual
+                host = self._to_host(snap)[: self.shape[0]]
+                if host.dtype != self.dtype:
+                    host = host.astype(self.dtype)
+            elif self._wire != "none":
                 host = self._to_host(self._bf16_cast_fn()(self._data))
                 host = host[: self.shape[0]].astype(self.dtype)
             else:

@@ -97,6 +97,9 @@ EV_SLOW = 20           # watchdog: request older than watchdog_slow_ms
 EV_STUCK = 21          # watchdog: request older than watchdog_stuck_s
 EV_STATE = 22          # free-form state transition (note names it)
 EV_SSP_RESOLVED = 23   # a blocked SSP wait resolved (pairs EV_SSP_WAIT)
+EV_GET_SERVE = 24      # shard: a get pinned an epoch to serve off-lock
+EV_GET_CHUNK = 25      # service: one streamed-reply sub-frame sent
+EV_GET_WIN = 26        # client get coalescer: one batched fetch shipped
 
 EV_NAMES = {
     EV_SEND: "send", EV_ACK: "ack", EV_ERR: "err", EV_RECV: "recv",
@@ -109,7 +112,8 @@ EV_NAMES = {
     EV_SSP_TIMEOUT: "ssp.timeout", EV_PEER_DEAD: "peer.dead",
     EV_FATAL: "fatal", EV_SIGNAL: "signal", EV_SLOW: "watchdog.slow",
     EV_STUCK: "watchdog.stuck", EV_STATE: "state",
-    EV_SSP_RESOLVED: "ssp.resolved",
+    EV_SSP_RESOLVED: "ssp.resolved", EV_GET_SERVE: "get.serve",
+    EV_GET_CHUNK: "get.chunk", EV_GET_WIN: "get.window",
 }
 
 

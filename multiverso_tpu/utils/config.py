@@ -209,6 +209,26 @@ define_int("batch_window_bytes", 1 << 20,
 define_int("batch_window_ops", 64,
            "flush an owner's send window early once this many logical "
            "adds are queued for it")
+# Client-side GET coalescer + chunk-streamed replies (the read-path
+# mirror of the send window, ps/tables._GetWindow + ps/wire.ChunkedReply)
+define_float("get_window_ms", 0.0,
+             "enable the client get coalescer for async tables: > 0 "
+             "turns on single-flight per-owner fetches — a get to an "
+             "idle owner dispatches immediately (no added latency); "
+             "gets arriving while that owner's fetch is outstanding "
+             "dedupe into ONE follow-up frame, dispatched when the "
+             "outstanding reply lands or when the oldest queued get is "
+             "this many ms old (so a small get is never starved behind "
+             "a long chunked fetch). 0 disables (every get is its own "
+             "frame). Per-table override: get_window_ms= on the table")
+define_int("get_chunk_rows", 0,
+           "chunk-stream get replies above this many rows: the server "
+           "ships N self-describing sub-frames instead of one "
+           "mega-frame, so the client's decode + out= scatter overlaps "
+           "the network receive. 0 disables. Only requested over python "
+           "conns; a native C++ server punts chunk-requesting gets to "
+           "its python handlers (slower than its zero-Python fast "
+           "path — leave 0 when the hot gets are natively served)")
 define_bool("ma", False, "model-average (allreduce) mode: no parameter tables")
 define_bool("sync", False, "BSP semantics (reference SyncServer). On TPU sync is "
             "the hardware-native mode; async emulated via sync_frequency")

@@ -181,3 +181,59 @@ def test_flightrec_dumps_recorded(tmp_path, monkeypatch):
     assert collect_flightrec_dumps(str(tmp_path),
                                    since=_time.time() + 60) == []
     assert collect_flightrec_dumps(str(tmp_path), since=0.0) == dumps
+
+
+def test_get_rows_bench_smoke():
+    """Tier-1 smoke of tools/bench_get_rows.py (ISSUE 5 read-path bench)
+    at toy scale through the REAL subprocess spawn/collect machinery:
+    both parity gates are in-run assertions, so a pass here means the
+    coalesced and chunk-streamed planes returned exact bytes."""
+    import json
+    import subprocess
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "bench_get_rows.py"),
+         "30", "2000"],
+        capture_output=True, text=True, timeout=240, env=env, cwd=_REPO)
+    assert out.returncode == 0, out.stderr[-800:]
+    line = [x for x in out.stdout.splitlines()
+            if x.startswith("RESULT ")][-1]
+    r = json.loads(line[len("RESULT "):])
+    assert r["parity_bit_for_bit"] and r["chunk_parity_bit_for_bit"]
+    assert r["small_get_on_p50_ms"] > 0 and r["small_get_off_p50_ms"] > 0
+    assert r["big_get_chunked_ms"] > 0
+    # the fan-in phase must have actually deduped something
+    assert r["fanout_frames"] < r["fanout_gets"]
+
+
+def test_run_bench_regression_flagging():
+    """ISSUE 5 CI satellite: run_bench FLAGS (never fails) a >2x
+    latency regression of the get/small-add planes vs the previous
+    recorded BENCH file, and skips keys either side is missing."""
+    from tools.run_bench import flag_regressions
+
+    prev = {"extra": {
+        "get_rows_plane": {"small_get_on_p50_ms": 0.5,
+                           "small_get_off_p50_ms": 0.6,
+                           "big_get_chunked_ms": 20.0},
+        "small_add_send_window": {"window_on_p50_ms": 0.04},
+    }}
+    same = flag_regressions(prev, prev)
+    assert same == []
+    worse = {"extra": {
+        "get_rows_plane": {"small_get_on_p50_ms": 1.2,   # 2.4x: flagged
+                           "small_get_off_p50_ms": 0.9,  # 1.5x: fine
+                           "big_get_chunked_ms": 90.0},  # 4.5x: flagged
+        "small_add_send_window": {"window_on_p50_ms": 0.05},
+    }}
+    flags = flag_regressions(prev, worse)
+    assert len(flags) == 2
+    assert any("coalesced small-get p50" in f for f in flags)
+    assert any("chunked big-get" in f for f in flags)
+    # missing keys (older record / errored sub-bench) are skipped
+    assert flag_regressions(None, worse) == []
+    assert flag_regressions({"extra": {}}, worse) == []
+    assert flag_regressions(
+        prev, {"extra": {"get_rows_plane": {"error": "boom"}}}) == []

@@ -1,0 +1,174 @@
+"""Bench worker: the PS read path (ISSUE 5) — small-get per-call latency
+with the client get coalescer on vs off, a concurrent fan-in phase
+showing the single-flight dedupe, and one large get plain vs
+chunk-streamed. Mirrors tools/bench_small_add.py: two PSContexts in one
+process (2-rank world over real localhost sockets), identical request
+streams to both arms, and latency is only reported when the returned
+values match bit-for-bit.
+
+  off — every get_rows ships its own frame immediately (rides the
+        native C++ transport where built, i.e. the FASTEST window-off
+        baseline available)
+  on  — get_window_ms=2: single-flight per-owner fetches; serial gets
+        dispatch immediately (no added latency), concurrent gets dedupe
+        into one frame per owner
+
+Every get targets the REMOTE rank's rows, so the off arm's cost is a
+real socket round-trip, not the local short-circuit.
+
+Invoked as: python tools/bench_get_rows.py [iters] [big_rows]
+(``big_rows`` shrinks the chunk-streamed phase for tier-1 smoke runs.)
+Prints "RESULT <json>".
+"""
+
+import json
+import sys
+import tempfile
+import threading
+import time
+
+
+def main():
+    iters = int(sys.argv[1]) if len(sys.argv) > 1 else 300
+    big_rows = int(sys.argv[2]) if len(sys.argv) > 2 else 120_000
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from multiverso_tpu.ps.service import (FileRendezvous, PSContext,
+                                           PSService)
+    from multiverso_tpu.ps.tables import AsyncMatrixTable
+    from multiverso_tpu.utils import config
+    from multiverso_tpu.utils.dashboard import Dashboard
+
+    rows, cols = 4096, 32
+    rng = np.random.default_rng(7)
+    init = rng.normal(size=(rows, cols)).astype(np.float32)
+    with tempfile.TemporaryDirectory(prefix="mv_get_rows_") as rdv_dir:
+        rdv = FileRendezvous(rdv_dir)
+        ctxs = [PSContext(r, 2, PSService(r, 2, rdv)) for r in range(2)]
+        t_off = AsyncMatrixTable(rows, cols, name="gr_off", init=init,
+                                 ctx=ctxs[0])
+        AsyncMatrixTable(rows, cols, name="gr_off", init=init, ctx=ctxs[1])
+        t_on = AsyncMatrixTable(rows, cols, name="gr_on", init=init,
+                                get_window_ms=2.0, ctx=ctxs[0])
+        AsyncMatrixTable(rows, cols, name="gr_on", init=init, ctx=ctxs[1])
+
+        # remote-owned single rows: rank 1 owns [2048, 4096)
+        ids = rng.integers(rows // 2, rows, iters)
+        for i in rng.integers(rows // 2, rows, 32):   # warm conns + jit
+            t_off.get_rows([i])
+            t_on.get_rows([i])
+
+        def serial_arm(table):
+            samples, got = [], None
+            for i in range(iters):
+                t0 = time.perf_counter()
+                got = table.get_rows([ids[i]])
+                samples.append(time.perf_counter() - t0)
+            return samples, got
+
+        on_s, on_last = serial_arm(t_on)
+        off_s, off_last = serial_arm(t_off)
+        parity = bool(np.array_equal(on_last, off_last) and np.array_equal(
+            t_on.get_rows(np.arange(rows)), t_off.get_rows(np.arange(rows))))
+        if not parity:
+            raise AssertionError(
+                "get-coalescer parity broke: window-on table returned "
+                "different bytes than window-off for the identical reads")
+
+        def pct(s, q):
+            return round(float(np.percentile(np.asarray(s) * 1e3, q)), 5)
+
+        # concurrent fan-in: N threads pulling overlapping remote rows at
+        # once — the single-flight shape the coalescer exists for. The
+        # dedupe is read off the fetch counters (frames actually sent vs
+        # logical gets), not wall time: in-process thread scheduling is
+        # too noisy for a latency claim here.
+        fan_threads, fan_iters = 4, max(iters // 4, 25)
+        fetch_mon = Dashboard.get("table[gr_on].get_rows.fetches")
+        win_mon = Dashboard.get("table[gr_on].get_rows.windowed")
+        f0, w0 = fetch_mon.count, win_mon.count
+
+        def fan(table):
+            errs = []
+
+            def run(seed):
+                r = np.random.default_rng(seed)
+                try:
+                    for _ in range(fan_iters):
+                        table.get_rows(r.integers(rows // 2, rows, 4))
+                except Exception as e:  # noqa: BLE001 — join surfaces it
+                    errs.append(e)
+            ths = [threading.Thread(target=run, args=(s,))
+                   for s in range(fan_threads)]
+            t0 = time.perf_counter()
+            for th in ths:
+                th.start()
+            for th in ths:
+                th.join()
+            if errs:
+                raise errs[0]
+            return time.perf_counter() - t0
+
+        fan_on_wall = fan(t_on)
+        fan_off_wall = fan(t_off)
+        fan_gets = win_mon.count - w0
+        fan_frames = fetch_mon.count - f0
+
+        # large get, plain vs chunk-streamed (bf16 wire keeps the serve
+        # on the python plane either way, so the comparison isolates the
+        # chunking — and exercises the codec-per-chunk path)
+        big_cols = 8
+        t_big = AsyncMatrixTable(big_rows, big_cols, name="gr_big",
+                                 wire="bf16", ctx=ctxs[0])
+        AsyncMatrixTable(big_rows, big_cols, name="gr_big", wire="bf16",
+                         ctx=ctxs[1])
+        t_big.set_rows(np.arange(big_rows),
+                       rng.normal(size=(big_rows, big_cols))
+                       .astype(np.float32))
+        all_ids = np.arange(big_rows)
+
+        def timed_big():
+            t0 = time.perf_counter()
+            got = t_big.get_rows(all_ids)
+            return time.perf_counter() - t0, got
+
+        timed_big()   # warm
+        plain_s, plain_got = min(timed_big() for _ in range(3))
+        config.set_flag("get_chunk_rows", max(big_rows // 8, 256))
+        try:
+            chunk_s, chunk_got = min(timed_big() for _ in range(3))
+        finally:
+            config.set_flag("get_chunk_rows", 0)
+        chunk_parity = bool(np.array_equal(plain_got, chunk_got))
+        if not chunk_parity:
+            raise AssertionError(
+                "chunked-get parity broke: streamed reply differs from "
+                "the one-frame reply for the identical read")
+
+        for c in ctxs:
+            c.close()
+
+    print("RESULT " + json.dumps({
+        "small_get_off_p50_ms": pct(off_s, 50),
+        "small_get_on_p50_ms": pct(on_s, 50),
+        "small_get_off_p99_ms": pct(off_s, 99),
+        "small_get_on_p99_ms": pct(on_s, 99),
+        "fanout_gets": int(fan_gets),
+        "fanout_frames": int(fan_frames),
+        "fanout_dedupe": (round(fan_gets / fan_frames, 2)
+                          if fan_frames else None),
+        "fanout_on_wall_s": round(fan_on_wall, 3),
+        "fanout_off_wall_s": round(fan_off_wall, 3),
+        "big_get_rows": big_rows,
+        "big_get_plain_ms": round(plain_s * 1e3, 3),
+        "big_get_chunked_ms": round(chunk_s * 1e3, 3),
+        "chunk_parity_bit_for_bit": chunk_parity,
+        "parity_bit_for_bit": parity,
+        "iters": iters,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -66,6 +66,44 @@ def record(returncode: int, stdout: str, flightrec_dumps=()) -> dict:
     }
 
 
+# bench-extra latency keys compared run-over-run: (path into headline
+# "extra", human label). Lower is better for all of them.
+_REGRESSION_KEYS = (
+    (("get_rows_plane", "small_get_on_p50_ms"), "coalesced small-get p50"),
+    (("get_rows_plane", "small_get_off_p50_ms"), "plain small-get p50"),
+    (("get_rows_plane", "big_get_chunked_ms"), "chunked big-get"),
+    (("small_add_send_window", "window_on_p50_ms"), "windowed small-add p50"),
+)
+
+
+def _extra_value(headline, path):
+    node = (headline or {}).get("extra", {})
+    for key in path:
+        if not isinstance(node, dict):
+            return None
+        node = node.get(key)
+    return node if isinstance(node, (int, float)) else None
+
+
+def flag_regressions(prev_headline, new_headline, factor: float = 2.0):
+    """Compare this run's recorded get/small-add latencies against the
+    PREVIOUS recorded bench file: anything more than ``factor``x slower
+    is FLAGGED (returned as human-readable strings), never failed — the
+    box's weather varies, and the flag exists so the next session sees
+    the band moved, not to veto a run. Keys missing on either side
+    (older record, errored sub-bench) are skipped."""
+    out = []
+    for path, label in _REGRESSION_KEYS:
+        old = _extra_value(prev_headline, path)
+        new = _extra_value(new_headline, path)
+        if old is None or new is None or old <= 0:
+            continue
+        if new > factor * old:
+            out.append(f"{label}: {new} vs {old} previously "
+                       f"({new / old:.1f}x, flag threshold {factor}x)")
+    return out
+
+
 def collect_flightrec_dumps(directory: str, since: float = 0.0):
     """Dump files under a run's flight-recorder directory (basenames;
     [] when the directory never materialized — no dump was written).
@@ -122,11 +160,27 @@ def main(argv) -> int:
                                          since=start - 2.0))
     if rec["headline"] is None:
         sys.stderr.write(proc.stderr[-2000:])
+    # run-over-run latency regression band: compare against the PREVIOUS
+    # record at this path (when one exists) and FLAG — never fail — a
+    # >2x slowdown of the get/small-add planes, so the next session
+    # inherits an explicit signal instead of silently re-baselining
+    prev = None
+    try:
+        with open(out_path) as f:
+            prev = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        pass
+    regressions = flag_regressions((prev or {}).get("headline"),
+                                   rec["headline"])
+    rec["regressions"] = regressions
+    for r in regressions:
+        sys.stderr.write(f"REGRESSION FLAG: {r}\n")
     with open(out_path, "w") as f:
         json.dump(rec, f, indent=1)
     print(json.dumps({"recorded": os.path.relpath(out_path, _REPO),
                       "truncated": rec["truncated"],
                       "complete": rec["complete"],
+                      "regressions": regressions,
                       "flightrec_dumps": rec["flightrec_dumps"]}))
     return proc.returncode
 
