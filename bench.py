@@ -66,6 +66,21 @@ def _dashboard_hist(max_monitors: int = 64):
     return out
 
 
+def _cluster_extra():
+    """Compact cluster record from the stats aggregator, when one ran
+    (flag ``stats_poll_interval_s`` > 0 starts it on PS rank 0): merged
+    cross-rank histograms, per-shard op counts, skew, and the hot-key
+    top-K — the all-ranks view ``_dashboard_hist`` (this process's local
+    monitors only) cannot give a multi-process run. None when no
+    aggregator ran, so single-process records are unchanged."""
+    from multiverso_tpu.telemetry import aggregator
+    agg = aggregator.global_aggregator()
+    if agg is None:
+        return None
+    # fresh final poll so the record reflects run-end counters
+    return aggregator.compact_record(agg.poll_once())
+
+
 # degenerate two-point measurements (t_hi < t_lo: timing noise swamped the
 # signal) recorded here and surfaced in the bench record's "extra" — a
 # floored slope must stay visible as a bad measurement, not pass as data
@@ -1030,6 +1045,18 @@ def main() -> None:
         dashboard_hist = _dashboard_hist()
     except Exception as e:
         dashboard_hist = {"error": f"{type(e).__name__}: {e}"[:200]}
+    # cluster view (aggregator flag-gated; None on the default
+    # single-process config). When polling was live, the merged
+    # cross-rank monitor histograms REPLACE the local-only
+    # dashboard_hist snapshot — a multi-process run's record must
+    # reflect every rank's latencies, not just rank 0's monitors.
+    try:
+        cluster_stats = _cluster_extra()
+    except Exception as e:
+        cluster_stats = {"error": f"{type(e).__name__}: {e}"[:200]}
+    if isinstance(cluster_stats, dict) and cluster_stats.get("monitors"):
+        dashboard_hist = dict(cluster_stats["monitors"])
+        dashboard_hist["_source"] = "cluster_aggregator (all ranks merged)"
     # flight-recorder plane, snapshotted BEFORE shutdown: a non-zero
     # count here means a FAULT dumped during the run (watchdog trip,
     # peer death, fatal) — a diagnosable anomaly even when every
@@ -1078,6 +1105,8 @@ def main() -> None:
         "dashboard_hist": dashboard_hist,
         "flightrec_dumps": flightrec_dumps,
     }
+    if cluster_stats is not None:
+        extra["cluster"] = cluster_stats
     if _DEGENERATE_DIFFERENTIALS:
         # floored noise-negative slopes (see _differential): the raw pairs
         # stay on the record so a degenerate measurement is visible

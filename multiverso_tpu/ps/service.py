@@ -42,6 +42,10 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from multiverso_tpu.ps import wire
+# module-level like the exporter (no cycle: the aggregator imports this
+# module only lazily, inside functions), so its stats_poll_interval_s
+# flag is registered before any Zoo.start/argv parse reads it
+from multiverso_tpu.telemetry import aggregator as _aggregator
 from multiverso_tpu.telemetry import exporter as _exporter
 from multiverso_tpu.telemetry import flightrec as _flight
 from multiverso_tpu.telemetry import trace as _trace
@@ -499,6 +503,31 @@ def _routable_ip() -> str:
         s.close()
 
 
+def oneshot_probe(addr: str, msg_type: int, timeout: float,
+                  connect_timeout: Optional[float] = None) -> Dict:
+    """One telemetry pull (MSG_HEALTH / MSG_STATS / MSG_PING) over a
+    fresh one-shot connection to ``addr``; returns the reply meta. The
+    shared socket body of :meth:`PSService.health`/``stats_oneshot`` and
+    the address-only consumers (``tools/mvtop.py`` probes straight from
+    a rendezvous directory, no PSService constructed). The connect is
+    budgeted like the reply: a partitioned host (SYN dropped, no RST)
+    must not hold a triage loop for the data plane's 30 s connect
+    timeout. Raises the raw socket/wire errors (callers wrap them in
+    their own peer-health types); an ERR reply raises PSError with the
+    server's message."""
+    host, port = addr.rsplit(":", 1)
+    ct = timeout if connect_timeout is None else min(timeout,
+                                                     connect_timeout)
+    with socket.create_connection((host, int(port)), timeout=ct) as s:
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        s.settimeout(timeout)
+        wire.send(s, msg_type, 0, {})
+        reply_type, _mid, meta, _ = wire.recv(s)
+    if reply_type == MSG_REPLY_ERR:
+        raise PSError(f"probe to {addr}: {meta.get('error', '?')}")
+    return meta
+
+
 class PSService:
     """Listener + shard registry + peer pool for one process."""
 
@@ -569,6 +598,12 @@ class PSService:
         # flag-gated metrics exporter with the rich (shard-aware)
         # payload; no-op unless metrics_dir is set
         _exporter.ensure_started(rank, self.stats_payload)
+        # controller-side cluster observability (flag
+        # stats_poll_interval_s): rank 0 polls every rank's MSG_STATS +
+        # MSG_HEALTH over the one-shot probe path and keeps the merged
+        # cluster time series
+        if rank == 0:
+            _aggregator.ensure_started(self)
         log.debug("PSService rank %d/%d listening on %s", rank, world,
                   self.addr)
 
@@ -808,55 +843,62 @@ class PSService:
         and the probe must return in triage time, not 5 minutes. Raises
         PSPeerError for a dead/unresponsive rank — which IS the 'not
         serving' answer, typed."""
-        if rank == self.rank:
-            return self.health_payload()
-        # address WITHOUT the data-plane peer registry's liveness gate:
-        # _peer() fails fast inside the reconnect-backoff window, which
-        # would report a rank "dead" during exactly the transient the
-        # probe exists to classify — and a health-only caller must not
-        # construct a full persistent peer (socket + recv thread) just
-        # to learn an address. A healthy cached peer donates its addr;
-        # otherwise the rendezvous re-resolves (so a restarted
-        # incarnation's fresh address is honored).
+        return self._oneshot_pull(rank, MSG_HEALTH, timeout)
+
+    def stats_oneshot(self, rank: int,
+                      timeout: Optional[float] = None) -> Dict:
+        """MSG_STATS over the probe path (own one-shot connection,
+        triage-scale timeout) — the cluster aggregator's poll primitive.
+        :meth:`stats` rides the shared data conn and is the right call
+        for a worker consulting a healthy peer; a periodic cluster poll
+        must instead survive exactly the degraded states it exists to
+        observe, so it gets the same isolation as MSG_HEALTH: a wedged
+        data plane (or this rank's own outstanding traffic) can never
+        stall it, and an unanswering rank costs ps_health_timeout, not
+        ps_timeout."""
+        return self._oneshot_pull(rank, MSG_STATS, timeout)
+
+    def _probe_addr(self, rank: int, timeout: float) -> str:
+        """Resolve ``rank``'s address for a one-shot probe, WITHOUT the
+        data-plane peer registry's liveness gate: _peer() fails fast
+        inside the reconnect-backoff window, which would report a rank
+        "dead" during exactly the transient the probe exists to
+        classify — and a probe-only caller must not construct a full
+        persistent peer (socket + recv thread) just to learn an
+        address. A healthy cached peer donates its addr; otherwise the
+        rendezvous re-resolves (so a restarted incarnation's fresh
+        address is honored)."""
         with self._peers_lock:
             peer = self._peers.get(rank)
         if peer is not None and peer._dead is None:
-            addr = peer.addr
-        elif self._rendezvous is not None:
+            return peer.addr
+        if self._rendezvous is not None:
             try:
-                addr = self._rendezvous.lookup(
+                return self._rendezvous.lookup(
                     rank, min(config.get_flag("ps_connect_timeout"),
-                              config.get_flag("ps_health_timeout")))
+                              timeout))
             except PSError:
                 if peer is None:
                     raise
-                addr = peer.addr   # dead peer's last known address
-        elif peer is not None:
-            addr = peer.addr
-        else:
-            raise PSError("no rendezvous configured for remote ranks")
-        host, port = addr.rsplit(":", 1)
+                return peer.addr   # dead peer's last known address
+        if peer is not None:
+            return peer.addr
+        raise PSError("no rendezvous configured for remote ranks")
+
+    def _oneshot_pull(self, rank: int, msg_type: int,
+                      timeout: Optional[float] = None) -> Dict:
+        if rank == self.rank:
+            return (self.health_payload() if msg_type == MSG_HEALTH
+                    else self.stats_payload())
         timeout = timeout or config.get_flag("ps_health_timeout")
+        addr = self._probe_addr(rank, timeout)
         try:
-            # connect is budgeted like the reply: a partitioned host
-            # (SYN dropped, no RST) must not hold the triage loop for
-            # the data plane's 30 s connect timeout
-            with socket.create_connection(
-                    (host, int(port)),
-                    timeout=min(timeout,
-                                config.get_flag("ps_connect_timeout"))
-                    ) as s:
-                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                s.settimeout(timeout)
-                wire.send(s, MSG_HEALTH, 0, {})
-                msg_type, _mid, meta, _ = wire.recv(s)
+            return oneshot_probe(addr, msg_type, timeout,
+                                 config.get_flag("ps_connect_timeout"))
         except (OSError, wire.WireError, TimeoutError) as e:
             raise PSPeerError(
-                f"health probe to rank {rank} at {addr} failed: {e}"
-            ) from e
-        if msg_type == MSG_REPLY_ERR:
-            raise PSError(f"rank {rank}: {meta.get('error', '?')}")
-        return meta
+                f"probe (type 0x{msg_type:X}) to rank {rank} at {addr} "
+                f"failed: {e}") from e
 
     def _wait_handler(self, table: str, timeout: float = 20.0) -> Callable:
         # a worker can race ahead of a peer still constructing its tables
@@ -1193,6 +1235,11 @@ class PSService:
             return False
 
     def close(self) -> None:
+        # the cluster aggregator polls THROUGH this service: stop it
+        # (final short-timeout poll included) while the probe path is
+        # still alive — afterwards a poll would just record every rank
+        # unreachable
+        _aggregator.stop_if_bound(self)
         self._closed = True
         # shutdown, not just close: close() does NOT wake a thread blocked
         # in accept() on Linux — shutdown() makes accept return EINVAL

@@ -46,6 +46,7 @@ from multiverso_tpu.ps import service as svc
 from multiverso_tpu.ps import wire
 from multiverso_tpu.table import _ceil_to
 from multiverso_tpu.telemetry import flightrec as _flight
+from multiverso_tpu.telemetry import hotkeys as _hotkeys
 from multiverso_tpu.tables.matrix_table import _bucket_size
 from multiverso_tpu.telemetry import trace as _trace
 from multiverso_tpu.updaters import AddOption, Updater
@@ -201,6 +202,19 @@ class RowShard:
         self._stat_cow = 0
         self._stat_gets = 0
         self._stat_chunks = 0
+        # wire-traffic byte counters (stats()["get_bytes"/"add_bytes"]):
+        # the cluster aggregator derives wire bytes/s from their deltas.
+        # Benign-race increments, same tolerance as _stat_gets above.
+        self._stat_get_bytes = 0
+        self._stat_add_bytes = 0
+        # heavy-hitter sketch over served GLOBAL row ids (telemetry/
+        # hotkeys.py): always-on like the flight recorder, bounded
+        # memory, O(1) per recorded op. Feeds stats()["hotkeys"] and the
+        # aggregator's cluster top-K + cache-hit-if-cached curve — the
+        # sizing input for a device-resident hot-row cache. Python-plane
+        # only (natively-served ops bypass it, same rule as tracing).
+        cap = _config.get_flag("hotkeys_capacity")
+        self._hotkeys = (_hotkeys.SpaceSaving(cap) if cap > 0 else None)
         # apply latency histogram (the p50/p99 of one updater dispatch)
         self._mon_apply = Dashboard.get(f"ps[{name}].apply")
         # native shard PIN once the native server serves this shard's hot
@@ -322,9 +336,15 @@ class RowShard:
             "get_chunks": self._stat_chunks,
             "cow_applies": self._stat_cow,
             "read_pins": self._cur_pins,
+            # cumulative ENCODED wire bytes served/received (python
+            # plane); the aggregator's wire-bytes/s comes from deltas
+            "get_bytes": self._stat_get_bytes,
+            "add_bytes": self._stat_add_bytes,
         }
         if dirty_rows is not None:
             out["dirty_rows"] = dirty_rows   # sparse-protocol staleness
+        if self._hotkeys is not None:
+            out["hotkeys"] = self._hotkeys.to_dict()
         return out
 
     def queue_depth(self) -> int:
@@ -337,6 +357,14 @@ class RowShard:
     @property
     def scratch(self) -> int:
         return self.n
+
+    def _note_rows(self, local: np.ndarray) -> None:
+        """Feed the heavy-hitter sketch with this op's GLOBAL row ids
+        (shard-local + ``lo``). Called on the get/add serve paths AFTER
+        id validation; HashShard overrides — its inherited call sites
+        carry slot ids, and the sketch wants the workload's keys."""
+        if self._hotkeys is not None:
+            self._hotkeys.observe(local, offset=self.lo)
 
     # ------------------------------------------------------------------ #
     # off-lock read epochs (snapshot serving)
@@ -687,6 +715,7 @@ class RowShard:
         re-encode hop for compressed wires."""
         opt = AddOption(**meta.get("opt", {}))
         local = self._localize_raw(arrays[0])
+        self._note_rows(local)   # one sketch record per add (plain+batch)
         wirem = meta.get("wire", "none")
         if wirem in ("none", "bf16"):   # single blob decodes implicitly
             vals = np.asarray(arrays[1], self.dtype)[: local.size]
@@ -694,6 +723,13 @@ class RowShard:
             vals = wire.decode_payload(arrays[1:], wirem,
                                        (local.size, self.num_col),
                                        self.dtype)
+        # ENCODED payload bytes (the blobs as they crossed the wire —
+        # a 1bit add must not count as 4 bytes/element), per REQUEST
+        # (like _stat_adds counts requests): the coalescing queue
+        # merges K overlapping adds into one deduped apply, and
+        # counting at apply time would underreport by up to Kx
+        self._stat_add_bytes += sum(int(getattr(a, "nbytes", 0))
+                                    for a in arrays[1:])
         return local, vals, opt
 
     def _prep_add_entry(self, meta: Dict, arrays: Sequence[np.ndarray]
@@ -838,6 +874,7 @@ class RowShard:
     def _serve_get_rows(self, meta: Dict, arrays: Sequence[np.ndarray]
                         ) -> Tuple[Dict, Any]:
         local = self._localize_raw(arrays[0])
+        self._note_rows(local)
         tr = meta.get(wire.TRACE_META_KEY) if _trace.enabled() else None
         t0 = time.time() if tr is not None else 0.0
         pin = self._pin_data()
@@ -902,6 +939,10 @@ class RowShard:
             return self._chunked_reply(rows, w, chunk, tr)
         t0 = time.time() if tr is not None else 0.0
         payload = wire.encode_payload(rows, w)
+        # ENCODED reply bytes (what actually crosses the wire — a topk/
+        # 1bit reply is ~16-29x smaller than the gathered f32 rows);
+        # feeds the aggregator's wire-bytes/s honestly
+        self._stat_get_bytes += sum(int(a.nbytes) for a in payload)
         if tr is not None:
             _trace.add_span("shard.get_encode", t0, time.time(), trace=tr,
                             args={"table": self.name, "wire": w})
@@ -927,6 +968,8 @@ class RowShard:
                     cmeta["wire"] = w
                 t0 = time.time() if tr is not None else 0.0
                 payload = wire.encode_payload(rows[a:b], w)
+                shard._stat_get_bytes += sum(int(x.nbytes)
+                                             for x in payload)
                 if tr is not None:
                     _trace.add_span("shard.get_encode", t0, time.time(),
                                     trace=tr,
@@ -966,6 +1009,7 @@ class RowShard:
             # :475-483 GetOption.worker_id + :540-572 stale filter)
             wid = int(meta.get("worker_id", 0))
             local = self._localize_raw(arrays[0])
+            self._note_rows(local)
             with self._lock:
                 if self._dirty is None:
                     raise svc.PSError(
@@ -993,6 +1037,9 @@ class RowShard:
             finally:
                 self._release_data(pin)
             self._stat_gets += 1
+            # sparse replies ship [mask, stale rows] uncompressed: that
+            # pair IS the wire payload
+            self._stat_get_bytes += mask.nbytes + rows.nbytes
             return {}, [mask, rows]
         if msg_type == svc.MSG_GET_ROWS:
             return self._serve_get_rows(meta, arrays)
@@ -1103,6 +1150,17 @@ class HashShard(RowShard):
             out["keys"] = len(self._slot_of)
         return out
 
+    def _note_rows(self, local: np.ndarray) -> None:
+        """No-op: the inherited serve paths reach here with SLOT ids.
+        Hash-shard traffic records through :meth:`_note_keys` at the
+        key-validation sites instead — the sketch must rank the
+        workload's KEYS (DLRM user ids etc.), not slot allocation
+        order."""
+
+    def _note_keys(self, keys: np.ndarray) -> None:
+        if self._hotkeys is not None:
+            self._hotkeys.observe(keys)
+
     def _grow(self, need: int) -> None:
         old_padded = self._padded
         old_rows = old_padded[0]
@@ -1170,8 +1228,12 @@ class HashShard(RowShard):
         translation stays at apply time inside :meth:`_apply_rows`,
         atomic with the update (same rule as the coalescing queue)."""
         keys = self._validate_keys(arrays[0])
+        self._note_keys(keys)
         opt = AddOption(**meta.get("opt", {}))
         vals = np.asarray(arrays[1], self.dtype)[: keys.size]
+        # encoded request blobs, per request — same rule as _prep_add
+        self._stat_add_bytes += sum(int(getattr(a, "nbytes", 0))
+                                    for a in arrays[1:])
         return _PendingAdd(keys, vals, opt,
                            trace=meta.get(wire.TRACE_META_KEY))
 
@@ -1220,6 +1282,7 @@ class HashShard(RowShard):
             # (one lock hold); the gather + encode run off-lock like the
             # range-sharded shard's.
             keys = self._validate_keys(arrays[0])
+            self._note_keys(keys)
             tr = (meta.get(wire.TRACE_META_KEY) if _trace.enabled()
                   else None)
             t0 = time.time() if tr is not None else 0.0
@@ -1233,13 +1296,21 @@ class HashShard(RowShard):
                                 trace=tr, args={"table": self.name,
                                                 "rows": int(keys.size)})
             return self._serve_rows_from_pin(pin, slots, meta, tr)
+        keys = None
+        if msg_type in (svc.MSG_GET_ROWS, svc.MSG_SET_ROWS):
+            # validate + sketch-record OFF the shard lock, like every
+            # other serve path: up to ~0.5 ms of sampled sketch work on
+            # a big sparse key batch must not stall applies behind
+            # telemetry (the reads-block-applies coupling PR 5 removed)
+            keys = self._validate_keys(arrays[0])
+            if msg_type == svc.MSG_GET_ROWS:   # sparse keyed get
+                self._note_keys(keys)
         with self._lock:   # reentrant: key->slot stays atomic w/ the update
             if msg_type == svc.MSG_GET_STATE and meta.get("dump"):
                 return self._dump()
             if msg_type == svc.MSG_SET_STATE and meta.get("dump"):
                 return self._restore(arrays)
-            if msg_type in (svc.MSG_GET_ROWS, svc.MSG_SET_ROWS):
-                keys = self._validate_keys(arrays[0])
+            if keys is not None:
                 slots = self._slots_for(keys)
                 arrays = [slots] + list(arrays[1:])
             return super().handle(msg_type, meta, arrays)

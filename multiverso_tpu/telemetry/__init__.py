@@ -9,7 +9,7 @@ the shutdown report (and any exporter) sees p50/p90/p99/max — tail
 regressions on the batched, compressed PS plane do not hide behind a
 stable mean.
 
-Five cooperating pieces:
+Seven cooperating pieces:
 
 * :mod:`~multiverso_tpu.telemetry.histogram` — the lock-free (caller-
   synchronized) log2-bucket histogram every Monitor embeds.
@@ -29,6 +29,16 @@ Five cooperating pieces:
 * :mod:`~multiverso_tpu.telemetry.watchdog` — per-request slow/stuck
   deadlines over the recorder's in-flight table; its verdict feeds the
   ``MSG_HEALTH`` RPC and ``elastic.Heartbeat`` beacons.
+* :mod:`~multiverso_tpu.telemetry.hotkeys` — the always-on, bounded-
+  memory Space-Saving heavy-hitter sketch each shard keeps over its
+  served row ids; feeds ``stats()["hotkeys"]`` and the cluster top-K +
+  cache-hit-if-cached curve.
+* :mod:`~multiverso_tpu.telemetry.aggregator` — the controller-side
+  cluster plane: flag-gated (``stats_poll_interval_s``) polling of
+  every rank's MSG_STATS + MSG_HEALTH over one-shot probe connections,
+  exact histogram merge, shard-skew + rate derivation, and the rolling
+  ``cluster.jsonl``/``cluster.prom`` series ``tools/mvtop.py`` renders
+  live.
 
 See docs/OBSERVABILITY.md for the end-to-end story (including the
 MSG_STATS / MSG_HEALTH RPCs in ``ps/service.py``).

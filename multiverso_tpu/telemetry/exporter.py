@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import threading
 import time
 from typing import Callable, Dict, Optional
@@ -46,6 +47,25 @@ def _prom_name(name: str) -> str:
     return name.replace('"', "'").replace("\\", "/")
 
 
+# monitor names of the forms ``table[X].op`` / ``ps[X].op`` carry the
+# table identity inside the name; surface it as a first-class label
+_NAME_TABLE_RE = re.compile(r"^(?:table|ps)\[([^\]]*)\]")
+
+
+def _monitor_labels(name: str, rank) -> str:
+    """Label set for one monitor line: ``name`` always, plus a ``table``
+    label when the name embeds one, plus ``rank`` — so ONE scrape config
+    covers an N-rank run (and the aggregator's rank="cluster" output)
+    with aggregation by (table, rank) instead of regex-parsing names or
+    output filenames."""
+    parts = [f'name="{_prom_name(name)}"']
+    m = _NAME_TABLE_RE.match(name)
+    if m:
+        parts.append(f'table="{_prom_name(m.group(1))}"')
+    parts.append(f'rank="{rank}"')
+    return "{" + ",".join(parts) + "}"
+
+
 def prometheus_text(payload: Dict) -> str:
     """Render a stats payload (exporter record / MSG_STATS reply meta)
     as Prometheus text exposition."""
@@ -60,7 +80,7 @@ def prometheus_text(payload: Dict) -> str:
     rank = payload.get("rank", 0)
     for name in sorted(payload.get("monitors", {})):
         m = payload["monitors"][name]
-        lbl = f'{{name="{_prom_name(name)}",rank="{rank}"}}'
+        lbl = _monitor_labels(name, rank)
         lines.append(f"mv_monitor_count{lbl} {m.get('count', 0)}")
         lines.append(f"mv_monitor_total_ms{lbl} {m.get('sum_ms', 0.0)}")
         # percentile gauges only for monitors with TIMED samples: an
@@ -158,13 +178,19 @@ _global_lock = threading.Lock()
 
 def default_stats_fn() -> Dict:
     """Dashboard-only payload for processes without a PSService (the
-    service installs a richer one that adds its shard registry)."""
+    service installs a richer one that adds its shard registry).
+    ``pid`` identifies the OS process: Dashboard monitors are
+    PROCESS-global, so a cluster merge over in-process multi-rank
+    worlds (test fixtures, bench workers) must pool each process's
+    monitors once, not once per rank (aggregator.merge_cluster keys on
+    the addr host + pid)."""
     from multiverso_tpu.utils.dashboard import Dashboard
     return {
         "monitors": {name: snap.hist_dict()
                      for name, snap in Dashboard.snapshot().items()},
         "notes": Dashboard.notes(),
         "shards": {},
+        "pid": os.getpid(),
     }
 
 

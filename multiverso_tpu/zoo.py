@@ -122,9 +122,14 @@ class Zoo:
         # final telemetry flush while the monitors still hold this run's
         # numbers (the exporter's stop() writes a last snapshot; buffered
         # trace spans drain to metrics_dir)
+        from multiverso_tpu.telemetry import aggregator as _aggregator
         from multiverso_tpu.telemetry import exporter as _exporter
         from multiverso_tpu.telemetry import flightrec as _flightrec
         from multiverso_tpu.telemetry import trace as _trace
+        # cluster aggregator first (final poll needs the PS service,
+        # which reset_default_context below tears down), then the
+        # per-rank exporter
+        _aggregator.stop_global()
         _exporter.stop_global()
         # final black-box dump (no-op unless a dump directory resolves):
         # a run that hung AFTER stop began still leaves its last tape.

@@ -81,10 +81,14 @@ def _fresh_runtime():
     Dashboard.reset()
     # telemetry plane: a test that enabled tracing/export must not leak
     # spans or a running exporter thread into its neighbors
+    from multiverso_tpu.telemetry import aggregator as _aggregator
     from multiverso_tpu.telemetry import exporter as _exporter
     from multiverso_tpu.telemetry import flightrec as _flightrec
     from multiverso_tpu.telemetry import trace as _trace
     from multiverso_tpu.telemetry import watchdog as _watchdog
+    # no final poll: the service a leaked aggregator is bound to may be
+    # gone, and teardown must not wait out probe timeouts
+    _aggregator.stop_global(final=False)
     _exporter.stop_global()
     _trace.TRACER.reset()
     _trace.TRACER.enabled = False

@@ -237,3 +237,28 @@ def test_run_bench_regression_flagging():
     assert flag_regressions({"extra": {}}, worse) == []
     assert flag_regressions(
         prev, {"extra": {"get_rows_plane": {"error": "boom"}}}) == []
+
+
+def test_run_bench_flags_skew_growth():
+    """ISSUE 6 satellite: when both records carry a cluster snapshot
+    (the stats aggregator ran), >2x run-over-run shard-skew growth is
+    FLAGGED (never fails the run); missing/partial cluster data is
+    skipped like any other absent key. Worker-level cluster blocks
+    (e.g. small_add_send_window.cluster) are scanned too."""
+    from tools.run_bench import flag_regressions
+
+    def rec(skew, nested=False):
+        cluster = {"tables": {"we": {"adds": 10, "skew": skew}}}
+        extra = ({"small_add_send_window": {"cluster": cluster}}
+                 if nested else {"cluster": cluster})
+        return {"extra": extra}
+
+    assert flag_regressions(rec(1.1), rec(1.9)) == []       # 1.7x: fine
+    flags = flag_regressions(rec(1.1), rec(2.5))            # 2.3x
+    assert len(flags) == 1 and "table[we] shard skew" in flags[0]
+    # nested worker-level cluster blocks count as well
+    flags = flag_regressions(rec(1.1, nested=True), rec(2.5, nested=True))
+    assert len(flags) == 1 and "shard skew" in flags[0]
+    # one side missing the cluster record: skipped, never flagged
+    assert flag_regressions({"extra": {}}, rec(9.0)) == []
+    assert flag_regressions(rec(1.0), {"extra": {}}) == []

@@ -37,7 +37,16 @@ def main():
     from multiverso_tpu.ps.service import (FileRendezvous, PSContext,
                                            PSService)
     from multiverso_tpu.ps.tables import AsyncMatrixTable
+    from multiverso_tpu.telemetry import aggregator
+    from multiverso_tpu.utils import config
     from multiverso_tpu.utils.dashboard import Dashboard
+
+    # ISSUE 6 acceptance config: the cluster aggregator polls BOTH ranks'
+    # MSG_STATS + MSG_HEALTH at 1 Hz over one-shot probe conns, and the
+    # hot-key sketch records every served op (default-on) — the band
+    # assertion below then proves the whole cluster-observability plane
+    # is free at the PR-2 latency floor
+    config.set_flag("stats_poll_interval_s", 1.0)
 
     rows, cols = 1024, 32
     rng = np.random.default_rng(5)
@@ -92,8 +101,20 @@ def main():
 
         # best-of-2, the repo's bench protocol for this box (single-shot
         # socket+GIL noise is ~±25%; see bench_async_ps's note) — both
-        # passes stay on the record
-        passes = [one_pass(), one_pass()]
+        # passes stay on the record. The aggregator MUST be live (that's
+        # the acceptance config, not an optional extra), and a full
+        # cluster poll is forced between the passes: the short timed
+        # loops can finish inside the first 1 Hz background wakeup, and
+        # the band below must be measured with polling provably
+        # interleaved, not merely enabled.
+        agg = aggregator.global_aggregator()
+        if agg is None:
+            raise AssertionError(
+                "stats aggregator did not start: the band below would "
+                "be measured without the cluster-observability load")
+        passes = [one_pass()]
+        agg.poll_once()
+        passes.append(one_pass())
         best = max(passes, key=lambda p: p["speedup"] or 0.0)
 
         # every pass fed both tables the same logical stream, so parity
@@ -125,13 +146,18 @@ def main():
         hist = {arm: Dashboard.get(f"table[{arm}].add_rows")
                 .snapshot().brief_dict()
                 for arm in ("sa_on", "sa_off")}
+        # cluster record: the final poll carries the merged 2-rank shard
+        # stats, skew, and the hot-row sketch heads into the record
+        cluster = aggregator.compact_record(agg.poll_once())
+        cluster["polls"] = len(agg.history())
         for c in ctxs:
             c.close()
 
     print("RESULT " + json.dumps(dict(
         best, iters=iters, passes=passes, window_counters=mon,
         latency_hist=hist, parity_bit_for_bit=parity,
-        flightrec_band_ms=list(flightrec_band))), flush=True)
+        flightrec_band_ms=list(flightrec_band),
+        cluster=cluster)), flush=True)
 
 
 if __name__ == "__main__":

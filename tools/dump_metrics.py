@@ -18,6 +18,13 @@ screen. ``to-perfetto`` wraps a JSONL trace-event file into the
 ``{"traceEvents": [...]}`` envelope the Perfetto UI / chrome://tracing
 expect (events from several ranks' files may be concatenated first; the
 spans carry ``pid`` = rank).
+
+Both commands also accept the cluster aggregator's time series
+(``cluster.jsonl``, records with ``kind: "cluster"`` — see
+``telemetry/aggregator.py``): ``show`` adds the per-rank health block,
+per-table cluster totals/rates/skew, and the hot-key table; ``diff`` of
+two cluster records prints per-table RATE and SKEW deltas between the
+two runs alongside the merged-monitor comparison.
 """
 
 from __future__ import annotations
@@ -48,28 +55,39 @@ def _fmt(v: float) -> str:
     return f"{v:>9.3f}"
 
 
+def _monitor_table(mons: Dict) -> List[str]:
+    """The monitor table lines (shared by per-rank and cluster shows)."""
+    lines = [f"{'monitor':<44} {'count':>8} {'mean':>9} "
+             f"{'p50':>9} {'p90':>9} {'p99':>9} {'max':>9}"]
+    for name in sorted(mons):
+        m = mons[name]
+        count = m.get("count", 0)
+        mean = m.get("sum_ms", 0.0) / count if count else 0.0
+        row = f"{name:<44} {count:>8}"
+        if m.get("timed", m.get("count")):
+            row += (f" {_fmt(mean)} {_fmt(m.get('p50_ms', 0))}"
+                    f" {_fmt(m.get('p90_ms', 0))}"
+                    f" {_fmt(m.get('p99_ms', 0))}"
+                    f" {_fmt(m.get('max_ms', 0))}")
+        lines.append(row)
+    return lines
+
+
 def format_record(rec: Dict) -> str:
-    """One record -> the human table (pure function; tested directly)."""
+    """One record -> the human table (pure function; tested directly).
+    Cluster records (``kind: "cluster"``) dispatch to
+    :func:`format_cluster_record`."""
+    if rec.get("kind") == "cluster":
+        return format_cluster_record(rec)
     lines = [f"rank {rec.get('rank', '?')}  ts {rec.get('ts', '?')}  "
              f"addr {rec.get('addr', '-')}"]
     mons = rec.get("monitors", {})
     if mons:
-        lines.append(f"{'monitor':<44} {'count':>8} {'mean':>9} "
-                     f"{'p50':>9} {'p90':>9} {'p99':>9} {'max':>9}")
-        for name in sorted(mons):
-            m = mons[name]
-            count = m.get("count", 0)
-            mean = m.get("sum_ms", 0.0) / count if count else 0.0
-            row = f"{name:<44} {count:>8}"
-            if m.get("timed", m.get("count")):
-                row += (f" {_fmt(mean)} {_fmt(m.get('p50_ms', 0))}"
-                        f" {_fmt(m.get('p90_ms', 0))}"
-                        f" {_fmt(m.get('p99_ms', 0))}"
-                        f" {_fmt(m.get('max_ms', 0))}")
-            lines.append(row)
+        lines.extend(_monitor_table(mons))
     for table in sorted(rec.get("shards", {})):
         s = dict(rec["shards"][table])
         apply_h = s.pop("apply", None)
+        hot = s.pop("hotkeys", None)
         lines.append(f"shard[{table}]: " + ", ".join(
             f"{k}={v}" for k, v in sorted(s.items())))
         if apply_h and apply_h.get("count"):
@@ -77,14 +95,95 @@ def format_record(rec: Dict) -> str:
                 f"  apply: count={apply_h['count']} "
                 f"p50={apply_h['p50_ms']:.3f} p99={apply_h['p99_ms']:.3f} "
                 f"max={apply_h['max_ms']:.3f} ms")
+        if hot and hot.get("items"):
+            head = "  ".join(f"{k}:{c}" for k, c, _ in hot["items"][:8])
+            lines.append(f"  hot rows (of {hot.get('total', 0)}): {head}")
     for name in sorted(rec.get("notes", {})):
         lines.append(f"note[{name}] {rec['notes'][name]}")
     return "\n".join(lines)
 
 
+def format_cluster_record(rec: Dict) -> str:
+    """One aggregator record -> per-rank health, per-table totals/rates/
+    skew, hot keys, and the merged-monitor table."""
+    lines = [f"cluster  ts {rec.get('ts', '?')}  world "
+             f"{rec.get('world', '?')}  stats from {rec.get('polled', 0)}"]
+    for r in sorted(rec.get("ranks", {}), key=int):
+        e = rec["ranks"][r]
+        lines.append(f"rank {r}: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(e.items()) if v is not None))
+    rates = rec.get("rates", {})
+    for tname in sorted(rec.get("tables", {})):
+        t = dict(rec["tables"][tname])
+        apply_h = t.pop("apply", None)
+        t.pop("shards", None)
+        lines.append(f"table[{tname}]: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(t.items())))
+        tr = rates.get(tname)
+        if tr:
+            lines.append("  rates: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(tr.items())))
+        if apply_h and apply_h.get("count"):
+            lines.append(
+                f"  apply(merged): count={apply_h['count']} "
+                f"p50={apply_h['p50_ms']:.3f} p99={apply_h['p99_ms']:.3f} "
+                f"max={apply_h['max_ms']:.3f} ms")
+    for tname in sorted(rec.get("hotkeys", {})):
+        h = rec["hotkeys"][tname]
+        head = "  ".join(f"{k}:{c}" for k, c, _ in h.get("top", [])[:8])
+        lines.append(f"hot[{tname}] total={h.get('total', 0)} top: {head}")
+        curve = h.get("hit_rate_curve") or []
+        if curve:
+            lines.append("  cache-hit-if-cached: " + "  ".join(
+                f"top{k}={r * 100:.0f}%" for k, r in curve))
+    mons = rec.get("monitors", {})
+    if mons:
+        lines.extend(_monitor_table(mons))
+    return "\n".join(lines)
+
+
+def diff_cluster_records(a: Dict, b: Dict) -> str:
+    """Two cluster records (typically the last record of two runs'
+    ``cluster.jsonl``) -> per-table rate and skew deltas, then the
+    merged-monitor comparison."""
+    at, bt = a.get("tables", {}), b.get("tables", {})
+    ar, br = a.get("rates", {}), b.get("rates", {})
+    names = sorted(set(at) | set(bt))
+    lines = [f"{'table':<24} {'adds a':>10} {'adds b':>10} "
+             f"{'gets a':>10} {'gets b':>10} {'skew a':>7} {'skew b':>7} "
+             f"{'skew b/a':>8}"]
+    for name in names:
+        ta, tb = at.get(name), bt.get(name)
+        if ta is None or tb is None:
+            lines.append(f"{name:<24} {'only ' + ('b' if ta is None else 'a')}")
+            continue
+        sa, sb = ta.get("skew"), tb.get("skew")
+        ratio = (f"{sb / sa:>8.2f}" if sa and sb else f"{'-':>8}")
+        lines.append(f"{name:<24} {ta.get('adds', 0):>10} "
+                     f"{tb.get('adds', 0):>10} {ta.get('gets', 0):>10} "
+                     f"{tb.get('gets', 0):>10} {sa or 0:>7.2f} "
+                     f"{sb or 0:>7.2f} {ratio}")
+        ra, rb = ar.get(name), br.get(name)
+        if ra and rb:
+            deltas = []
+            for k in ("adds_per_s", "gets_per_s", "applies_per_s",
+                      "wire_bytes_per_s", "skew_window"):
+                if k in ra or k in rb:
+                    deltas.append(f"{k}: {ra.get(k, 0)} -> {rb.get(k, 0)}")
+            if deltas:
+                lines.append("  " + ", ".join(deltas))
+    lines.append("")
+    lines.append(diff_records({"monitors": a.get("monitors", {})},
+                              {"monitors": b.get("monitors", {})}))
+    return "\n".join(lines)
+
+
 def diff_records(a: Dict, b: Dict) -> str:
     """Align two records by monitor name; report count delta and
-    p50/p99 ratios (b relative to a — >1 means b is slower)."""
+    p50/p99 ratios (b relative to a — >1 means b is slower). Two
+    cluster records dispatch to :func:`diff_cluster_records`."""
+    if a.get("kind") == "cluster" and b.get("kind") == "cluster":
+        return diff_cluster_records(a, b)
     am, bm = a.get("monitors", {}), b.get("monitors", {})
     names = sorted(set(am) | set(bm))
     lines = [f"{'monitor':<44} {'count a':>8} {'count b':>8} "

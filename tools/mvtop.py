@@ -1,0 +1,237 @@
+#!/usr/bin/env python
+"""mvtop — one pane of glass over a live async-PS cluster.
+
+    python tools/mvtop.py --rdv RDV_DIR [--world N] --once [--json]
+    python tools/mvtop.py --rdv RDV_DIR --watch [SECONDS]
+
+Reads rank addresses from the file-rendezvous directory (``<rank>.addr``,
+the same files the PS plane itself rendezvouses through), probes each
+rank's MSG_HEALTH + MSG_STATS over **one-shot connections** (the PR-4
+probe path: answers even when a rank's data plane is wedged, bounded by
+``ps_health_timeout``-scale waits), merges the payloads through
+``telemetry/aggregator.py`` (exact histogram merge, shard skew, hot-key
+top-K), and renders:
+
+* per-rank health verdicts (ok/slow/stuck/unreachable, queue depth,
+  oldest in-flight op age);
+* per-table cluster totals and — in ``--watch`` mode, from consecutive
+  polls — rates (adds/s, gets/s, wire MB/s), queue-depth deltas, and
+  the windowed shard skew;
+* merged p50/p99 latency percentiles for the serve/apply planes;
+* the cluster hot-key table with the estimated
+  cache-hit-rate-if-cached curve.
+
+``--once`` prints a single snapshot and exits 0 when at least one rank
+answered (scripts/tests); ``--watch`` refreshes in place until ^C.
+``--json`` emits the raw merged cluster record instead of the table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def read_addrs(rdv_dir: str,
+               world: Optional[int] = None) -> Dict[int, str]:
+    """rank -> published address from a file-rendezvous directory
+    (``world`` limits the scan; default: every ``<rank>.addr`` found)."""
+    out: Dict[int, str] = {}
+    try:
+        names = os.listdir(rdv_dir)
+    except OSError:
+        return out
+    for n in names:
+        if not n.endswith(".addr") or n.startswith("."):
+            continue
+        stem = n[: -len(".addr")]
+        if not stem.isdigit():
+            continue
+        rank = int(stem)
+        if world is not None and rank >= world:
+            continue
+        try:
+            with open(os.path.join(rdv_dir, n)) as f:
+                addr = f.read().strip()
+        except OSError:
+            continue
+        if addr:
+            out[rank] = addr
+    return out
+
+
+def poll(addrs: Dict[int, str], timeout: float = 2.0) -> Dict:
+    """Probe every rank once (one-shot conns, CONCURRENT — failures and
+    deadline overruns become per-rank entries) and return the merged
+    cluster record. One poll is bounded by ~2 probe timeouts total, not
+    per dead rank: a --watch refresh against a half-down cluster must
+    not stall world x 2 timeouts."""
+    from multiverso_tpu.ps import service as svc
+    from multiverso_tpu.telemetry import aggregator
+
+    def probe_one(r, stats, health):
+        addr = addrs[r]
+        try:
+            health[r] = svc.oneshot_probe(addr, svc.MSG_HEALTH, timeout)
+        except Exception as e:  # noqa: BLE001 — per-rank entry
+            health[r] = e
+        try:
+            stats[r] = svc.oneshot_probe(addr, svc.MSG_STATS, timeout)
+        except Exception as e:  # noqa: BLE001
+            stats[r] = e
+
+    stats, health = aggregator.probe_all(sorted(addrs), probe_one,
+                                         deadline_s=2.0 * timeout + 1.0)
+    return aggregator.merge_cluster(stats, health, world=len(addrs))
+
+
+def _fmt(v, nd: int = 3) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def _mb(v) -> str:
+    return f"{(v or 0) / 1e6:.2f} MB/s"
+
+
+def render(rec: Dict, prev: Optional[Dict] = None,
+           topk: int = 8) -> str:
+    """Cluster record -> the operator screen (pure; tested directly).
+    ``prev`` (the previous poll) turns counters into rates."""
+    from multiverso_tpu.telemetry import aggregator
+    if prev is not None and "rates" not in rec:
+        aggregator.derive_rates(prev, rec)
+    up = sum(1 for e in rec.get("ranks", {}).values()
+             if e.get("status") not in (None, "unreachable"))
+    lines = [f"mvtop  {time.strftime('%H:%M:%S', time.localtime(rec.get('ts', 0)))}"
+             f"  ranks {up}/{rec.get('world', '?')} up"
+             f"  (stats from {rec.get('polled', 0)})"]
+    lines.append(f"{'rank':<5} {'status':<12} {'addr':<22} {'queue':>6} "
+                 f"{'infl':>5} {'oldest_s':>9} {'serve_age':>10}")
+    for r in sorted(rec.get("ranks", {}), key=int):
+        e = rec["ranks"][r]
+        status = e.get("status", "?")
+        if e.get("stats_error"):
+            status += "*"       # health answered, stats did not
+        lines.append(
+            f"{r:<5} {status:<12} {_fmt(e.get('addr')):<22} "
+            f"{_fmt(e.get('queue_depth')):>6} {_fmt(e.get('inflight')):>5} "
+            f"{_fmt(e.get('oldest_inflight_s')):>9} "
+            f"{_fmt(e.get('serve_age_s')):>10}")
+        if e.get("error"):
+            lines.append(f"      {e['error']}")
+    mons = rec.get("monitors", {})
+    rates = rec.get("rates", {})
+    for tname in sorted(rec.get("tables", {})):
+        t = rec["tables"][tname]
+        lines.append("")
+        lines.append(f"table[{tname}]  shards={len(t.get('shards', {}))}"
+                     f"  skew={_fmt(t.get('skew'))}"
+                     f"  queue={t.get('queue_depth', 0)}")
+        tr = rates.get(tname)
+        if tr:
+            lines.append(
+                f"  rates: adds {tr['adds_per_s']}/s  gets "
+                f"{tr['gets_per_s']}/s  applies {tr['applies_per_s']}/s  "
+                f"wire {_mb(tr['wire_bytes_per_s'])}  "
+                f"queue Δ{tr['queue_depth_delta']}"
+                + (f"  skew(window) {tr['skew_window']}"
+                   if "skew_window" in tr else ""))
+        lines.append(f"  totals: adds {t.get('adds', 0)}  gets "
+                     f"{t.get('gets', 0)}  applies {t.get('applies', 0)}  "
+                     f"wire {((t.get('add_bytes', 0) or 0) + (t.get('get_bytes', 0) or 0)) / 1e6:.2f} MB")
+        # merged latency percentiles: shard apply + the serve monitor
+        a = t.get("apply") or {}
+        parts = []
+        if a.get("timed"):
+            parts.append(f"apply p50 {_fmt(a.get('p50_ms'))} "
+                         f"p99 {_fmt(a.get('p99_ms'))} ms")
+        srv = mons.get(f"ps[{tname}].serve")
+        if srv and srv.get("timed"):
+            parts.append(f"serve p50 {_fmt(srv.get('p50_ms'))} "
+                         f"p99 {_fmt(srv.get('p99_ms'))} ms")
+        if parts:
+            lines.append("  " + "  |  ".join(parts))
+        hk = rec.get("hotkeys", {}).get(tname)
+        if hk and hk.get("top"):
+            head = "  ".join(f"{k}:{c}" for k, c, _ in hk["top"][:topk])
+            lines.append(f"  hot rows (of {hk.get('total', 0)} sketched): "
+                         f"{head}")
+            curve = hk.get("hit_rate_curve") or []
+            if curve:
+                lines.append("  cache-hit-if-cached: " + "  ".join(
+                    f"top{k}={r * 100:.0f}%" for k, r in curve))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mvtop", description="live async-PS cluster view")
+    ap.add_argument("--rdv", required=True,
+                    help="file-rendezvous directory (<rank>.addr files)")
+    ap.add_argument("--world", type=int, default=None,
+                    help="rank count (default: every published addr)")
+    ap.add_argument("--once", action="store_true",
+                    help="one snapshot, then exit (scripts/tests)")
+    ap.add_argument("--watch", type=float, nargs="?", const=2.0,
+                    default=None, metavar="SECONDS",
+                    help="refresh every SECONDS (default 2) until ^C")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the raw merged cluster record")
+    ap.add_argument("--timeout", type=float, default=2.0,
+                    help="per-rank probe timeout seconds")
+    ap.add_argument("--topk", type=int, default=8,
+                    help="hot keys shown per table")
+    args = ap.parse_args(argv)
+
+    addrs = read_addrs(args.rdv, args.world)
+    if not addrs:
+        print(f"mvtop: no <rank>.addr files under {args.rdv}",
+              file=sys.stderr)
+        return 2
+    if args.once or args.watch is None:
+        rec = poll(addrs, args.timeout)
+        print(json.dumps(rec) if args.json
+              else render(rec, topk=args.topk))
+        up = sum(1 for e in rec.get("ranks", {}).values()
+                 if e.get("status") not in (None, "unreachable"))
+        return 0 if up else 1
+    prev = None
+    try:
+        while True:
+            addrs = read_addrs(args.rdv, args.world) or addrs
+            rec = poll(addrs, args.timeout)
+            # rates belong to the RECORD, not the renderer: --json
+            # consumers get the same consecutive-poll rates block the
+            # table view shows
+            if prev is not None:
+                from multiverso_tpu.telemetry import aggregator
+                aggregator.derive_rates(prev, rec)
+            if args.json:
+                # machine-readable stream: one record per line, no
+                # screen-clear escapes corrupting the JSON
+                out = json.dumps(rec)
+                sys.stdout.write(out + "\n")
+            else:
+                sys.stdout.write("\x1b[2J\x1b[H"
+                                 + render(rec, topk=args.topk) + "\n")
+            sys.stdout.flush()
+            prev = rec
+            time.sleep(args.watch)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

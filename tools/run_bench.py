@@ -85,13 +85,35 @@ def _extra_value(headline, path):
     return node if isinstance(node, (int, float)) else None
 
 
+def _cluster_skews(headline):
+    """Per-table shard-skew values out of a headline's ``extra.cluster``
+    record (written when the stats aggregator ran during the bench;
+    both the bench main record and worker-reported ``cluster`` blocks
+    use the same compact shape)."""
+    out = {}
+    nodes = [(headline or {}).get("extra", {}).get("cluster")]
+    # worker-level cluster blocks (e.g. small_add_send_window.cluster)
+    for sub in (headline or {}).get("extra", {}).values():
+        if isinstance(sub, dict) and isinstance(sub.get("cluster"), dict):
+            nodes.append(sub["cluster"])
+    for node in nodes:
+        if not isinstance(node, dict):
+            continue
+        for t, d in (node.get("tables") or {}).items():
+            s = d.get("skew") if isinstance(d, dict) else None
+            if isinstance(s, (int, float)) and not isinstance(s, bool):
+                out[t] = s
+    return out
+
+
 def flag_regressions(prev_headline, new_headline, factor: float = 2.0):
-    """Compare this run's recorded get/small-add latencies against the
-    PREVIOUS recorded bench file: anything more than ``factor``x slower
+    """Compare this run's recorded get/small-add latencies — and, when
+    the cluster aggregator ran, per-table shard skew — against the
+    PREVIOUS recorded bench file: anything more than ``factor``x worse
     is FLAGGED (returned as human-readable strings), never failed — the
     box's weather varies, and the flag exists so the next session sees
     the band moved, not to veto a run. Keys missing on either side
-    (older record, errored sub-bench) are skipped."""
+    (older record, errored sub-bench, no aggregator) are skipped."""
     out = []
     for path, label in _REGRESSION_KEYS:
         old = _extra_value(prev_headline, path)
@@ -101,6 +123,16 @@ def flag_regressions(prev_headline, new_headline, factor: float = 2.0):
         if new > factor * old:
             out.append(f"{label}: {new} vs {old} previously "
                        f"({new / old:.1f}x, flag threshold {factor}x)")
+    # shard-skew growth: a scale-out run whose row traffic collapsed
+    # onto one shard is a regression even when every latency held
+    old_skews, new_skews = (_cluster_skews(prev_headline),
+                            _cluster_skews(new_headline))
+    for t in sorted(set(old_skews) & set(new_skews)):
+        old, new = old_skews[t], new_skews[t]
+        if old > 0 and new > factor * old:
+            out.append(f"table[{t}] shard skew: {new} vs {old} "
+                       f"previously ({new / old:.1f}x, flag threshold "
+                       f"{factor}x)")
     return out
 
 
