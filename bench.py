@@ -481,6 +481,16 @@ def bench_get_rows_plane(iters: int = 300):
     return _run_result_worker("bench_get_rows.py", [iters])
 
 
+def bench_chaos_failover(seconds: float = 16.0):
+    """Elastic-failover chaos bench (ISSUE 7 acceptance): 2 server
+    shards under sustained windowed add/get traffic, SIGKILL one, and
+    record recovery-time-to-90%-throughput plus the exactly-once
+    ledger (ops lost / double-applied, final state bit-for-bit vs the
+    acked-op oracle). The tool exits nonzero — failing this sub-bench
+    — if any acked op was lost or double-applied."""
+    return _run_result_worker("bench_chaos.py", [seconds], timeout=420)
+
+
 def bench_array_table_nontunnel(size: int = 1_000_000, iters: int = 10):
     """The BASELINE ArrayTable metric WITHOUT the tunneled device link:
     same code on the in-process CPU backend (subprocess so the parent's
@@ -1039,6 +1049,10 @@ def main() -> None:
         get_rows_stats = bench_get_rows_plane()
     except Exception as e:
         get_rows_stats = {"error": f"{type(e).__name__}: {e}"[:200]}
+    try:
+        chaos_stats = bench_chaos_failover()
+    except Exception as e:
+        chaos_stats = {"error": f"{type(e).__name__}: {e}"[:200]}
     # telemetry-plane record: latency HISTOGRAMS of every monitored op
     # this process ran (shutdown resets the dashboard, so snapshot now)
     try:
@@ -1102,6 +1116,7 @@ def main() -> None:
         "lm_decode_b8_d256_L4": decode_stats,
         "small_add_send_window": small_add_stats,
         "get_rows_plane": get_rows_stats,
+        "chaos": chaos_stats,
         "dashboard_hist": dashboard_hist,
         "flightrec_dumps": flightrec_dumps,
     }

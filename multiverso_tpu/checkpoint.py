@@ -21,6 +21,7 @@ import os
 from typing import Dict, Optional
 
 import jax
+import numpy as np
 
 from multiverso_tpu.io.stream import open_stream
 from multiverso_tpu.utils import log
@@ -37,6 +38,32 @@ def _join(base: str, *parts: str) -> str:
 
 def is_local(path: str) -> bool:
     return "://" not in path or path.startswith("file://")
+
+
+# Commit marker written LAST into every checkpoint directory: a tag
+# whose manifest exists but whose marker does not is a torn/partial
+# write (the writer died mid-checkpoint) and is invisible to latest()
+# and rejected by restore() — a resume must never load half a save.
+COMMIT_MARKER = "COMMIT"
+
+
+def _write_commit(path: str) -> None:
+    with open_stream(_join(path, COMMIT_MARKER), "wb") as s:
+        s.write(b"1")
+
+
+def is_committed(path: str) -> bool:
+    """True when ``path`` holds a COMPLETE checkpoint (the commit
+    marker was written after everything else)."""
+    if is_local(path):
+        local = path[len("file://"):] if path.startswith("file://") else path
+        return os.path.exists(os.path.join(local, COMMIT_MARKER))
+    try:
+        with open_stream(_join(path, COMMIT_MARKER), "rb") as s:
+            s.read(1)
+        return True
+    except Exception:   # noqa: BLE001 — missing remote marker
+        return False
 
 
 def _manifest_entry(table) -> Dict:
@@ -100,9 +127,11 @@ def save(directory: str, tag: str = "checkpoint",
             _manifest_entry(table), file=fname)
     if zoo.rank() == 0:
         # manifest rides the same URI-dispatched stream layer as the table
-        # payloads, so gs:// checkpoints stay in one storage system
+        # payloads, so gs:// checkpoints stay in one storage system; the
+        # commit marker lands LAST — readers ignore marker-less tags
         with open_stream(_join(path, "manifest.json"), "wb") as s:
             s.write(json.dumps(manifest, indent=2).encode())
+        _write_commit(path)
         log.info("checkpoint saved: %s (%d tables)", path,
                  len(manifest["tables"]))
     zoo.barrier()
@@ -119,6 +148,11 @@ def restore(directory: str, tag: str = "checkpoint") -> int:
     wait_pending()  # finalize any in-flight async save first
     zoo = Zoo.get()
     path = _join(directory, tag)
+    if not is_committed(path):
+        raise ValueError(
+            f"checkpoint {path} has no commit marker — the save was "
+            "torn/partial (writer died mid-checkpoint); restore the "
+            "previous committed tag instead")
     with open_stream(_join(path, "manifest.json"), "rb") as s:
         manifest = json.loads(s.read().decode())
     if manifest.get("backend") == "orbax":
@@ -207,6 +241,7 @@ def wait_pending() -> int:
         if zoo.rank() == 0:
             with open_stream(_join(path, "manifest.json"), "wb") as s:
                 s.write(json.dumps(manifest, indent=2).encode())
+            _write_commit(path)
             log.info("checkpoint finalized (orbax async): %s", path)
         done += 1
     _pending = []
@@ -250,6 +285,7 @@ def _save_orbax(directory: str, tag: str, block: bool = True) -> str:
     if zoo.rank() == 0:
         with open_stream(_join(path, "manifest.json"), "wb") as s:
             s.write(json.dumps(manifest, indent=2).encode())
+        _write_commit(path)
         log.info("checkpoint saved (orbax): %s (%d tables)", path,
                  len(manifest["tables"]))
     zoo.barrier()
@@ -298,16 +334,195 @@ def _restore_orbax(path: str, manifest: Dict) -> int:
 
 
 def latest(directory: str) -> Optional[str]:
-    """Most recent tag under ``directory`` (by manifest mtime).
-    Local filesystems only — remote URIs return None (no listing API in the
-    gated stream layer)."""
+    """Most recent COMMITTED tag under ``directory`` (by manifest
+    mtime). Tags without the commit marker — torn/partial saves whose
+    writer died mid-checkpoint — are invisible: a resume silently falls
+    back to the previous complete save instead of loading half of one.
+    Local filesystems only — remote URIs return None (no listing API in
+    the gated stream layer)."""
     if not is_local(directory) or not os.path.isdir(directory):
         return None
     best, best_mtime = None, -1.0
+    skipped = []
     for tag in os.listdir(directory):
-        m = os.path.join(directory, tag, "manifest.json")
-        if os.path.exists(m):
-            mt = os.path.getmtime(m)
-            if mt > best_mtime:
-                best, best_mtime = tag, mt
+        base = os.path.join(directory, tag)
+        m = os.path.join(base, "manifest.json")
+        if not os.path.exists(m):
+            continue
+        if not os.path.exists(os.path.join(base, COMMIT_MARKER)):
+            skipped.append(tag)
+            continue
+        mt = os.path.getmtime(m)
+        if mt > best_mtime:
+            best, best_mtime = tag, mt
+    if skipped:
+        # loud, because this is also the legacy-upgrade surface: a tag
+        # with a manifest but no marker is EITHER a torn save (skip is
+        # the fix) or a pre-marker checkpoint (the operator must
+        # `touch COMMIT` to readmit it — docs/FAILOVER.md); silently
+        # cold-starting over saved state would be the worst outcome
+        log.error("checkpoint latest(%s): skipping %d uncommitted "
+                  "tag(s) %s — torn saves, or pre-commit-marker "
+                  "checkpoints needing a manual COMMIT file (see "
+                  "docs/FAILOVER.md)", directory, len(skipped),
+                  sorted(skipped)[:4])
     return best
+
+
+# ---------------------------------------------------------------------- #
+# per-shard incremental checkpoints (elastic failover, ps/failover.py;
+# docs/FAILOVER.md). Unlike save()/restore() — which walk every table
+# COLLECTIVELY and roll the whole world back — these snapshot ONE
+# rank's locally-owned shards (data + updater state + replay sequence
+# channels + apply version) so a restarted incarnation restores exactly
+# its own rows without touching peers' newer live state. Local
+# filesystems only: failover checkpoints are written at ~second cadence
+# and read by the replacement process on the same host/NFS plane.
+# ---------------------------------------------------------------------- #
+def _shard_base(directory: str, rank: int) -> str:
+    return os.path.join(directory, f"shard-r{int(rank)}")
+
+
+def _checkpointable_shards(tables):
+    """(name, shard) pairs of the local shards with the failover
+    checkpoint surface. Accepts a list of async tables, a
+    ``{name: shard}`` dict (the PSService registry shape), or a
+    zero-arg callable returning either."""
+    if callable(tables):
+        tables = tables()
+    if isinstance(tables, dict):
+        return [(n, s) for n, s in tables.items()
+                if hasattr(s, "checkpoint_state")]
+    out = []
+    for t in tables:
+        shard = getattr(t, "_shard", None)
+        if shard is None and hasattr(t, "_m"):   # AsyncArrayTable wraps
+            shard = getattr(t._m, "_shard", None)
+        if shard is not None and hasattr(shard, "checkpoint_state"):
+            out.append((t.name, shard))
+    return out
+
+
+def _save_shard_file(path: str, meta: Dict, arrays) -> None:
+    header = json.dumps(meta).encode()
+    with open(path, "wb") as f:
+        np.save(f, np.frombuffer(header, np.uint8), allow_pickle=False)
+        np.save(f, np.array([len(arrays)], np.int64), allow_pickle=False)
+        for a in arrays:
+            np.save(f, np.ascontiguousarray(a), allow_pickle=False)
+
+
+def _load_shard_file(path: str):
+    with open(path, "rb") as f:
+        meta = json.loads(np.load(f).tobytes().decode())
+        n = int(np.load(f)[0])
+        arrays = [np.load(f) for _ in range(n)]
+    return meta, arrays
+
+
+def save_shard_state(directory: str, rank: int, tables) -> str:
+    """Write one COMMITTED snapshot of ``rank``'s local shards under
+    ``directory/shard-r<rank>/v<N>/`` (monotonic tag; the commit marker
+    lands last, so a writer dying mid-save leaves an invisible torn
+    tag, never a loadable half-checkpoint). After the commit, each
+    shard's durable replay floors advance (``mark_durable``) — from
+    here on its stamped acks tell clients the snapshot's sequences
+    survive a crash. Returns the tag path."""
+    if not is_local(directory):
+        raise ValueError("per-shard failover checkpoints require a "
+                         f"local/NFS directory, got {directory!r}")
+    base = _shard_base(directory, rank)
+    os.makedirs(base, exist_ok=True)
+    nxt = 0
+    for name in os.listdir(base):
+        if name.startswith("v") and name[1:].isdigit():
+            nxt = max(nxt, int(name[1:]) + 1)
+    path = os.path.join(base, f"v{nxt:09d}")
+    os.makedirs(path, exist_ok=True)
+    manifest: Dict = {"version": 1, "rank": int(rank), "tables": {}}
+    shards = _checkpointable_shards(tables)
+    metas = []
+    for name, shard in shards:
+        meta, arrays = shard.checkpoint_state()
+        fname = f"{name}.mvs"
+        _save_shard_file(os.path.join(path, fname), meta, arrays)
+        manifest["tables"][name] = {"file": fname,
+                                    "kind": meta.get("kind"),
+                                    "version": meta.get("version")}
+        metas.append((shard, meta))
+    with open(os.path.join(path, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=1)
+    _write_commit(path)
+    # durable ONLY now: the marks must never run ahead of a commit a
+    # replacement could actually restore
+    for shard, meta in metas:
+        shard.mark_durable({cl: int(chan.get("floor", -1))
+                            for cl, chan in
+                            (meta.get("replay") or {}).items()})
+    log.debug("shard checkpoint saved: %s (%d shards)", path, len(shards))
+    return path
+
+
+def latest_shard_tag(directory: str, rank: int) -> Optional[str]:
+    """Newest COMMITTED per-shard tag for ``rank`` (torn tags skipped),
+    or None when the rank never completed a save."""
+    base = _shard_base(directory, rank)
+    if not os.path.isdir(base):
+        return None
+    tags = sorted((n for n in os.listdir(base)
+                   if n.startswith("v") and n[1:].isdigit()
+                   and os.path.exists(os.path.join(base, n,
+                                                   COMMIT_MARKER))),
+                  reverse=True)
+    return tags[0] if tags else None
+
+
+def restore_shard_state(directory: str, rank: int, tables,
+                        tag: Optional[str] = None) -> int:
+    """Restore ``rank``'s local shards from its newest committed
+    per-shard checkpoint (or an explicit ``tag``) — the respawned
+    incarnation's first act. Tables absent from the snapshot keep
+    their fresh state (they were created after the save); snapshot
+    entries without a live table are skipped. Returns the number of
+    shards restored (0 when no committed tag exists — a cold start)."""
+    tag = tag or latest_shard_tag(directory, rank)
+    if tag is None:
+        return 0
+    path = os.path.join(_shard_base(directory, rank), tag)
+    if not is_committed(path):
+        raise ValueError(f"shard checkpoint {path} is not committed")
+    with open(os.path.join(path, "manifest.json")) as f:
+        manifest = json.load(f)
+    by_name = dict(_checkpointable_shards(tables))
+    restored = 0
+    for name, entry in manifest.get("tables", {}).items():
+        shard = by_name.get(name)
+        if shard is None:
+            continue
+        meta, arrays = _load_shard_file(os.path.join(path, entry["file"]))
+        shard.restore_checkpoint(meta, arrays)
+        restored += 1
+    log.info("shard checkpoint restored: %s (%d shards)", path, restored)
+    return restored
+
+
+def prune_shard_tags(directory: str, rank: int, keep: int = 2) -> None:
+    """Drop all but the newest ``keep`` committed per-shard tags, plus
+    any torn (uncommitted) tag older than the newest committed one —
+    a crashed writer's debris must not accumulate forever."""
+    import shutil
+
+    base = _shard_base(directory, rank)
+    if not os.path.isdir(base):
+        return
+    tags = sorted(n for n in os.listdir(base)
+                  if n.startswith("v") and n[1:].isdigit())
+    committed = [n for n in tags
+                 if os.path.exists(os.path.join(base, n, COMMIT_MARKER))]
+    drop = set(committed[: -max(keep, 1)])
+    if committed:
+        newest = committed[-1]
+        drop.update(n for n in tags
+                    if n < newest and n not in committed)
+    for n in drop:
+        shutil.rmtree(os.path.join(base, n), ignore_errors=True)

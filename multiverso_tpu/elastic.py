@@ -40,14 +40,24 @@ class Heartbeat:
     """Periodic liveness beacon on shared storage (one file per rank)."""
 
     def __init__(self, directory: str, interval: float = 5.0,
-                 rank: Optional[int] = None):
+                 rank: Optional[int] = None, addr: Optional[str] = None):
+        """``addr`` stamps every beacon with this incarnation's
+        identity (the rank's published PS address): a respawned rank's
+        fresh beacon then clears its predecessor's tombstone by
+        IDENTITY, not just by timestamp — see :func:`failed`."""
         self.directory = directory
         self.interval = interval
         self.rank = Zoo.get().rank() if rank is None else rank
+        self.addr = addr
         self._step = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         os.makedirs(directory, exist_ok=True)
+
+    def set_addr(self, addr: Optional[str]) -> None:
+        """Late-bind the incarnation address (a service constructed
+        after the heartbeat started)."""
+        self.addr = addr
 
     @property
     def path(self) -> str:
@@ -65,6 +75,8 @@ class Heartbeat:
         staleness test alone can never distinguish from healthy."""
         entry = {"rank": self.rank, "step": self._step,
                  "ts": time.time()}
+        if self.addr:
+            entry["addr"] = self.addr
         try:
             from multiverso_tpu.telemetry import watchdog
             v = watchdog.last_verdict()
@@ -113,6 +125,8 @@ def peers(directory: str) -> Dict[int, Dict]:
                      "ts": float(raw["ts"])}
             if isinstance(raw.get("last_health"), dict):
                 entry["last_health"] = raw["last_health"]
+            if isinstance(raw.get("addr"), str):
+                entry["addr"] = raw["addr"]
             out[entry["rank"]] = entry
         except (ValueError, KeyError, TypeError, json.JSONDecodeError,
                 OSError):
@@ -120,30 +134,43 @@ def peers(directory: str) -> Dict[int, Dict]:
     return out
 
 
-def mark_failed(directory: str, rank: int) -> None:
+def mark_failed(directory: str, rank: int,
+                addr: Optional[str] = None) -> None:
     """Tombstone ``rank`` as failed NOW — the PS plane's socket-death
     signal feeding the heartbeat view (see :func:`bind_ps`), so a peer
     death is visible immediately instead of after a heartbeat timeout.
 
     The tombstone records the rank's LAST-SEEN beacon timestamp (the
-    subject's own clock): it clears as soon as a beacon newer than that
-    appears. Comparing subject-clock to subject-clock keeps the verdict
-    immune to cross-host wall-clock skew — an observer's clock being
-    minutes ahead must not keep a rejoined rank 'dead'."""
+    subject's own clock) and the dead INCARNATION's address (``addr``,
+    defaulting to the last beacon's). It clears as soon as a beacon
+    newer than that timestamp appears — OR a beacon carrying a
+    DIFFERENT address: a respawned rank is a fresh incarnation whatever
+    its clock says, and its beacons must never be shadowed by its
+    predecessor's tombstone (the predecessor may have kept beating
+    while wedged, pushing the recorded timestamp past anything the
+    replacement will ever write). Comparing subject-clock to
+    subject-clock keeps the timestamp rule immune to cross-host
+    wall-clock skew."""
     os.makedirs(directory, exist_ok=True)
     beacon = peers(directory).get(int(rank))
     seen_ts = float(beacon["ts"]) if beacon else float("-inf")
+    if addr is None and beacon is not None:
+        addr = beacon.get("addr")
     path = os.path.join(directory, f"failed.{int(rank)}.json")
     tmp = path + ".tmp"
+    entry: Dict = {"rank": int(rank), "ts": time.time(),
+                   "beacon_ts": seen_ts}
+    if addr:
+        entry["addr"] = addr
     with open(tmp, "w") as f:
-        json.dump({"rank": int(rank), "ts": time.time(),
-                   "beacon_ts": seen_ts}, f)
+        json.dump(entry, f)
     os.replace(tmp, path)
 
 
-def _tombstones(directory: str) -> Dict[int, float]:
-    """rank -> last-seen beacon ts (subject clock) at tombstone time."""
-    out: Dict[int, float] = {}
+def _tombstones(directory: str) -> Dict[int, Dict]:
+    """rank -> {"ts": last-seen beacon ts (subject clock), "addr":
+    tombstoned incarnation address or None} at tombstone time."""
+    out: Dict[int, Dict] = {}
     if not os.path.isdir(directory):
         return out
     for name in os.listdir(directory):
@@ -152,8 +179,9 @@ def _tombstones(directory: str) -> Dict[int, float]:
         try:
             with open(os.path.join(directory, name)) as f:
                 entry = json.load(f)
-            out[int(entry["rank"])] = float(
-                entry.get("beacon_ts", entry["ts"]))
+            out[int(entry["rank"])] = {
+                "ts": float(entry.get("beacon_ts", entry["ts"])),
+                "addr": entry.get("addr")}
         except (ValueError, KeyError, TypeError, json.JSONDecodeError,
                 OSError):
             continue
@@ -163,18 +191,28 @@ def _tombstones(directory: str) -> Dict[int, float]:
 def failed(directory: str, timeout: float = 30.0,
            beacons: Optional[Dict[int, Dict]] = None) -> List[int]:
     """Ranks considered dead: beacon older than ``timeout`` seconds, OR
-    tombstoned by a PS-plane death (:func:`mark_failed`) with no beacon
-    newer than the one the tombstone recorded (both timestamps are the
-    subject's own clock — cross-host skew cannot pin a rejoined rank).
+    tombstoned by a PS-plane death (:func:`mark_failed`) with no
+    exonerating beacon. A beacon exonerates its rank when it is newer
+    than the one the tombstone recorded (both timestamps the subject's
+    own clock — cross-host skew cannot pin a rejoined rank) or when it
+    carries a DIFFERENT incarnation address than the tombstone: a
+    respawned rank's fresh identity clears its predecessor's tombstone
+    even if the predecessor's last (wedged) beacons out-stamp it.
     ``beacons`` lets a caller that already listed the directory
     (:func:`health`) skip the second scan of shared storage."""
     now = time.time()
     if beacons is None:
         beacons = peers(directory)
     out = {r for r, e in beacons.items() if now - float(e["ts"]) > timeout}
-    for rank, seen_ts in _tombstones(directory).items():
+    for rank, tomb in _tombstones(directory).items():
         beacon = beacons.get(rank)
-        if beacon is None or float(beacon["ts"]) <= seen_ts:
+        if beacon is None:
+            out.add(rank)
+            continue
+        fresh_incarnation = (tomb.get("addr") is not None
+                             and beacon.get("addr") is not None
+                             and beacon["addr"] != tomb["addr"])
+        if not fresh_incarnation and float(beacon["ts"]) <= tomb["ts"]:
             out.add(rank)
     return sorted(out)
 

@@ -42,9 +42,11 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from multiverso_tpu.ps import wire
-# module-level like the exporter (no cycle: the aggregator imports this
-# module only lazily, inside functions), so its stats_poll_interval_s
-# flag is registered before any Zoo.start/argv parse reads it
+# module-level like the exporter (no cycle: the aggregator and the
+# failover plane import this module only lazily, inside functions), so
+# their stats_poll_interval_s / failover_* flags are registered before
+# any Zoo.start/argv parse reads them
+from multiverso_tpu.ps import failover as _failover
 from multiverso_tpu.telemetry import aggregator as _aggregator
 from multiverso_tpu.telemetry import exporter as _exporter
 from multiverso_tpu.telemetry import flightrec as _flight
@@ -532,7 +534,14 @@ class PSService:
     """Listener + shard registry + peer pool for one process."""
 
     def __init__(self, rank: int, world: int, rendezvous=None,
-                 host: Optional[str] = None, port: Optional[int] = None):
+                 host: Optional[str] = None, port: Optional[int] = None,
+                 defer_publish: bool = False):
+        """``defer_publish=True`` holds the rendezvous publish until
+        :meth:`publish_addr` — a RESTARTED shard must restore from its
+        checkpoint before any survivor can discover the new address,
+        or a replayed frame landing on the still-empty shard would
+        commit its sequence, ack, and then be wiped by the restore
+        (an acked op silently lost). See failover.rejoin."""
         self.rank, self.world = rank, world
         if host is None:
             host = config.get_flag("ps_host") or "127.0.0.1"
@@ -576,6 +585,13 @@ class PSService:
         self._native_cb = None
         self._native_lock = threading.Lock()
         self._nconns: Dict[int, Any] = {}
+        # shard incarnation generation (flag ps_generation): 0 for a
+        # first boot; the failover supervisor spawns each replacement
+        # at gen+1 and MSG_HEALTH echoes it, so a restarted shard is
+        # visible at a glance (mvtop's gen column). Assigned BEFORE
+        # the listener exists: a health probe can land the instant the
+        # accept loop starts, and health_payload reads this
+        self.generation = int(config.get_flag("ps_generation"))
         if config.get_flag("ps_native"):
             from multiverso_tpu.ps import native as ps_native
             if ps_native.available():
@@ -593,8 +609,9 @@ class PSService:
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="ps-accept", daemon=True)
         self._accept_thread.start()
-        if rendezvous is not None:
-            rendezvous.publish(rank, self.addr)
+        self._published = False
+        if rendezvous is not None and not defer_publish:
+            self.publish_addr()
         # flag-gated metrics exporter with the rich (shard-aware)
         # payload; no-op unless metrics_dir is set
         _exporter.ensure_started(rank, self.stats_payload)
@@ -604,10 +621,23 @@ class PSService:
         # cluster time series
         if rank == 0:
             _aggregator.ensure_started(self)
+        # flag-gated per-shard failover checkpointer (failover_dir +
+        # failover_ckpt_interval_s): the durable half of exactly-once
+        # replay — shards registered later are picked up per cycle
+        _failover.ensure_checkpointer(self)
         log.debug("PSService rank %d/%d listening on %s", rank, world,
                   self.addr)
 
     # ----------------------------- server side ----------------------- #
+    def publish_addr(self) -> None:
+        """Publish (or re-publish) this incarnation's address through
+        the rendezvous — the moment peers may discover it. Deferred-
+        publish services (restarted shards) call this AFTER their
+        checkpoint restore; idempotent."""
+        if self._rendezvous is not None:
+            self._rendezvous.publish(self.rank, self.addr)
+            self._published = True
+
     def register_handler(self, table: str, handler: Callable,
                          shard=None) -> None:
         """``handler(msg_type, meta, arrays) -> (meta, arrays)``, called on
@@ -803,6 +833,11 @@ class PSService:
         apply_age = _flight.RECORDER.beat_age("apply")
         return {
             "rank": self.rank, "addr": self.addr,
+            # incarnation generation: a respawned shard reports its
+            # predecessor's + 1, so operators (mvtop) and the cluster
+            # aggregator can tell a restarted rank from a healthy one
+            # even after its beacon/tombstone state settles
+            "gen": self.generation,
             "ts": round(time.time(), 3),
             # beat ages: PYTHON-plane liveness only. None = that loop
             # never ran (no python-plane traffic yet), a growing number
@@ -1238,8 +1273,11 @@ class PSService:
         # the cluster aggregator polls THROUGH this service: stop it
         # (final short-timeout poll included) while the probe path is
         # still alive — afterwards a poll would just record every rank
-        # unreachable
+        # unreachable. The shard checkpointer stops with a FINAL save
+        # while the shards are intact: a cleanly-closing rank's tail of
+        # applies must stay durable for whoever inherits its rows.
         _aggregator.stop_if_bound(self)
+        _failover.stop_if_bound(self)
         self._closed = True
         # shutdown, not just close: close() does NOT wake a thread blocked
         # in accept() on Linux — shutdown() makes accept return EINVAL

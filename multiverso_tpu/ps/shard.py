@@ -62,6 +62,85 @@ from multiverso_tpu.updaters import (OPT_INSENSITIVE as _OPT_INSENSITIVE,
                                      STATELESS_LINEAR as _LINEAR_SIGN)
 
 
+class _SeqChannel:
+    """Per-client applied-sequence tracker for exactly-once replay
+    (docs/FAILOVER.md): ``floor`` means every sequence at or below it
+    has applied; ``above`` is the sparse set of applied sequences past
+    a gap. The gap shape exists because a frame re-sent across a
+    connection change can arrive after a later frame sent on the fresh
+    conn — a plain high-water mark would then dedupe the LATE frame as
+    already-applied and lose it. Memory is bounded by the client's
+    in-flight pipeline depth (the set drains into the floor as gaps
+    close)."""
+
+    __slots__ = ("floor", "above", "failed")
+
+    # frames that applied with per-sub-op failures, kept so a DUP ack
+    # can echo the same "failed" indices (a replayed batch whose first
+    # ack was lost must not resolve its failed sub-ops as successes);
+    # bounded — failures are rare and only the recent replay window
+    # can ever be re-asked
+    _MAX_FAILED = 64
+
+    def __init__(self, floor: int = -1, above=(), failed=None):
+        self.floor = int(floor)
+        self.above = set(int(s) for s in above)
+        self.failed: Dict[int, Dict] = {
+            int(k): v for k, v in (failed or {}).items()}
+
+    def seen(self, seq: int) -> bool:
+        return seq <= self.floor or seq in self.above
+
+    def note_failed(self, seq: int, rmeta: Dict) -> None:
+        self.failed[int(seq)] = {"failed": list(rmeta.get("failed", ())),
+                                 "error": rmeta.get("error", "")}
+        while len(self.failed) > self._MAX_FAILED:
+            del self.failed[min(self.failed)]
+
+    @staticmethod
+    def _max_above() -> int:
+        """Gap-set bound: a client never has more frames outstanding
+        than its retention cap (flag ``ps_replay_max_frames``), so a
+        set larger than that means some sequence was permanently
+        abandoned (the client dropped its frame after exhausting
+        ``ps_replay_timeout`` — logged loudly there) and the gap will
+        never fill. Floored at the flag's default so a tiny/zero knob
+        can never make live out-of-order pipelines jump the floor."""
+        try:
+            return max(int(_config.get_flag("ps_replay_max_frames")),
+                       4096)
+        except Exception:   # noqa: BLE001 — flag registry unavailable
+            return 4096     # (standalone channel use in tests/tools)
+
+    def commit(self, seq: int) -> None:
+        if seq == self.floor + 1:
+            self.floor += 1
+            while self.floor + 1 in self.above:
+                self.floor += 1
+                self.above.discard(self.floor)
+        elif seq > self.floor:
+            self.above.add(seq)
+            if len(self.above) > self._max_above():
+                # jump past the abandoned gap instead of growing the
+                # set (and every checkpoint's replay block) forever
+                self.floor = min(self.above) - 1
+                while self.floor + 1 in self.above:
+                    self.floor += 1
+                    self.above.discard(self.floor)
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"floor": self.floor,
+                               "above": sorted(self.above)}
+        if self.failed:
+            out["failed"] = {str(k): v for k, v in self.failed.items()}
+        return out
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "_SeqChannel":
+        return cls(d.get("floor", -1), d.get("above", ()),
+                   d.get("failed"))
+
+
 class _DataPin:
     """A pinned read epoch of a shard's data buffer: holds the buffer
     object alive (plain Python reference) and marks it so the apply path
@@ -228,6 +307,22 @@ class RowShard:
         # sparse Get pulls everything (ref matrix.cpp up_to_date_ = false)
         self._dirty = (np.ones((num_workers, self.n), bool)
                        if num_workers > 0 else None)
+        # exactly-once replay plane (docs/FAILOVER.md): per-client
+        # applied-sequence channels. _replay_seq tracks which stamped
+        # frames each client has APPLIED (a frame already in its
+        # channel is a duplicate — replay racing a late ack, or a
+        # survivor re-flushing to this restored incarnation — and is
+        # acked without applying); _durable_floor is the channel floor
+        # at the last CHECKPOINT (ShardCheckpointer.mark_durable),
+        # echoed in every stamped reply as the client's retention-prune
+        # signal. _stamp_lock makes (dup check, apply, commit) atomic
+        # against checkpoint_state()'s snapshot: without it a frame
+        # could apply before the snapshot but commit its mark after,
+        # and the restored state would replay-apply it twice.
+        self._replay_seq: Dict[str, _SeqChannel] = {}
+        self._durable_floor: Dict[str, int] = {}
+        self._stamp_lock = threading.Lock()
+        self._stat_dup_frames = 0
 
     def _place_rows(self, host):
         """Place a row buffer honoring the size-gated local-device sharding
@@ -340,6 +435,12 @@ class RowShard:
             # plane); the aggregator's wire-bytes/s comes from deltas
             "get_bytes": self._stat_get_bytes,
             "add_bytes": self._stat_add_bytes,
+            # replay plane (docs/FAILOVER.md): stamped frames dedup'd
+            # as duplicates, and how many clients hold a sequence
+            # channel here — non-zero dup_frames after a failover is
+            # the exactly-once machinery WORKING, not an error
+            "dup_frames": self._stat_dup_frames,
+            "replay_clients": len(self._replay_seq),
         }
         if dirty_rows is not None:
             out["dirty_rows"] = dirty_rows   # sparse-protocol staleness
@@ -988,6 +1089,190 @@ class RowShard:
     def handle(self, msg_type: int, meta: Dict,
                arrays: Sequence[np.ndarray]
                ) -> Tuple[Dict, List[np.ndarray]]:
+        if (msg_type in (svc.MSG_ADD_ROWS, svc.MSG_BATCH)
+                and wire.REPLAY_CLIENT_KEY in meta):
+            return self._handle_stamped(msg_type, meta, arrays)
+        return self._handle(msg_type, meta, arrays)
+
+    def _handle_stamped(self, msg_type: int, meta: Dict,
+                        arrays: Sequence[np.ndarray]
+                        ) -> Tuple[Dict, List[np.ndarray]]:
+        """Dedupe gate for replay-stamped add frames (wire.REPLAY_*
+        meta): a frame at or below the client's applied high-water mark
+        acks as a duplicate without touching the data — the exactly-
+        once half of elastic failover (a survivor re-flushing its
+        retained window to a restored incarnation must never double-
+        apply the prefix the checkpoint already holds, and a replay
+        racing a late ack on a live shard must apply once). Stamped
+        frames serialize on ``_stamp_lock`` so the check, the apply,
+        and the mark commit are one atomic unit against concurrent
+        same-client replays AND against checkpoint_state()'s snapshot.
+        Replies echo the DURABLE mark (wire.REPLAY_DURABLE_KEY) — the
+        client prunes retained frames at or below it."""
+        cl = str(meta[wire.REPLAY_CLIENT_KEY])
+        seq = int(meta.get(wire.REPLAY_SEQ_KEY, -1))
+        with self._stamp_lock:
+            chan = self._replay_seq.get(cl)
+            if chan is not None and chan.seen(seq):
+                self._stat_dup_frames += 1
+                _flight.record(_flight.EV_FAILOVER_REPLAY,
+                               note=f"dup seq={seq}")
+                dup: Dict = {wire.REPLAY_DUP_KEY: True,
+                             wire.REPLAY_DURABLE_KEY:
+                                 self._durable_floor.get(cl, -1)}
+                # the original apply had per-sub-op failures: the dup
+                # ack must repeat them, or a replay whose first ack was
+                # lost would resolve the failed sub-ops as successes
+                dup.update(chan.failed.get(seq, ()))
+                return dup, []
+            rmeta, rarrays = self._handle(msg_type, meta, arrays)
+            # commit AFTER a successful apply: an apply that raised
+            # must stay replayable (at-least-once on failure; the
+            # client sees the error either way). A batch with per-
+            # sub-op failures still consumes the frame — those are
+            # REPORTED per op in the reply (and memoized for dup
+            # acks), never silently retried.
+            if chan is None:
+                chan = self._replay_seq[cl] = _SeqChannel()
+            chan.commit(seq)
+            if rmeta.get("failed"):
+                chan.note_failed(seq, rmeta)
+            rmeta = dict(rmeta)
+            rmeta[wire.REPLAY_DURABLE_KEY] = self._durable_floor.get(
+                cl, -1)
+        return rmeta, rarrays
+
+    def mark_durable(self, floors: Dict[str, int]) -> None:
+        """Advance the durable (checkpointed) channel floors — called
+        by the ShardCheckpointer after a COMMITTED save whose snapshot
+        carried exactly these channels. From here on stamped replies
+        tell clients that sequences at or below their floor survive a
+        crash, so their retention buffers may prune them."""
+        with self._stamp_lock:
+            self._durable_floor = dict(floors)
+
+    # ------------------------------------------------------------------ #
+    # failover checkpoint surface (checkpoint.save_shard_state):
+    # one atomic (meta, arrays) snapshot of everything a restarted
+    # incarnation needs — data rows, updater state, replay marks,
+    # mutation version
+    # ------------------------------------------------------------------ #
+    def _native_mutex(self):
+        """Context manager holding the native shard mutex when this
+        shard is natively registered (C++ serving threads mutate the
+        buffer under THAT mutex, not ``_lock`` — a checkpoint snapshot
+        racing them would tear rows); no-op otherwise."""
+        import contextlib
+        if self._native_ref is None:
+            return contextlib.nullcontext()
+        from multiverso_tpu.ps import native as ps_native
+
+        @contextlib.contextmanager
+        def held(pin=self._native_ref):
+            ps_native.shard_pin_lock(pin)
+            try:
+                yield
+            finally:
+                ps_native.shard_pin_unlock(pin)
+
+        return held()
+
+    def checkpoint_state(self) -> Tuple[Dict, List[np.ndarray]]:
+        """Consistent shard snapshot for the per-shard failover
+        checkpoint. Taken under ``_stamp_lock`` + the shard lock (plus
+        the native shard mutex when C++ serves this shard) so the
+        replay marks and the data agree exactly (see _handle_stamped);
+        every array is an OWNED host copy — a donating apply right
+        after release must not invalidate the bytes being written.
+        Lock ORDER matters: the native mutex comes FIRST, matching the
+        punt path (locked_handler holds it around handle(), which then
+        takes _stamp_lock) — the reverse order deadlocks a stamped
+        punted frame against a concurrent checkpoint."""
+        with self._native_mutex(), self._stamp_lock:
+            with self._lock:
+                chans = {k: v.to_dict()
+                         for k, v in self._replay_seq.items()}
+                version = self._version
+                if self._np_mode:
+                    data = self._data[: self.n].copy()
+                else:
+                    data = np.asarray(self._data)[: self.n].copy()
+                leaves = [np.asarray(l).copy()
+                          for l in jax.tree.leaves(self._ustate)]
+        meta = {"kind": "row", "lo": self.lo, "rows": self.n,
+                "cols": self.num_col, "dtype": str(self.dtype),
+                "version": int(version), "replay": chans,
+                "n_leaves": len(leaves)}
+        return meta, [data] + leaves
+
+    def restore_checkpoint(self, meta: Dict,
+                           arrays: Sequence[np.ndarray]) -> None:
+        """Adopt a :meth:`checkpoint_state` snapshot — the restore half
+        of shard failover. Dirty bits reset to all-True (sparse workers
+        re-pull everything; safe, never wrong), and the restored replay
+        marks become BOTH the applied and the durable high-water marks:
+        the restored state is by definition exactly what the checkpoint
+        made durable."""
+        if meta.get("kind") != "row":
+            raise svc.PSError(f"{self.name}: checkpoint kind "
+                              f"{meta.get('kind')!r} is not a row shard")
+        if (int(meta["lo"]) != self.lo or int(meta["rows"]) != self.n
+                or int(meta["cols"]) != self.num_col):
+            raise svc.PSError(
+                f"{self.name}: checkpoint shard [{meta['lo']}, "
+                f"{int(meta['lo']) + int(meta['rows'])})x{meta['cols']} "
+                f"!= live [{self.lo}, {self.hi})x{self.num_col} — "
+                "partition changed since the save")
+        data, leaves = arrays[0], list(arrays[1:])
+        # native mutex FIRST (same order rule as checkpoint_state)
+        with self._native_mutex(), self._stamp_lock:
+            with self._lock:
+                flat, treedef = jax.tree.flatten(self._ustate)
+                if len(leaves) != len(flat):
+                    raise svc.PSError(
+                        f"{self.name}: checkpoint has {len(leaves)} "
+                        f"updater-state leaves, shard expects "
+                        f"{len(flat)}")
+                for got, want in zip(leaves, flat):
+                    if tuple(np.shape(got)) != tuple(np.shape(want)):
+                        raise svc.PSError(
+                            f"{self.name}: updater-state leaf shape "
+                            f"{np.shape(got)} != {np.shape(want)}")
+                if self._np_mode:
+                    # in place: a natively-registered shard's C++ side
+                    # holds the raw pointer, so the buffer never swaps
+                    self._data[: self.n] = np.asarray(data, self.dtype)
+                else:
+                    host = np.zeros(self._padded, self.dtype)
+                    host[: self.n] = np.asarray(data, self.dtype)
+                    self._data = self._place_rows(host)
+                new = [jnp.asarray(np.asarray(a, np.asarray(w).dtype))
+                       for a, w in zip(leaves, flat)]
+                self._ustate = jax.tree.unflatten(treedef, new)
+                if self._local_sharding is not None:
+                    self._ustate = jax.tree.map(self._place_state_local,
+                                                self._ustate)
+                self._adopt_replay_channels(meta)
+                self._version = int(meta.get("version", 0))
+                if self._dirty is not None:
+                    self._dirty[:] = True
+        _flight.record(_flight.EV_FAILOVER_RESTORE,
+                       note=f"{self.name} v{meta.get('version', 0)}")
+
+    def _adopt_replay_channels(self, meta: Dict) -> None:
+        """Rebuild the replay channels from a checkpoint's ``replay``
+        block (caller holds ``_stamp_lock``). The restored channels are
+        BOTH the applied and the durable marks: the restored state is
+        by definition exactly what the checkpoint made durable."""
+        self._replay_seq = {str(k): _SeqChannel.from_dict(v)
+                            for k, v in (meta.get("replay")
+                                         or {}).items()}
+        self._durable_floor = {k: c.floor
+                               for k, c in self._replay_seq.items()}
+
+    def _handle(self, msg_type: int, meta: Dict,
+                arrays: Sequence[np.ndarray]
+                ) -> Tuple[Dict, List[np.ndarray]]:
         if msg_type == svc.MSG_ADD_ROWS:
             local, vals, opt = self._prep_add(meta, arrays)
             tr = (meta.get(wire.TRACE_META_KEY)
@@ -1251,9 +1536,36 @@ class HashShard(RowShard):
             out[i] = slot
         return out
 
-    def handle(self, msg_type: int, meta: Dict,
-               arrays: Sequence[np.ndarray]
-               ) -> Tuple[Dict, List[np.ndarray]]:
+    def checkpoint_state(self) -> Tuple[Dict, List[np.ndarray]]:
+        """Hash-shard failover snapshot: the (keys, rows, state-leaf)
+        dump plus replay marks/version, same atomicity as RowShard's."""
+        with self._stamp_lock:
+            with self._lock:
+                chans = {k: v.to_dict()
+                         for k, v in self._replay_seq.items()}
+                version = self._version
+                _, arrs = self._dump()
+        meta = {"kind": "hash", "cols": self.num_col,
+                "dtype": str(self.dtype), "version": int(version),
+                "replay": chans, "n_leaves": max(len(arrs) - 2, 0)}
+        return meta, [np.ascontiguousarray(a) for a in arrs]
+
+    def restore_checkpoint(self, meta: Dict,
+                           arrays: Sequence[np.ndarray]) -> None:
+        if meta.get("kind") != "hash":
+            raise svc.PSError(f"{self.name}: checkpoint kind "
+                              f"{meta.get('kind')!r} is not a hash shard")
+        with self._stamp_lock:
+            with self._lock:
+                self._restore(arrays)
+                self._adopt_replay_channels(meta)
+                self._version = int(meta.get("version", 0))
+        _flight.record(_flight.EV_FAILOVER_RESTORE,
+                       note=f"{self.name} v{meta.get('version', 0)}")
+
+    def _handle(self, msg_type: int, meta: Dict,
+                arrays: Sequence[np.ndarray]
+                ) -> Tuple[Dict, List[np.ndarray]]:
         if msg_type in (svc.MSG_ADD_FULL, svc.MSG_GET_FULL):
             raise svc.PSError(
                 f"{self.name}: hash-sharded table has no dense whole-table "
@@ -1313,7 +1625,10 @@ class HashShard(RowShard):
             if keys is not None:
                 slots = self._slots_for(keys)
                 arrays = [slots] + list(arrays[1:])
-            return super().handle(msg_type, meta, arrays)
+            # _handle, not handle: the replay gate already ran at this
+            # request's entry point (HashShard.handle inherits it) —
+            # re-entering it here would dup-check the frame twice
+            return super()._handle(msg_type, meta, arrays)
 
     # ------------------------------------------------------------------ #
     # checkpoint: (keys, rows, per-key updater state) — the reference left

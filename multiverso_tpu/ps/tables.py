@@ -18,7 +18,10 @@ Waiter, src/table.cpp:27-97).
 
 from __future__ import annotations
 
+import collections
 import concurrent.futures as cf
+import itertools
+import os
 import threading
 import time
 import weakref
@@ -327,6 +330,74 @@ def _attach_reply_span(futs: List, name: str, t0: float, tid: int,
             f.add_done_callback(_done)
 
 
+class _RetainedFrame:
+    """One replay-retained window frame: everything needed to put the
+    EXACT frame back on the wire (same sequence stamp, same meta, same
+    blobs) plus the waiter futures its eventual ack fans out to."""
+
+    __slots__ = ("owner", "seq", "msg_type", "meta", "arrays", "gfuts",
+                 "acked", "needs_send", "created", "attempts",
+                 "retry_since")
+
+    def __init__(self, owner: int, seq: int, msg_type: int, meta: Dict,
+                 arrays, gfuts):
+        self.owner, self.seq = owner, seq
+        self.msg_type, self.meta, self.arrays = msg_type, meta, arrays
+        self.gfuts = gfuts
+        self.acked = False
+        self.needs_send = False
+        self.created = time.monotonic()
+        self.attempts = 0
+        # when this frame ENTERED its current replay episode (first
+        # failed attempt / owner-death re-arm); None = not replaying.
+        # ps_replay_timeout bounds time spent RETRYING, measured from
+        # here — a frame acked long ago and re-armed by a late owner
+        # death must get the full retry budget, not zero of it
+        self.retry_since: Optional[float] = None
+
+
+class _ReplayBuffer:
+    """Client half of exactly-once send-window replay (flag
+    ``ps_replay``; docs/FAILOVER.md): per-owner monotonic frame
+    sequences, the retained-frame log, and the replay schedule.
+
+    Every windowed frame is stamped with (client id, per-owner seq) and
+    RETAINED — past its ack — until the owning shard reports it durable
+    (the reply's ``dseq`` floor, advanced by the failover checkpointer).
+    On a peer death the whole retained tail for that owner re-arms and
+    the flusher re-flushes it, oldest first, to whatever incarnation the
+    rendezvous resolves next: the restored shard's sequence channels ack
+    the already-checkpointed prefix as duplicates and apply the rest —
+    no acked op lost, no frame applied twice."""
+
+    # per-process window nonce: a re-created same-named table must get
+    # a FRESH sequence channel on the shard — reusing (rank, pid) alone
+    # would restart next_seq at 0 under the old channel's floor and the
+    # shard would dedupe every fresh frame as already-applied
+    _nonce = itertools.count()
+
+    def __init__(self, table):
+        self.client_id = (f"r{table.ctx.rank}.{os.getpid()}"
+                          f".{next(self._nonce)}")
+        self.lock = threading.Lock()
+        self.next_seq: Dict[int, int] = {}
+        # owner -> seq -> frame, insertion (= seq) order
+        self.retained: Dict[int, "collections.OrderedDict[int, _RetainedFrame]"] = {}
+        # owner -> count of frames awaiting (re-)send; > 0 blocks direct
+        # dispatch of NEW frames so the wire order stays the seq order
+        self.pending_send: Dict[int, int] = {}
+        # owner -> monotonic deadline of the next replay attempt
+        self.next_due: Dict[int, float] = {}
+        base = f"table[{table.name}].replay"
+        self.mon_replayed = Dashboard.get(base + ".frames")
+        self.mon_dups = Dashboard.get(base + ".dups")
+        self.mon_dropped = Dashboard.get(base + ".dropped")
+
+    def soonest_due(self) -> Optional[float]:
+        with self.lock:
+            return min(self.next_due.values()) if self.next_due else None
+
+
 class _SendWindow:
     """Client-side cross-call add coalescer (the PS *send window*), one
     per windowed table: ``add_rows_async`` enqueues per-owner entries and
@@ -354,7 +425,18 @@ class _SendWindow:
     socket send: an ``add_rows_async`` enqueue can never block behind an
     in-progress flush. A caller that fences (:meth:`flush_pending`) and
     then issues a get on the same conn reads its own writes — per-conn
-    FIFO at the server does the rest; the fence does NOT wait for acks."""
+    FIFO at the server does the rest; the fence does NOT wait for acks.
+
+    Replay (flag ``ps_replay``; docs/FAILOVER.md): frames are stamped
+    with (client, per-owner seq), RETAINED past their ack until the
+    owning shard reports them checkpoint-durable, and re-flushed in seq
+    order when the owner dies — the shard's sequence channels dedupe,
+    so an acked op is never lost and no frame applies twice. While an
+    owner's retained tail awaits replay, fresh frames to it queue
+    behind (seq order IS wire order) and their futures stay pending
+    until the restored incarnation acks them; the fence then means
+    "queued or retained", and read-your-writes on that owner degrades
+    to eventual until the replay drains."""
 
     def __init__(self, table, window_ms: float, max_bytes: int,
                  max_ops: int):
@@ -379,6 +461,21 @@ class _SendWindow:
         self._mon_windowed = Dashboard.get(base + ".windowed")
         self._mon_flushes = Dashboard.get(base + ".flushes")
         self._mon_merged = Dashboard.get(base + ".merged_rows")
+        # exactly-once replay (flag ps_replay; docs/FAILOVER.md):
+        # stamped, retained, re-flushed frames. The peer-death hook is
+        # weakref-bound — the service's hook list outlives any one
+        # table and must not pin it (same rule as the flusher thread)
+        self._replay: Optional[_ReplayBuffer] = None
+        if config.get_flag("ps_replay"):
+            self._replay = _ReplayBuffer(table)
+            wref = weakref.ref(self)
+
+            def _death(rank: int, _w=wref) -> None:
+                w = _w()
+                if w is not None:
+                    w._on_owner_death(rank)
+
+            table.ctx.service.add_death_hook(_death)
 
     # ------------------------------------------------------------------ #
     def submit(self, parts: List[Tuple[int, np.ndarray, np.ndarray]],
@@ -414,12 +511,7 @@ class _SendWindow:
                 # on every small add for nothing: the flusher's existing
                 # wait already covers an armed deadline
                 self._deadline = time.monotonic() + self.window_s
-                if self._thread is None:
-                    self._thread = threading.Thread(
-                        target=_window_loop, args=(weakref.ref(self),),
-                        daemon=True,
-                        name=f"ps-window-{self._table_name}")
-                    self._thread.start()
+                self._ensure_flusher_locked()
                 self._cv.notify()
         if ship:
             self._flush_owner(owner)
@@ -446,23 +538,48 @@ class _SendWindow:
     # died (see _window_loop's weakref) instead of pinning it forever
     _IDLE_WAIT_S = 5.0
 
+    def _ensure_flusher_locked(self) -> None:
+        """Start (or restart) the flusher thread; caller holds
+        ``self._cv``. Shared by the enqueue path and the replay plane —
+        a replay-armed window with no fresh enqueues still needs the
+        thread alive to drive retries."""
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=_window_loop, args=(weakref.ref(self),),
+                daemon=True, name=f"ps-window-{self._table_name}")
+            self._thread.start()
+
     def _step(self) -> bool:
         """One flusher cycle: wait out the open window (or idle,
-        bounded), then ship everything pending. Returns False only on a
-        spurious/idle wakeup with nothing to do."""
+        bounded), then ship everything pending; with replay armed, the
+        wait also bounds to the soonest replay deadline and every cycle
+        drives due replays. Returns False only on a spurious/idle
+        wakeup with nothing to do."""
+        owners: List[int] = []
+        rp = self._replay
         with self._cv:
-            if self._deadline is None:
-                self._cv.wait(self._IDLE_WAIT_S)
-                return False
-            delay = self._deadline - time.monotonic()
-            if delay > 0:
-                self._cv.wait(min(delay, self._IDLE_WAIT_S))
-                return False
-            self._deadline = None
-            owners = list(self._pending)
+            bound = self._IDLE_WAIT_S
+            now = time.monotonic()
+            nd = rp.soonest_due() if rp is not None else None
+            if nd is not None:
+                bound = min(bound, max(nd - now, 0.005))
+            if self._deadline is not None:
+                delay = self._deadline - now
+                if delay <= 0:
+                    self._deadline = None
+                    owners = list(self._pending)
+                else:
+                    bound = min(bound, delay)
+            if not owners and not (nd is not None and nd <= now):
+                self._cv.wait(bound)
+        # _replay_step runs OUTSIDE the cv hold: it takes owner send
+        # locks (and its sends can block on a dead owner's sockets),
+        # while senders holding those locks block on the cv to queue
+        # armed frames — calling it under the cv would be an ABBA
+        # deadlock of the table during exactly the failover it serves
         for owner in owners:
             self._flush_owner(owner)
-        return True
+        return self._replay_step() or bool(owners)
 
     # ------------------------------------------------------------------ #
     def _send_lock(self, owner: int) -> threading.Lock:
@@ -580,26 +697,36 @@ class _SendWindow:
                         meta["wire"] = w
                     if tids:
                         meta[wire_mod.TRACE_META_KEY] = tids[0]
-                    req = t.ctx.service.request(
-                        owner, svc.MSG_ADD_ROWS, meta,
-                        [ids] + wire_mod.encode_payload(vals, w),
-                        meta_b=(None if tids
-                                else t._add_meta_b(opt, w)))
+                    msg_type = svc.MSG_ADD_ROWS
+                    frame_arrays = [ids] + wire_mod.encode_payload(vals, w)
+                    meta_b = (None if tids or self._replay is not None
+                              else t._add_meta_b(opt, w))
                 else:
                     blobs = [wire_mod.encode(
                         svc.MSG_ADD_ROWS, i, sub_meta(opt, tids),
                         [ids] + wire_mod.encode_payload(vals, w))
                         for i, (ids, vals, opt, tids) in enumerate(chunk)]
-                    req = t.ctx.service.request(
-                        owner, svc.MSG_BATCH,
-                        {"table": t.name, "n": len(chunk)},
-                        wire_mod.pack_batch(blobs))
+                    msg_type = svc.MSG_BATCH
+                    meta = {"table": t.name, "n": len(chunk)}
+                    frame_arrays = wire_mod.pack_batch(blobs)
+                    meta_b = None
             except Exception as e:   # encode failure must not orphan waiters
                 for f in futs:
                     if not f.done():
                         f.set_exception(e)
                 continue
             self._mon_flushes.incr()
+            if self._replay is not None:
+                # stamped + retained dispatch: the ack callback,
+                # retention pruning, and peer-death replay all live in
+                # _frame_done (trace ack spans stay off this path — a
+                # replayed frame's span would stitch to a long-dead
+                # request)
+                self._dispatch_retained(t, owner, msg_type, meta,
+                                        frame_arrays, gfuts)
+                continue
+            req = t.ctx.service.request(owner, msg_type, meta,
+                                        frame_arrays, meta_b=meta_b)
             if traced and all_tids:
                 # ack span: frame on the wire -> window ack fanned out
                 # (runs on the peer's recv thread)
@@ -630,6 +757,248 @@ class _SendWindow:
                 trace=all_tids[0],
                 args={"owner": owner, "ops": len(entries),
                       "frames": nframes, "traces": all_tids})
+
+    # ------------------------------------------------------------------ #
+    # exactly-once replay plane (flag ps_replay; docs/FAILOVER.md)
+    # ------------------------------------------------------------------ #
+    def _dispatch_retained(self, t, owner: int, msg_type: int,
+                           meta: Dict, arrays, gfuts) -> None:
+        """Stamp one window frame with (client, per-owner seq), retain
+        it, and put it on the wire — unless earlier frames to this
+        owner are awaiting replay, in which case it queues behind them
+        (seq order IS wire order; a new frame overtaking a replayed one
+        could commit a later sequence first and the shard would then
+        treat the late arrival as the duplicate)."""
+        rp = self._replay
+        with rp.lock:
+            seq = rp.next_seq.get(owner, 0)
+            rp.next_seq[owner] = seq + 1
+            meta = dict(meta)
+            meta[wire_mod.REPLAY_CLIENT_KEY] = rp.client_id
+            meta[wire_mod.REPLAY_SEQ_KEY] = seq
+            fr = _RetainedFrame(owner, seq, msg_type, meta, arrays, gfuts)
+            q = rp.retained.setdefault(owner, collections.OrderedDict())
+            q[seq] = fr
+            blocked = rp.pending_send.get(owner, 0) > 0
+            if blocked:
+                fr.needs_send = True
+                rp.pending_send[owner] += 1
+        if blocked:
+            with self._cv:
+                self._ensure_flusher_locked()
+                self._cv.notify()
+            return
+        self._send_frame(t, fr)
+
+    def _send_frame(self, t, fr: _RetainedFrame) -> None:
+        fr.attempts += 1
+        try:
+            req = t.ctx.service.request(fr.owner, fr.msg_type, fr.meta,
+                                        fr.arrays)
+        except Exception as e:   # defensive: request() never raises
+            req = _failed_future(e)
+        req.add_done_callback(lambda bf, fr=fr: self._frame_done(bf, fr))
+
+    def _frame_done(self, bf: cf.Future, fr: _RetainedFrame) -> None:
+        """Outcome of one retained frame's latest wire attempt (peer
+        recv thread, or inline for a failed-fast dispatch). A peer-
+        unreachable failure inside the replay window does NOT fail the
+        waiters — the frame re-arms and they complete when it finally
+        lands on a (possibly restored) incarnation; anything else — a
+        shard-side error, or the replay window exhausted — completes
+        them with the error exactly like the unreplayed path."""
+        rp = self._replay
+        exc: Optional[BaseException] = None
+        meta: Dict = {}
+        try:
+            exc = bf.exception()
+            if exc is None:
+                res = bf.result()
+                if isinstance(res, tuple) and isinstance(res[0], dict):
+                    meta = res[0]
+        except (cf.CancelledError, Exception) as e:   # defensive
+            exc = e
+        if isinstance(exc, svc.PSPeerError):
+            now = time.monotonic()
+            if fr.retry_since is None:
+                fr.retry_since = now
+            if (now - fr.retry_since
+                    <= config.get_flag("ps_replay_timeout")):
+                with rp.lock:
+                    if not fr.needs_send:
+                        fr.needs_send = True
+                        rp.pending_send[fr.owner] = (
+                            rp.pending_send.get(fr.owner, 0) + 1)
+                    due = now + config.get_flag("ps_replay_backoff")
+                    cur = rp.next_due.get(fr.owner)
+                    if cur is None or due < cur:
+                        rp.next_due[fr.owner] = due
+                with self._cv:
+                    self._ensure_flusher_locked()
+                    self._cv.notify()
+                return
+        if meta.get(wire_mod.REPLAY_DUP_KEY):
+            rp.mon_dups.incr()
+        with rp.lock:
+            q = rp.retained.get(fr.owner)
+            if exc is None:
+                fr.acked = True
+                fr.retry_since = None
+                if q is not None:
+                    self._prune_owner_locked(
+                        fr.owner,
+                        int(meta.get(wire_mod.REPLAY_DURABLE_KEY, -1)))
+            elif q is not None:
+                # permanently failed (shard error / replay window
+                # exhausted): nothing left to replay — drop the frame,
+                # keeping the armed-frame invariant (pending_send ==
+                # count of needs_send frames; a stale positive count
+                # would block every later frame to this owner forever)
+                if fr.needs_send:
+                    fr.needs_send = False
+                    rp.pending_send[fr.owner] = max(
+                        rp.pending_send.get(fr.owner, 0) - 1, 0)
+                dropped_acked = all(f.done()
+                                    for fs in fr.gfuts for f in fs)
+                q.pop(fr.seq, None)
+                if dropped_acked:
+                    # the waiters already saw success: this IS a lost
+                    # acked op — the one outcome replay exists to
+                    # prevent — and it must be loud, not silent
+                    log.error(
+                        "table[%s]: replay of frame seq %d to owner %d "
+                        "exhausted its window (%s); an ACKED op may be "
+                        "lost", self._table_name, fr.seq, fr.owner, exc)
+        _complete_window_futures(bf, fr.gfuts, owner=fr.owner)
+
+    def _prune_owner_locked(self, owner: int, durable: int) -> None:
+        """Drop retained frames the shard has made durable (caller
+        holds ``rp.lock``), then enforce the retention cap: past it the
+        oldest ACKED frames drop with a warning — durability degrades
+        to ack-time instead of memory growing without bound when no
+        checkpointer is advancing the durable floor."""
+        rp = self._replay
+
+        def _remove(seq: int) -> None:
+            # keep the armed-frame invariant (pending_send == count of
+            # needs_send frames) on EVERY removal path: a frame can be
+            # re-armed by an owner death while its (old-incarnation)
+            # success ack is in flight, and pruning it without the
+            # decrement would leave the owner "blocked" forever
+            fr = q.pop(seq, None)
+            if fr is not None and fr.needs_send:
+                fr.needs_send = False
+                rp.pending_send[owner] = max(
+                    rp.pending_send.get(owner, 0) - 1, 0)
+
+        q = rp.retained.get(owner)
+        if not q:
+            return
+        for seq in [s for s, f in q.items()
+                    if f.acked and s <= durable]:
+            _remove(seq)
+        cap = config.get_flag("ps_replay_max_frames")
+        if len(q) > cap:
+            drop = [s for s, f in q.items() if f.acked][: len(q) - cap]
+            if drop:
+                rp.mon_dropped.incr(len(drop))
+                log.error(
+                    "table[%s]: replay retention cap (%d) dropped %d "
+                    "acked frames for owner %d — they are durable only "
+                    "to ack-time (is the failover checkpointer "
+                    "running?)", self._table_name, cap, len(drop), owner)
+                for s in drop:
+                    _remove(s)
+
+    def _on_owner_death(self, rank: int) -> None:
+        """Peer-death hook: the owner may come back restored from a
+        checkpoint missing the tail of what it acked — re-arm EVERY
+        retained frame (acked ones too) for re-flush in seq order; the
+        restored incarnation's sequence channels ack the prefix its
+        checkpoint already holds as duplicates and apply only the
+        genuinely lost tail."""
+        rp = self._replay
+        if rp is None:
+            return
+        now = time.monotonic()
+        with rp.lock:
+            q = rp.retained.get(rank)
+            if not q:
+                return
+            armed = 0
+            for fr in q.values():
+                fr.acked = False
+                if fr.retry_since is None:
+                    fr.retry_since = now
+                if not fr.needs_send:
+                    fr.needs_send = True
+                    armed += 1
+            if armed:
+                rp.pending_send[rank] = (rp.pending_send.get(rank, 0)
+                                         + armed)
+            rp.next_due[rank] = (time.monotonic()
+                                 + config.get_flag("ps_replay_backoff"))
+            n = len(q)
+        _flight.record(_flight.EV_FAILOVER_REPLAY, peer=rank,
+                       note=f"owner died: {n} frames re-armed")
+        with self._cv:
+            self._ensure_flusher_locked()
+            self._cv.notify()
+
+    def _replay_step(self) -> bool:
+        """Flusher-cycle half of the replay plane: re-flush every owner
+        whose retry deadline passed."""
+        rp = self._replay
+        if rp is None:
+            return False
+        now = time.monotonic()
+        with rp.lock:
+            due = [o for o, t0 in rp.next_due.items() if now >= t0]
+        did = False
+        for owner in due:
+            did = self._replay_owner(owner) or did
+        return did
+
+    def _replay_owner(self, owner: int) -> bool:
+        """Re-flush one owner's armed frames, oldest first, under the
+        owner's SEND lock (fresh flushes queue behind, so the conn sees
+        strict seq order). Frames that fail again re-arm themselves via
+        their _frame_done; frames landing on a restored incarnation
+        dedupe server-side."""
+        rp = self._replay
+        t = self._table_ref()
+        with self._send_lock(owner):
+            with rp.lock:
+                rp.next_due.pop(owner, None)
+                q = rp.retained.get(owner)
+                frames = ([f for f in q.values() if f.needs_send]
+                          if q else [])
+                for f in frames:
+                    f.needs_send = False
+                if frames:
+                    rp.pending_send[owner] = max(
+                        rp.pending_send.get(owner, 0) - len(frames), 0)
+            if not frames:
+                return False
+            if t is None:
+                err = svc.PSError(
+                    f"table[{self._table_name}] was garbage-collected "
+                    "with frames awaiting replay")
+                with rp.lock:
+                    for f in frames:
+                        if q is not None:
+                            q.pop(f.seq, None)
+                for f in frames:
+                    for fut in (x for fs in f.gfuts for x in fs):
+                        if not fut.done():
+                            fut.set_exception(err)
+                return True
+            rp.mon_replayed.incr(len(frames))
+            _flight.record(_flight.EV_FAILOVER_REPLAY, peer=owner,
+                           note=f"re-flush {len(frames)} frames")
+            for fr in frames:
+                self._send_frame(t, fr)
+        return True
 
 
 def _chunk_scatter(buf: np.ndarray, idx: Optional[np.ndarray],

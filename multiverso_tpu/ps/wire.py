@@ -69,6 +69,24 @@ class WireError(RuntimeError):
 # JSON-meta key carrying the per-request trace ID (see module docstring)
 TRACE_META_KEY = "tr"
 
+# Exactly-once replay meta (elastic failover, docs/FAILOVER.md). A
+# windowed add frame (MSG_ADD_ROWS / MSG_BATCH shipped by a replay-
+# enabled _SendWindow) stamps its OUTER meta with the sending client's
+# identity and a per-(client, table) monotonic sequence number; the
+# owning shard dedupes by per-client high-water mark (a frame arriving
+# twice — replay racing a late ack, or a survivor re-flushing to a
+# restored incarnation — applies exactly once). Replies echo the
+# shard's DURABLE (checkpointed) high-water mark for that client, which
+# is the client's retention-prune signal. The binary frame layout is
+# unchanged; unstamped frames behave exactly as before. The native C++
+# server's meta whitelist does not know these keys, so stamped frames
+# always punt to the Python handler — dedupe runs under the native
+# shard mutex there, one implementation on both wire planes.
+REPLAY_CLIENT_KEY = "cl"     # request: client identity string
+REPLAY_SEQ_KEY = "seq"       # request: per-(client, table) sequence
+REPLAY_DURABLE_KEY = "dseq"  # reply: durable high-water mark for cl
+REPLAY_DUP_KEY = "dup"       # reply: frame was a dedup'd duplicate
+
 
 def with_trace(meta: Dict, trace) -> Dict:
     """Meta dict + trace ID (no-op passthrough for ``trace=None`` so

@@ -125,6 +125,9 @@ def merge_cluster(stats_by_rank: Dict[int, Any],
                 ent["error"] = f"{type(h).__name__}: {h}"[:200]
         else:
             ent = {"status": h.get("status", "?"), "addr": h.get("addr"),
+                   # incarnation generation (failover plane): a
+                   # restarted shard reports its predecessor's + 1
+                   "gen": h.get("gen"),
                    "native": h.get("native"),
                    "queue_depth": h.get("queue_depth"),
                    "inflight": h.get("inflight"),

@@ -100,6 +100,13 @@ EV_SSP_RESOLVED = 23   # a blocked SSP wait resolved (pairs EV_SSP_WAIT)
 EV_GET_SERVE = 24      # shard: a get pinned an epoch to serve off-lock
 EV_GET_CHUNK = 25      # service: one streamed-reply sub-frame sent
 EV_GET_WIN = 26        # client get coalescer: one batched fetch shipped
+# elastic shard failover lifecycle (ps/failover.py, docs/FAILOVER.md):
+# postmortem renders these five as the recovery timeline
+EV_FAILOVER_DETECT = 27   # supervisor confirmed a dead|stuck rank
+EV_FAILOVER_RESPAWN = 28  # supervisor launched the replacement
+EV_FAILOVER_RESTORE = 29  # a shard restored from its checkpoint
+EV_FAILOVER_REPLAY = 30   # replay plane: frame re-flushed / dedup'd
+EV_FAILOVER_REJOIN = 31   # restored incarnation is serving again
 
 EV_NAMES = {
     EV_SEND: "send", EV_ACK: "ack", EV_ERR: "err", EV_RECV: "recv",
@@ -114,6 +121,11 @@ EV_NAMES = {
     EV_STUCK: "watchdog.stuck", EV_STATE: "state",
     EV_SSP_RESOLVED: "ssp.resolved", EV_GET_SERVE: "get.serve",
     EV_GET_CHUNK: "get.chunk", EV_GET_WIN: "get.window",
+    EV_FAILOVER_DETECT: "failover.detect",
+    EV_FAILOVER_RESPAWN: "failover.respawn",
+    EV_FAILOVER_RESTORE: "failover.restore",
+    EV_FAILOVER_REPLAY: "failover.replay",
+    EV_FAILOVER_REJOIN: "failover.rejoin",
 }
 
 

@@ -221,6 +221,34 @@ define_float("get_window_ms", 0.0,
              "this many ms old (so a small get is never starved behind "
              "a long chunked fetch). 0 disables (every get is its own "
              "frame). Per-table override: get_window_ms= on the table")
+# Exactly-once send-window replay (the elastic-failover client half,
+# ps/tables._SendWindow + ps/shard dedupe; docs/FAILOVER.md): windowed
+# frames carry a per-(client, table) monotonic sequence, the owning
+# shard dedupes by high-water mark, and the client RETAINS frames past
+# their ack until the shard reports them durable (checkpointed) — on a
+# shard death the retained tail re-flushes to the restored incarnation,
+# so no acked op is lost and no frame applies twice.
+define_bool("ps_replay", False,
+            "stamp windowed async-table frames with (client, seq), "
+            "retain them until the owning shard reports them durable, "
+            "and replay the unacked/non-durable tail to a restarted "
+            "shard incarnation (dedup by per-client high-water mark on "
+            "the shard). Requires a send window (batch_window_ms / "
+            "send_window_ms=); the failover supervisor's checkpointer "
+            "advances the durable mark (docs/FAILOVER.md)")
+define_float("ps_replay_timeout", 120.0,
+             "seconds a replayed frame keeps retrying against a dead "
+             "owner before its futures fail with PSPeerError (bounds "
+             "how long a failover may take before clients give up)")
+define_float("ps_replay_backoff", 0.5,
+             "seconds between replay attempts against an owner that is "
+             "still unreachable")
+define_int("ps_replay_max_frames", 4096,
+           "retained-frame cap per owner: past it the oldest ACKED "
+           "frames are dropped (with a warning) — durability degrades "
+           "to ack-time instead of checkpoint-time rather than memory "
+           "growing without bound when no checkpointer is advancing "
+           "the durable mark")
 define_int("get_chunk_rows", 0,
            "chunk-stream get replies above this many rows: the server "
            "ships N self-describing sub-frames instead of one "

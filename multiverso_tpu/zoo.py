@@ -128,8 +128,11 @@ class Zoo:
         from multiverso_tpu.telemetry import trace as _trace
         # cluster aggregator first (final poll needs the PS service,
         # which reset_default_context below tears down), then the
-        # per-rank exporter
+        # per-rank exporter; the failover checkpointer writes one final
+        # committed save while the shards are still intact
         _aggregator.stop_global()
+        from multiverso_tpu.ps import failover as _failover
+        _failover.stop_global(final=True)
         _exporter.stop_global()
         # final black-box dump (no-op unless a dump directory resolves):
         # a run that hung AFTER stop began still leaves its last tape.

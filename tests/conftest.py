@@ -87,8 +87,11 @@ def _fresh_runtime():
     from multiverso_tpu.telemetry import trace as _trace
     from multiverso_tpu.telemetry import watchdog as _watchdog
     # no final poll: the service a leaked aggregator is bound to may be
-    # gone, and teardown must not wait out probe timeouts
+    # gone, and teardown must not wait out probe timeouts; same rule
+    # for a leaked shard checkpointer's final save
     _aggregator.stop_global(final=False)
+    from multiverso_tpu.ps import failover as _failover
+    _failover.stop_global(final=False)
     _exporter.stop_global()
     _trace.TRACER.reset()
     _trace.TRACER.enabled = False

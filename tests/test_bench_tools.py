@@ -262,3 +262,24 @@ def test_run_bench_flags_skew_growth():
     # one side missing the cluster record: skipped, never flagged
     assert flag_regressions({"extra": {}}, rec(9.0)) == []
     assert flag_regressions(rec(1.0), {"extra": {}}) == []
+
+
+def test_run_bench_flags_chaos_recovery_growth():
+    """ISSUE 7 satellite: >2x run-over-run growth of the chaos bench's
+    recovery-time-to-full-throughput (extra.chaos.recovery_s) is
+    FLAGGED — never fails the run — mirroring the skew flag; missing
+    chaos data (bench errored, older record) is skipped."""
+    from tools.run_bench import flag_regressions
+
+    def rec(recovery_s):
+        return {"extra": {"chaos": {"recovery_s": recovery_s,
+                                    "ops_lost": 0}}}
+
+    assert flag_regressions(rec(4.0), rec(6.0)) == []        # 1.5x: fine
+    flags = flag_regressions(rec(4.0), rec(9.0))             # 2.25x
+    assert len(flags) == 1
+    assert "chaos failover recovery time" in flags[0]
+    # missing on either side (errored chaos bench, older record): skip
+    assert flag_regressions({"extra": {}}, rec(9.0)) == []
+    assert flag_regressions(
+        rec(4.0), {"extra": {"chaos": {"error": "boom"}}}) == []

@@ -1,0 +1,533 @@
+"""Elastic shard failover (ISSUE 7): exactly-once replay dedupe on both
+wire planes, per-shard incremental checkpoints with torn-write
+skipping, the failover supervisor's detect→respawn→rejoin loop, the
+stale-tombstone incarnation rule, and a fast in-process failover smoke
+(kill a rank's service, respawn it in-process, restore from the shard
+checkpoint, and assert the replayed state is bit-exact). The full
+SIGKILL chaos bench lives in tools/bench_chaos.py and runs as a `slow`
+test at the bottom."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from multiverso_tpu import checkpoint, elastic
+from multiverso_tpu.ps import failover
+from multiverso_tpu.ps import service as svc
+from multiverso_tpu.ps import wire
+from multiverso_tpu.ps.tables import AsyncMatrixTable, AsyncSparseKVTable
+from multiverso_tpu.utils import config
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _stamped_meta(table, cl, seq, extra=None):
+    meta = {"table": table, "opt": {},
+            wire.REPLAY_CLIENT_KEY: cl, wire.REPLAY_SEQ_KEY: seq}
+    meta.update(extra or {})
+    return meta
+
+
+class TestReplayDedupe:
+    """A shard receiving the same sequence-stamped frame twice applies
+    it exactly once — on both the python and native-punt wire planes
+    (the two_ranks fixture parametrizes them; stamped metas always punt
+    off the native fast path, so dedupe is one implementation)."""
+
+    def test_plain_frame_applies_once(self, two_ranks):
+        ctxs = two_ranks
+        t0 = AsyncMatrixTable(8, 2, name="dd", ctx=ctxs[0])
+        AsyncMatrixTable(8, 2, name="dd", ctx=ctxs[1])
+        meta = _stamped_meta("dd", "c1", 0)
+        arrays = [np.array([5], np.int64), np.ones((1, 2), np.float32)]
+        r1, _ = ctxs[0].service.request(
+            1, svc.MSG_ADD_ROWS, meta, arrays).result(15)
+        assert not r1.get(wire.REPLAY_DUP_KEY)
+        assert wire.REPLAY_DURABLE_KEY in r1
+        # the duplicate (replay racing a late ack) acks without applying
+        r2, _ = ctxs[0].service.request(
+            1, svc.MSG_ADD_ROWS, meta, arrays).result(15)
+        assert r2.get(wire.REPLAY_DUP_KEY) is True
+        got = t0.get_rows([5])
+        assert float(got[0, 0]) == 1.0, got
+        st = t0.server_stats(1)["shards"]["dd"]
+        assert st["dup_frames"] >= 1
+        assert st["replay_clients"] == 1
+
+    def test_batch_frame_applies_once(self, two_ranks):
+        ctxs = two_ranks
+        t0 = AsyncMatrixTable(8, 2, name="db", ctx=ctxs[0])
+        AsyncMatrixTable(8, 2, name="db", ctx=ctxs[1])
+        blobs = [wire.encode(svc.MSG_ADD_ROWS, i,
+                             {"table": "db", "opt": {}},
+                             [np.array([4 + i], np.int64),
+                              np.ones((1, 2), np.float32)])
+                 for i in range(2)]
+        meta = _stamped_meta("db", "c1", 7, {"n": 2})
+        arrays = wire.pack_batch(blobs)
+        r1, _ = ctxs[0].service.request(
+            1, svc.MSG_BATCH, meta, arrays).result(15)
+        assert not r1.get(wire.REPLAY_DUP_KEY)
+        r2, _ = ctxs[0].service.request(
+            1, svc.MSG_BATCH, meta, arrays).result(15)
+        assert r2.get(wire.REPLAY_DUP_KEY) is True
+        got = t0.get_rows([4, 5])
+        assert np.array_equal(got, np.ones((2, 2), np.float32)), got
+
+    def test_hash_shard_dedupes_too(self, two_ranks):
+        ctxs = two_ranks
+        t0 = AsyncSparseKVTable(2, name="dk", ctx=ctxs[0])
+        AsyncSparseKVTable(2, name="dk", ctx=ctxs[1])
+        meta = _stamped_meta("dk", "c2", 3)
+        arrays = [np.array([11], np.int64), np.ones((1, 2), np.float32)]
+        ctxs[0].service.request(1, svc.MSG_ADD_ROWS, meta,
+                                arrays).result(15)
+        r2, _ = ctxs[0].service.request(
+            1, svc.MSG_ADD_ROWS, meta, arrays).result(15)
+        assert r2.get(wire.REPLAY_DUP_KEY) is True
+        assert float(t0.get_rows([11])[0, 0]) == 1.0
+
+    def test_out_of_order_replay_not_lost(self, two_ranks):
+        """A late frame arriving AFTER a higher sequence (re-send
+        across a connection change) must still apply — the channel
+        tracks gaps, not just a high-water mark."""
+        ctxs = two_ranks
+        t0 = AsyncMatrixTable(8, 2, name="oo", ctx=ctxs[0])
+        AsyncMatrixTable(8, 2, name="oo", ctx=ctxs[1])
+        arrays = [np.array([6], np.int64), np.ones((1, 2), np.float32)]
+        ctxs[0].service.request(1, svc.MSG_ADD_ROWS,
+                                _stamped_meta("oo", "c3", 2),
+                                arrays).result(15)
+        r, _ = ctxs[0].service.request(1, svc.MSG_ADD_ROWS,
+                                       _stamped_meta("oo", "c3", 1),
+                                       arrays).result(15)
+        assert not r.get(wire.REPLAY_DUP_KEY)
+        assert float(t0.get_rows([6])[0, 0]) == 2.0
+        # ...and each of them is still deduped on a second arrival
+        r, _ = ctxs[0].service.request(1, svc.MSG_ADD_ROWS,
+                                       _stamped_meta("oo", "c3", 1),
+                                       arrays).result(15)
+        assert r.get(wire.REPLAY_DUP_KEY) is True
+
+    def test_windowed_reflush_to_live_shard_is_noop(self, two_ranks):
+        """The replay-race-vs-late-ack case end to end: force the send
+        window to re-flush its retained (already acked) frames to the
+        still-alive shard — every one must dedupe, state unchanged."""
+        ctxs = two_ranks
+        config.set_flag("ps_replay", True)
+        t0 = AsyncMatrixTable(8, 2, name="rf", send_window_ms=1.0,
+                              ctx=ctxs[0])
+        AsyncMatrixTable(8, 2, name="rf", send_window_ms=1.0,
+                         ctx=ctxs[1])
+        for _ in range(4):
+            t0.add_rows([5], np.ones((1, 2), np.float32))
+        assert float(t0.get_rows([5])[0, 0]) == 4.0
+        win = t0._window
+        assert win._replay is not None
+        # pretend the owner died: every retained frame re-arms...
+        win._on_owner_death(1)
+        # ...and the re-flush lands on the SAME live incarnation
+        deadline = time.monotonic() + 10
+        while win._replay.pending_send.get(1, 0) > 0:
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+        t0.flush()
+        assert float(t0.get_rows([5])[0, 0]) == 4.0
+        st = t0.server_stats(1)["shards"]["rf"]
+        assert st["dup_frames"] >= 1
+
+    def test_old_acked_frame_gets_full_retry_budget(self, two_ranks):
+        """Regression: ps_replay_timeout bounds time spent RETRYING,
+        measured from the replay episode's start — a frame acked long
+        before its owner died must not be dropped with zero budget
+        (its age is retention working as designed, not a stuck
+        retry)."""
+        ctxs = two_ranks
+        config.set_flag("ps_replay", True)
+        config.set_flag("ps_replay_backoff", 0.05)
+        t0 = AsyncMatrixTable(8, 2, name="ob", send_window_ms=1.0,
+                              ctx=ctxs[0])
+        AsyncMatrixTable(8, 2, name="ob", send_window_ms=1.0,
+                         ctx=ctxs[1])
+        t0.add_rows([4], np.ones((1, 2), np.float32))
+        win = t0._window
+        q = win._replay.retained.get(1, {})
+        assert q
+        for fr in q.values():
+            # simulate a frame retained far past ps_replay_timeout
+            fr.created -= 10 * config.get_flag("ps_replay_timeout")
+        win._on_owner_death(1)
+        deadline = time.monotonic() + 10
+        while win._replay.pending_send.get(1, 0) > 0:
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+        # the re-flush landed (dedup'd), nothing was dropped
+        assert win._replay.mon_dropped.count == 0
+        assert float(t0.get_rows([4])[0, 0]) == 1.0
+        st = t0.server_stats(1)["shards"]["ob"]
+        assert st["dup_frames"] >= 1
+
+
+class TestShardCheckpoint:
+    def test_roundtrip_and_durable_pruning(self, two_ranks, tmp_path):
+        ctxs = two_ranks
+        config.set_flag("ps_replay", True)
+        t0 = AsyncMatrixTable(8, 2, name="ck", send_window_ms=1.0,
+                              ctx=ctxs[0])
+        t1 = AsyncMatrixTable(8, 2, name="ck", send_window_ms=1.0,
+                              ctx=ctxs[1])
+        ckdir = str(tmp_path / "ck")
+        for _ in range(3):
+            t0.add_rows([6], np.ones((1, 2), np.float32))
+        path = checkpoint.save_shard_state(ckdir, 1, [t1])
+        assert checkpoint.is_committed(path)
+        assert checkpoint.latest_shard_tag(ckdir, 1) is not None
+        # acks now carry the durable floor — retained frames prune
+        t0.add_rows([6], np.ones((1, 2), np.float32))
+        win = t0._window
+        deadline = time.monotonic() + 10
+        while len(win._replay.retained.get(1, {})) > 1:
+            assert time.monotonic() < deadline, \
+                dict(win._replay.retained.get(1, {}))
+            time.sleep(0.05)
+        # mutate past the checkpoint, then roll the shard back
+        assert float(t0.get_rows([6])[0, 0]) == 4.0
+        assert checkpoint.restore_shard_state(ckdir, 1, [t1]) == 1
+        assert float(t0.get_rows([6])[0, 0]) == 3.0
+
+    def test_updater_state_roundtrips(self, two_ranks, tmp_path):
+        ctxs = two_ranks
+        t0 = AsyncMatrixTable(8, 2, name="cs", updater="adagrad",
+                              ctx=ctxs[0])
+        t1 = AsyncMatrixTable(8, 2, name="cs", updater="adagrad",
+                              ctx=ctxs[1])
+        from multiverso_tpu.updaters import AddOption
+        opt = AddOption(learning_rate=0.1, rho=0.1)
+        t0.add_rows([5], np.ones((1, 2), np.float32), opt)
+        before = t0.get_rows([5]).copy()
+        ckdir = str(tmp_path / "ck")
+        checkpoint.save_shard_state(ckdir, 1, [t1])
+        t0.add_rows([5], np.ones((1, 2), np.float32), opt)
+        after_two = t0.get_rows([5]).copy()
+        checkpoint.restore_shard_state(ckdir, 1, [t1])
+        assert np.array_equal(t0.get_rows([5]), before)
+        # the restored adagrad accumulator must step exactly like the
+        # original's second step — state rode the checkpoint
+        t0.add_rows([5], np.ones((1, 2), np.float32), opt)
+        assert np.array_equal(t0.get_rows([5]), after_two)
+
+    def test_torn_tag_invisible(self, tmp_path, two_ranks):
+        ctxs = two_ranks
+        t1 = AsyncMatrixTable(8, 2, name="tt", ctx=ctxs[1])
+        AsyncMatrixTable(8, 2, name="tt", ctx=ctxs[0])
+        ckdir = str(tmp_path / "ck")
+        checkpoint.save_shard_state(ckdir, 1, [t1])
+        p2 = checkpoint.save_shard_state(ckdir, 1, [t1])
+        os.remove(os.path.join(p2, checkpoint.COMMIT_MARKER))
+        # the torn newest tag is skipped: latest falls back to v0
+        assert checkpoint.latest_shard_tag(ckdir, 1) == "v000000000"
+        # ...and prune clears the debris once a newer commit exists
+        checkpoint.save_shard_state(ckdir, 1, [t1])
+        checkpoint.prune_shard_tags(ckdir, 1, keep=2)
+        base = os.path.dirname(p2)
+        assert os.path.basename(p2) not in os.listdir(base)
+
+    def test_partition_mismatch_raises(self, two_ranks, tmp_path):
+        ctxs = two_ranks
+        t1 = AsyncMatrixTable(8, 2, name="pm", ctx=ctxs[1])
+        meta, arrays = t1._shard.checkpoint_state()
+        meta = dict(meta, lo=0)   # claim somebody else's range
+        with pytest.raises(svc.PSError):
+            t1._shard.restore_checkpoint(meta, arrays)
+
+
+class TestTornFullCheckpoint:
+    """Satellite: checkpoint.latest()/restore() skip torn directories
+    — the manifest commit marker is written last."""
+
+    def _fake_tag(self, root, tag, committed):
+        d = root / tag
+        d.mkdir(parents=True)
+        (d / "manifest.json").write_text(
+            json.dumps({"tables": {}, "version": 1}))
+        if committed:
+            (d / checkpoint.COMMIT_MARKER).write_text("1")
+
+    def test_latest_skips_uncommitted(self, tmp_path):
+        self._fake_tag(tmp_path, "step_000000009", committed=True)
+        time.sleep(0.02)
+        # newer but TORN (writer died before the marker): invisible
+        self._fake_tag(tmp_path, "step_000000019", committed=False)
+        assert checkpoint.latest(str(tmp_path)) == "step_000000009"
+
+    def test_restore_rejects_uncommitted(self, tmp_path):
+        self._fake_tag(tmp_path, "step_000000009", committed=False)
+        with pytest.raises(ValueError, match="commit marker"):
+            checkpoint.restore(str(tmp_path), "step_000000009")
+
+    def test_truncated_mid_write_regression(self, tmp_path):
+        """The literal mid-write truncation: manifest half-written, no
+        marker — latest() must fall back to the previous good tag."""
+        self._fake_tag(tmp_path, "step_000000009", committed=True)
+        d = tmp_path / "step_000000019"
+        d.mkdir()
+        (d / "manifest.json").write_text('{"tables": {"0": {"na')
+        assert checkpoint.latest(str(tmp_path)) == "step_000000009"
+
+
+class TestStaleTombstone:
+    """Satellite: a respawned rank's fresh beacon is never shadowed by
+    its predecessor's tombstone — beacons and tombstones carry the
+    incarnation address."""
+
+    def test_fresh_incarnation_clears_out_stamped_tombstone(
+            self, tmp_path):
+        hb = str(tmp_path / "hb")
+        # the predecessor kept beating while wedged: its last beacon
+        # carries a timestamp AHEAD of anything the replacement writes
+        pred = elastic.Heartbeat(hb, rank=3, addr="10.0.0.1:7001")
+        pred.beat()
+        path = pred.path
+        with open(path) as f:
+            raw = json.load(f)
+        raw["ts"] = time.time() + 1000.0
+        with open(path, "w") as f:
+            json.dump(raw, f)
+        elastic.mark_failed(hb, 3)
+        assert 3 in elastic.failed(hb, timeout=1e9)
+        # replacement incarnation: NEW address, ordinary (older) clock
+        elastic.Heartbeat(hb, rank=3, addr="10.0.0.1:7002").beat()
+        assert 3 not in elastic.failed(hb, timeout=1e9)
+        assert elastic.health(hb, timeout=1e9)[3] == "ok"
+
+    def test_addr_less_beacons_keep_timestamp_rule(self, tmp_path):
+        hb = str(tmp_path / "hb")
+        b = elastic.Heartbeat(hb, rank=2)
+        b.beat()
+        elastic.mark_failed(hb, 2)
+        assert 2 in elastic.failed(hb, timeout=1e9)
+        b.beat()   # newer beacon, same (absent) identity: clears by ts
+        assert 2 not in elastic.failed(hb, timeout=1e9)
+
+    def test_tombstone_records_beacon_addr(self, tmp_path):
+        hb = str(tmp_path / "hb")
+        elastic.Heartbeat(hb, rank=1, addr="h:1").beat()
+        elastic.mark_failed(hb, 1)
+        tomb = elastic._tombstones(hb)[1]
+        assert tomb["addr"] == "h:1"
+
+
+class TestSupervisor:
+    def test_detect_respawn_rejoin(self, tmp_path):
+        hb = str(tmp_path / "hb")
+        calls = {"spawn": [], "kill": []}
+        elastic.Heartbeat(hb, rank=0, addr="h:1").beat()
+        victim = elastic.Heartbeat(hb, rank=1, addr="h:2")
+        victim.beat()
+        sup = failover.FailoverSupervisor(
+            hb, 2, spawn=lambda r, g: calls["spawn"].append((r, g)),
+            kill=lambda r: calls["kill"].append(r),
+            timeout=1e9, poll_s=60, confirm=False, respawn_grace=0.2)
+        assert sup.check_once()[1] == "ok"
+        assert calls["spawn"] == []
+        # the PS plane observes the death (tombstone short-circuits
+        # the staleness timeout entirely)
+        elastic.mark_failed(hb, 1)
+        v = sup.check_once()
+        assert v[1] == "dead"
+        assert calls["kill"] == [1] and calls["spawn"] == [(1, 1)]
+        phases = [p for _, p, _ in sup.events]
+        assert phases == ["detect", "respawn"]
+        # within the grace: no re-respawn even though still dead
+        assert sup.check_once()[1] == "dead"
+        assert calls["spawn"] == [(1, 1)]
+        # the replacement beacons with a fresh incarnation address
+        elastic.Heartbeat(hb, rank=1, addr="h:3").beat()
+        assert sup.check_once()[1] == "ok"
+        assert [p for _, p, _ in sup.events] == ["detect", "respawn",
+                                                 "rejoin"]
+        spans = sup.recovery_spans()
+        assert len(spans) == 1 and spans[0]["rank"] == 1
+
+    def test_confirm_probe_vetoes_false_positive(self, tmp_path):
+        """A stale beacon alone (wedged NFS, slow clock) must not kill
+        a rank whose MSG_HEALTH probe still answers ok."""
+        from multiverso_tpu.ps.service import FileRendezvous, PSService
+        rdv_dir = str(tmp_path / "rdv")
+        service = PSService(1, 1, FileRendezvous(rdv_dir))
+        try:
+            hb = str(tmp_path / "hb")
+            b = elastic.Heartbeat(hb, rank=1, addr=service.addr)
+            b.beat()
+            calls = []
+            sup = failover.FailoverSupervisor(
+                hb, 2, rendezvous_dir=rdv_dir,
+                spawn=lambda r, g: calls.append((r, g)),
+                timeout=0.0, poll_s=60, confirm=True, ranks=[1])
+            time.sleep(0.05)   # beacon goes "stale" at timeout=0
+            sup.check_once()
+            assert calls == []   # probe answered: verdict vetoed
+        finally:
+            service.close()
+
+    def test_never_seen_rank_not_respawned(self, tmp_path):
+        hb = str(tmp_path / "hb")
+        calls = []
+        sup = failover.FailoverSupervisor(
+            hb, 4, spawn=lambda r, g: calls.append(r), confirm=False,
+            timeout=1e9, poll_s=60)
+        sup.check_once()
+        assert calls == []   # nobody ever beaconed: not ours to spawn
+
+
+class TestInProcessFailoverSmoke:
+    """Tier-1 failover smoke: kill a rank's service in-process, respawn
+    it (fresh PSService + shard), restore from the per-shard
+    checkpoint, and assert the survivor's replayed state is bit-exact —
+    the full SIGKILL/OS-process version is the `slow` chaos bench."""
+
+    def test_kill_respawn_restore_replay(self, tmp_path):
+        from multiverso_tpu.ps.service import (FileRendezvous, PSContext,
+                                               PSService)
+        config.set_flag("ps_native", False)
+        config.set_flag("ps_replay", True)
+        config.set_flag("ps_timeout", 30.0)
+        config.set_flag("ps_connect_timeout", 5.0)
+        config.set_flag("ps_reconnect_backoff", 0.2)
+        config.set_flag("ps_replay_backoff", 0.1)
+        rdv = FileRendezvous(str(tmp_path / "rdv"))
+        ckdir = str(tmp_path / "ck")
+        ctx0 = PSContext(0, 2, PSService(0, 2, rdv))
+        ctx1 = PSContext(1, 2, PSService(1, 2, rdv))
+        ctx1b = None
+        try:
+            t = AsyncMatrixTable(8, 2, name="sm", send_window_ms=1.0,
+                                 ctx=ctx0)
+            t1 = AsyncMatrixTable(8, 2, name="sm", send_window_ms=1.0,
+                                  ctx=ctx1)
+            ck = failover.ShardCheckpointer(ckdir, 1, [t1],
+                                            interval_s=999)
+            for _ in range(3):
+                t.add_rows([5], np.ones((1, 2), np.float32))
+            ck.checkpoint_now()
+            for _ in range(2):   # acked but NOT durable
+                t.add_rows([5], np.ones((1, 2), np.float32))
+            assert float(t.get_rows([5])[0, 0]) == 5.0
+            ctx1.service.close()   # the "crash"
+            # ops issued mid-outage must survive too
+            mids = [t.add_rows_async([5], np.ones((1, 2), np.float32))
+                    for _ in range(2)]
+            time.sleep(0.3)
+            # respawn: fresh service (new port, publish DEFERRED until
+            # the restore — a survivor must never reach the empty
+            # shard), fresh shard, restore
+            config.set_flag("ps_generation", 1)
+            svc1b = PSService(1, 2, rdv, defer_publish=True)
+            ctx1b = PSContext(1, 2, svc1b)
+            t1b = AsyncMatrixTable(8, 2, name="sm", send_window_ms=1.0,
+                                   ctx=ctx1b)
+            assert failover.rejoin(ckdir, 1, [t1b], service=svc1b) == 1
+            for m in mids:
+                t.wait(m)
+            t.flush()
+            # 3 checkpointed + 2 acked-replayed + 2 mid-outage, exactly
+            assert float(t.get_rows([5])[0, 0]) == 7.0
+            assert svc1b.health_payload()["gen"] == 1
+        finally:
+            ctx0.close()
+            if ctx1b is not None:
+                ctx1b.close()
+
+
+class TestObservability:
+    def test_merge_cluster_carries_generation(self):
+        from multiverso_tpu.telemetry import aggregator
+        health = {0: {"status": "ok", "addr": "h:1", "gen": 0},
+                  1: {"status": "ok", "addr": "h:9", "gen": 2}}
+        rec = aggregator.merge_cluster({}, health, world=2)
+        assert rec["ranks"]["1"]["gen"] == 2
+        assert rec["ranks"]["0"]["gen"] == 0
+
+    def test_mvtop_renders_generation(self):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import mvtop
+        rec = {"ts": time.time(), "world": 2, "polled": 2,
+               "ranks": {"0": {"status": "ok", "gen": 0, "addr": "h:1"},
+                         "1": {"status": "ok", "gen": 3,
+                               "addr": "h:9"}},
+               "tables": {}, "monitors": {}}
+        out = mvtop.render(rec)
+        assert "gen" in out.splitlines()[1]
+        assert any(" 3 " in line for line in out.splitlines())
+
+    def test_postmortem_recovery_timeline(self):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import postmortem
+        dumps = [{
+            "header": {"rank": 9, "mono_to_wall": 100.0},
+            "events": [
+                {"ev": "failover.detect", "mono": 1.0, "peer": 1},
+                {"ev": "recv", "mono": 1.2, "msg_id": 4},
+                {"ev": "failover.respawn", "mono": 2.0, "peer": 1,
+                 "note": "gen=1"},
+                {"ev": "failover.restore", "mono": 4.0,
+                 "note": "sm v3"},
+                {"ev": "failover.replay", "mono": 4.5, "peer": 1},
+                {"ev": "failover.rejoin", "mono": 5.0, "peer": 1},
+            ],
+            "inflight": [], "stacks": [], "path": "x",
+        }]
+        rec = postmortem.recovery_timeline(dumps)
+        assert [e["phase"] for e in rec] == [
+            "detect", "respawn", "restore", "replay", "rejoin"]
+        assert rec[-1]["t_plus_s"] == pytest.approx(4.0)
+        report = postmortem.render_report(dumps)
+        assert "recovery timeline" in report
+        assert "rejoin" in report
+
+
+@pytest.mark.slow
+class TestChaosBench:
+    """The ISSUE 7 acceptance run: SIGKILL one of two server shards
+    under sustained windowed traffic; the job must recover to >= 90%
+    of pre-fault throughput with zero acked ops lost and zero double
+    applies (final state bit-for-bit vs the acked-op oracle)."""
+
+    def test_sigkill_chaos_recovers_exactly_once(self):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        # the exactly-once ledger must hold on EVERY run; the 90%
+        # throughput ratio compares rates measured ~10 s apart on a
+        # shared CI box whose load drifts more than 10% by itself, so
+        # that one check gets a second attempt before failing
+        last = None
+        for attempt in range(2):
+            out = subprocess.run(
+                [sys.executable, os.path.join(REPO, "tools",
+                                              "bench_chaos.py"), "16"],
+                capture_output=True, text=True, timeout=400, env=env,
+                cwd=REPO)
+            res = None
+            for line in out.stdout.splitlines():
+                if line.startswith("RESULT "):
+                    res = json.loads(line[len("RESULT "):])
+            assert out.returncode == 0, (out.returncode,
+                                         out.stderr[-1500:])
+            assert res is not None
+            assert res["ops_lost"] == 0
+            assert res["ops_double_applied"] == 0
+            assert res["parity_bit_for_bit"] is True
+            phases = [e["phase"] for e in res["supervisor"]["events"]]
+            assert phases[:2] == ["detect", "respawn"]
+            assert "rejoin" in phases
+            last = res
+            if res["recovered_to_90pct"]:
+                break
+        assert last["recovered_to_90pct"] is True, last
+        assert last["recovery_s"] is not None

@@ -117,15 +117,19 @@ def render(rec: Dict, prev: Optional[Dict] = None,
     lines = [f"mvtop  {time.strftime('%H:%M:%S', time.localtime(rec.get('ts', 0)))}"
              f"  ranks {up}/{rec.get('world', '?')} up"
              f"  (stats from {rec.get('polled', 0)})"]
-    lines.append(f"{'rank':<5} {'status':<12} {'addr':<22} {'queue':>6} "
+    lines.append(f"{'rank':<5} {'status':<12} {'gen':>4} "
+                 f"{'addr':<22} {'queue':>6} "
                  f"{'infl':>5} {'oldest_s':>9} {'serve_age':>10}")
     for r in sorted(rec.get("ranks", {}), key=int):
         e = rec["ranks"][r]
         status = e.get("status", "?")
         if e.get("stats_error"):
             status += "*"       # health answered, stats did not
+        # incarnation generation: gen>0 = this rank was respawned by
+        # the failover plane (the at-a-glance restarted-shard signal)
         lines.append(
-            f"{r:<5} {status:<12} {_fmt(e.get('addr')):<22} "
+            f"{r:<5} {status:<12} {_fmt(e.get('gen')):>4} "
+            f"{_fmt(e.get('addr')):<22} "
             f"{_fmt(e.get('queue_depth')):>6} {_fmt(e.get('inflight')):>5} "
             f"{_fmt(e.get('oldest_inflight_s')):>9} "
             f"{_fmt(e.get('serve_age_s')):>10}")

@@ -199,6 +199,37 @@ def dead_suspects(dumps: List[Dict]) -> List[Dict]:
             for r, v in sorted(why.items())]
 
 
+_RECOVERY_EVS = ("failover.detect", "failover.respawn",
+                 "failover.restore", "failover.replay",
+                 "failover.rejoin")
+
+
+def recovery_timeline(dumps: List[Dict], log_lines: List[Dict] = ()
+                      ) -> List[Dict]:
+    """The failover lifecycle (detect → respawn → restore → replay →
+    rejoin) across every rank's dump, on one wall clock, each phase
+    stamped with its delay since the episode's first detect — the
+    "how long was the shard dark, and where did the time go" view."""
+    rows = [r for r in timeline(dumps, log_lines)
+            if r.get("ev") in _RECOVERY_EVS]
+    t0: Optional[float] = None
+    out = []
+    for r in rows:
+        phase = r["ev"].split(".", 1)[1]
+        if phase == "detect":
+            t0 = r.get("ts", 0.0)
+        entry = {"ts": r.get("ts", 0.0), "phase": phase,
+                 "rank": r.get("rank", -1)}
+        if r.get("peer", -1) != -1:
+            entry["about_rank"] = r["peer"]
+        if r.get("note"):
+            entry["note"] = r["note"]
+        if t0 is not None:
+            entry["t_plus_s"] = round(entry["ts"] - t0, 3)
+        out.append(entry)
+    return out
+
+
 def render_report(dumps: List[Dict], log_lines: List[Dict] = (),
                   tail: int = 40) -> str:
     names = _msg_names()
@@ -222,6 +253,17 @@ def render_report(dumps: List[Dict], log_lines: List[Dict] = (),
             lines.append(f"  rank {s['rank']}:")
             for ev in s["evidence"]:
                 lines.append(f"    - {ev}")
+    rec = recovery_timeline(dumps, log_lines)
+    if rec:
+        lines.append("recovery timeline (failover plane):")
+        for e in rec:
+            about = (f" rank {e['about_rank']}"
+                     if "about_rank" in e else "")
+            note = f"  {e['note']!r}" if e.get("note") else ""
+            tplus = (f"  (+{e['t_plus_s']:.3f}s)"
+                     if "t_plus_s" in e else "")
+            lines.append(f"  {e['ts']:.6f} rank{e['rank']} "
+                         f"{e['phase']}{about}{note}{tplus}")
     pairs = stuck_pairs(dumps)
     if pairs:
         lines.append("oldest unacked request per (src, dst):")
@@ -275,6 +317,7 @@ def main(argv=None) -> int:
             "ranks": sorted(d["header"].get("rank", -1) for d in dumps),
             "suspects": dead_suspects(dumps),
             "stuck_pairs": stuck_pairs(dumps),
+            "recovery": recovery_timeline(dumps, log_lines),
             "timeline": timeline(dumps, log_lines)[-args.tail:],
         }, indent=1))
     else:

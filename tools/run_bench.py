@@ -73,6 +73,11 @@ _REGRESSION_KEYS = (
     (("get_rows_plane", "small_get_off_p50_ms"), "plain small-get p50"),
     (("get_rows_plane", "big_get_chunked_ms"), "chunked big-get"),
     (("small_add_send_window", "window_on_p50_ms"), "windowed small-add p50"),
+    # elastic failover: recovery-time-to-90%-throughput after a
+    # SIGKILLed shard (tools/bench_chaos.py) — flagged like the skew
+    # growth, never failed: box weather moves it, but a silent 2x
+    # slide in how long a shard stays dark must reach the next session
+    (("chaos", "recovery_s"), "chaos failover recovery time"),
 )
 
 
