@@ -481,6 +481,18 @@ def bench_get_rows_plane(iters: int = 300):
     return _run_result_worker("bench_get_rows.py", [iters])
 
 
+def bench_dlrm_serving(seconds: float = 10.0):
+    """Online-serving bench (ISSUE 8 acceptance): DLRM training writes
+    and a zipf inference storm hit the same sharded embedding table —
+    reads served by a bounded-staleness ReadReplica behind admission
+    control. Records served QPS, p50/p99/p999 tail latency, measured
+    replica staleness (asserted <= the advertised bound in-run), shed
+    rate, and the sketch-estimate-vs-measured cache hit rate; the tool
+    exits nonzero — failing this sub-bench — if replica parity,
+    staleness, or the overload-protection contract broke."""
+    return _run_result_worker("bench_serving.py", [seconds], timeout=420)
+
+
 def bench_chaos_failover(seconds: float = 16.0):
     """Elastic-failover chaos bench (ISSUE 7 acceptance): 2 server
     shards under sustained windowed add/get traffic, SIGKILL one, and
@@ -1053,6 +1065,10 @@ def main() -> None:
         chaos_stats = bench_chaos_failover()
     except Exception as e:
         chaos_stats = {"error": f"{type(e).__name__}: {e}"[:200]}
+    try:
+        serving_stats = bench_dlrm_serving()
+    except Exception as e:
+        serving_stats = {"error": f"{type(e).__name__}: {e}"[:200]}
     # telemetry-plane record: latency HISTOGRAMS of every monitored op
     # this process ran (shutdown resets the dashboard, so snapshot now)
     try:
@@ -1117,6 +1133,7 @@ def main() -> None:
         "small_add_send_window": small_add_stats,
         "get_rows_plane": get_rows_stats,
         "chaos": chaos_stats,
+        "serving": serving_stats,
         "dashboard_hist": dashboard_hist,
         "flightrec_dumps": flightrec_dumps,
     }

@@ -6,8 +6,10 @@ lives in ONE row-sharded MatrixTable, the dot-interaction MLP in one
 ArrayTable, and a single jitted step does gather -> grad -> duplicate-
 accumulating scatter -> server-side AdaGrad.
 
-Run: python examples/dlrm_ctr.py   (8 virtual CPU devices stand in for
-8 chips; the same code runs unchanged on a TPU pod slice.)
+Run: python examples/dlrm_ctr.py [--epochs N] [--samples N]
+(8 virtual CPU devices stand in for 8 chips; the same code runs
+unchanged on a TPU pod slice. The size args exist so the tier-1 smoke
+test can drive a short real run — tests/test_dlrm.py.)
 """
 
 import sys
@@ -29,7 +31,17 @@ from multiverso_tpu.models import dlrm
 from multiverso_tpu.updaters import AddOption
 
 
+def _arg(name: str, default: int) -> int:
+    """--name N from argv (the example's only knobs; everything else
+    routes through mv.init like the app mains)."""
+    if name in sys.argv:
+        return int(sys.argv[sys.argv.index(name) + 1])
+    return default
+
+
 def main() -> int:
+    epochs = _arg("--epochs", 8)
+    samples = _arg("--samples", 16384)
     mv.init()
     cfg = dlrm.DLRMConfig(vocab_sizes=(2000, 2000, 500, 100), embed_dim=16,
                           dense_dim=8, bottom_mlp=(32, 16), top_mlp=(32, 1))
@@ -39,7 +51,7 @@ def main() -> int:
     flat, meta = dlrm.flatten_mlp(dlrm.init_mlp_params(cfg, 0))
     mlp = mv.ArrayTable(flat.size, updater="adagrad", init=flat,
                         name="ctr_mlp")
-    cat, dense, labels = dlrm.synthetic_ctr(cfg, 16384, seed=1)
+    cat, dense, labels = dlrm.synthetic_ctr(cfg, samples, seed=1)
 
     opt = AddOption(learning_rate=0.2, rho=0.1)
     step = jax.jit(dlrm.make_train_step(cfg, emb, mlp, meta, opt, opt),
@@ -47,7 +59,7 @@ def main() -> int:
     es = jax.tree.map(jnp.copy, emb.state)
     ms = jax.tree.map(jnp.copy, mlp.state)
     bs = 512
-    for epoch in range(8):
+    for epoch in range(epochs):
         tot, nb = 0.0, 0
         for i in range(0, len(labels), bs):
             es, ms, loss = step(es, ms, jnp.asarray(cat[i:i + bs]),

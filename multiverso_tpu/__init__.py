@@ -29,7 +29,7 @@ from multiverso_tpu.updaters import (
     AdaGradUpdater, AdamUpdater, AddOption, MomentumUpdater, SGDUpdater,
     Updater, get_updater, register_updater,
 )
-from multiverso_tpu import telemetry
+from multiverso_tpu import serving, telemetry
 from multiverso_tpu.utils import config, dashboard, log
 from multiverso_tpu.zoo import Zoo
 

@@ -47,6 +47,11 @@ from multiverso_tpu.ps import wire
 # their stats_poll_interval_s / failover_* flags are registered before
 # any Zoo.start/argv parse reads them
 from multiverso_tpu.ps import failover as _failover
+# serving plane (read replicas + admission): module-level for the same
+# reason — its serving_* flags must exist before an argv parse, and its
+# replica registry feeds the MSG_STATS "serving" block below. The
+# serving package never imports ps at module scope (no cycle).
+from multiverso_tpu.serving import replica as _serving_replica
 from multiverso_tpu.telemetry import aggregator as _aggregator
 from multiverso_tpu.telemetry import exporter as _exporter
 from multiverso_tpu.telemetry import flightrec as _flight
@@ -98,6 +103,16 @@ MSG_STATS = 0x1B
 # asked. Surfaced as table.server_health(rank) / PSService.health(rank);
 # the native server punts it like MSG_STATS.
 MSG_HEALTH = 0x1C
+# replica subscription pull (serving plane, docs/SERVING.md): one
+# committed full-shard row snapshot + the shard's mutation version as
+# the reply. Request meta: {"table", "since": last seen version,
+# "chunk": rows per sub-frame}. A shard whose version still equals
+# "since" replies a tiny {"unchanged": true} frame — the epoch cadence
+# costs an idle table almost nothing — and big snapshots stream as
+# PR-5 chunked replies. Served off-lock under an epoch pin
+# (shard.export_snapshot); the native C++ server punts it to Python
+# like MSG_STATS (and its meta whitelist rejects "since" regardless).
+MSG_SNAPSHOT = 0x1D
 
 config.define_string("ps_rendezvous", "",
                      "directory for async-PS rank rendezvous (empty = use "
@@ -796,6 +811,16 @@ class PSService:
         payload = _exporter.default_stats_fn()
         payload.update(rank=self.rank, world=self.world, addr=self.addr,
                        shards=shards)
+        # serving plane: this process's read replicas (lag, versions,
+        # cache hit rate, shed counters) — the block mvtop's serving
+        # panel and the cluster aggregator merge. Process-global like
+        # the monitors (same (host, pid) dedupe rule applies there).
+        try:
+            serving = _serving_replica.stats_snapshot()
+            if serving:
+                payload["serving"] = serving
+        except Exception:   # noqa: BLE001 — telemetry never breaks stats
+            pass
         return payload
 
     def stats(self, rank: int, timeout: Optional[float] = None) -> Dict:

@@ -281,6 +281,13 @@ class RowShard:
         self._stat_cow = 0
         self._stat_gets = 0
         self._stat_chunks = 0
+        # replica snapshot pulls served (MSG_SNAPSHOT; serving plane) —
+        # counted apart from gets: a full-table replica pull must not
+        # read as row-get traffic in rates/skew, and its ids never feed
+        # the hot-key sketch (a periodic full sweep would drown the
+        # workload's zipf signal the sketch exists to surface)
+        self._stat_snapshots = 0
+        self._stat_snapshot_unchanged = 0
         # wire-traffic byte counters (stats()["get_bytes"/"add_bytes"]):
         # the cluster aggregator derives wire bytes/s from their deltas.
         # Benign-race increments, same tolerance as _stat_gets above.
@@ -441,6 +448,10 @@ class RowShard:
             # the exactly-once machinery WORKING, not an error
             "dup_frames": self._stat_dup_frames,
             "replay_clients": len(self._replay_seq),
+            # serving plane: replica snapshot pulls answered (and how
+            # many were since-version deduped to an 'unchanged' frame)
+            "snapshots": self._stat_snapshots,
+            "snapshots_unchanged": self._stat_snapshot_unchanged,
         }
         if dirty_rows is not None:
             out["dirty_rows"] = dirty_rows   # sparse-protocol staleness
@@ -1028,6 +1039,69 @@ class RowShard:
                             args={"table": self.name, "full": True})
         return self._encode_reply(full, meta, tr)
 
+    def export_snapshot(self, meta: Dict) -> Tuple[Dict, Any]:
+        """Replica subscription snapshot (MSG_SNAPSHOT; the serving
+        plane's pull primitive, docs/SERVING.md): the shard's committed
+        rows plus the mutation version they correspond to, version and
+        epoch pin taken atomically so the advertised version is exactly
+        the copied bytes'. ``meta["since"]`` = the version the replica
+        already holds — an unchanged shard answers a tiny meta-only
+        frame instead of re-shipping its rows (the epoch cadence is
+        then nearly free on an idle table). The copy runs OFF the shard
+        lock under the pin (applies keep flowing, PR-5), and big
+        snapshots chunk-stream when the request asked
+        (``meta["chunk"]``). Natively-registered shards are safe here
+        because MSG_SNAPSHOT always punts: the punt path's
+        locked_handler holds the native shard mutex around this whole
+        call, so C++ applies cannot mutate the buffer mid-copy (same
+        argument as checkpoint_state, same lock order — native mutex
+        first). Snapshot ids never feed the hot-key sketch: a periodic
+        full sweep would drown the workload's zipf signal."""
+        since = int(meta.get("since", -1))
+        # the dedupe token is (generation, version), never version
+        # alone: a respawned incarnation restores an older checkpoint
+        # and re-applies different ops — its counter can coincide with
+        # the replica's last-seen version while the CONTENT diverged.
+        # The failover plane already stamps each incarnation
+        # (ps_generation, PR 7); a replica holding a different
+        # generation's version must be shipped rows, not "unchanged".
+        gen = int(_config.get_flag("ps_generation"))
+        since_gen = int(meta.get("since_gen", -1))
+        tr = meta.get(wire.TRACE_META_KEY) if _trace.enabled() else None
+        t0 = time.time() if tr is not None else 0.0
+        with self._lock:
+            version = self._version + self._native_stats()[1]
+            if since >= 0 and version == since and since_gen == gen:
+                self._stat_snapshots += 1
+                self._stat_snapshot_unchanged += 1
+                return {"version": version, "gen": gen, "lo": self.lo,
+                        "rows": self.n, "cols": self.num_col,
+                        "unchanged": True}, []
+            pin = self._pin_data_locked()
+        _flight.record(_flight.EV_GET_SERVE,
+                       nbytes=self.n * self.num_col * self.dtype.itemsize)
+        try:
+            full = (pin.data[: self.n].copy() if self._np_mode
+                    else np.asarray(pin.data)[: self.n])
+        finally:
+            self._release_data(pin)
+        self._stat_snapshots += 1
+        if tr is not None:
+            _trace.add_span("shard.snapshot", t0, time.time(), trace=tr,
+                            args={"table": self.name,
+                                  "version": int(version)})
+        rmeta = {"version": int(version), "gen": gen, "lo": self.lo,
+                 "rows": self.n, "cols": self.num_col}
+        emeta, payload = self._encode_reply(full, meta, tr)
+        if isinstance(payload, wire.ChunkedReply):
+            # the service sends ChunkedReply.meta as the closing OK —
+            # the version must ride THAT frame
+            payload.meta.update(rmeta)
+            return payload.meta, payload
+        emeta = dict(emeta)
+        emeta.update(rmeta)
+        return emeta, payload
+
     def _encode_reply(self, rows: np.ndarray, meta: Dict,
                       tr: Optional[int]) -> Tuple[Dict, Any]:
         """Wire-encode a gathered get reply — chunk-streamed when the
@@ -1370,6 +1444,9 @@ class RowShard:
             return {}, []
         if msg_type == svc.MSG_GET_FULL:
             return self._serve_get_full(meta)
+        if msg_type == svc.MSG_SNAPSHOT:
+            # replica subscription pull (serving plane)
+            return self.export_snapshot(meta)
         if msg_type == svc.MSG_GET_STATE:
             # updater-state leaves, full precision (checkpoint plumbing:
             # the sync table persists ustate, table.py store(); async
@@ -1434,6 +1511,18 @@ class HashShard(RowShard):
         with self._lock:
             out["keys"] = len(self._slot_of)
         return out
+
+    def export_snapshot(self, meta: Dict) -> Tuple[Dict, Any]:
+        """Hash shards have no stable global row space to replicate —
+        slot order is allocation order and changes across restores, so
+        a positional snapshot would silently serve the wrong keys.
+        Replica support for keyed tables means shipping (keys, rows)
+        pairs and a keyed replica read path; refuse loudly until that
+        exists rather than serve garbage."""
+        raise svc.PSError(
+            f"{self.name}: read replicas support row-partitioned "
+            "shards only (hash-sharded tables have no stable "
+            "positional row space)")
 
     def _note_rows(self, local: np.ndarray) -> None:
         """No-op: the inherited serve paths reach here with SLOT ids.

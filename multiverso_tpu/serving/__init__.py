@@ -1,0 +1,37 @@
+"""Online-serving plane for the sparse PS: read replicas + admission.
+
+The write-optimized async PS (ps/) serves a *training* mix — windowed
+adds, coalesced applies, read-your-writes gets. A recommender in
+production adds the other half: a read-dominated inference tier pulling
+embedding rows for millions of users while training keeps writing
+(ROADMAP open item 3). Serving those reads from the owning shards
+directly couples inference tail latency to the training write path and
+lets an inference storm starve the optimizer; classic serving systems
+decouple the two with **read replicas** (bounded-staleness copies the
+hot path reads instead) and **admission control** (budget the readers,
+never the trainer). This package is that layer:
+
+* :mod:`multiverso_tpu.serving.replica` — :class:`ReadReplica`: a
+  bounded-staleness copy of one table, refreshed on an epoch cadence
+  through the ``MSG_SNAPSHOT`` subscription RPC (epoch-pinned,
+  chunk-streamed, since-version deduped at the shard), with a
+  device-resident hot-row cache seeded from the PR-6 Space-Saving
+  sketch.
+* :mod:`multiverso_tpu.serving.admission` — per-(table, class)
+  token-bucket QPS limits with priority classes: training traffic is
+  never shed by default, inference reads shed fast and loudly
+  (``table[X].get.shed`` counters, MSG_STATS ``serving`` block).
+
+The app over it is :mod:`multiverso_tpu.apps.dlrm_serving`; the bench
+is ``tools/bench_serving.py``; the operator story is docs/SERVING.md.
+Imported module-level by ps/service.py (like the aggregator) so the
+``serving_*`` flags are registered before any argv parse — nothing
+here imports the ps package at module scope.
+"""
+
+from multiverso_tpu.serving.admission import (AdmissionController,
+                                              SheddingError, TokenBucket)
+from multiverso_tpu.serving.replica import ReadReplica, stats_snapshot
+
+__all__ = ["AdmissionController", "SheddingError", "TokenBucket",
+           "ReadReplica", "stats_snapshot"]

@@ -208,6 +208,50 @@ def merge_cluster(stats_by_rank: Dict[int, Any],
             [int(s.get("adds") or 0) + int(s.get("gets") or 0)
              for s in shards]), 3)
     rec["tables"] = tables
+
+    # serving plane (read replicas + admission, docs/SERVING.md): the
+    # MSG_STATS "serving" block is PROCESS-global like the monitors
+    # (serving/replica.stats_snapshot walks a per-process registry), so
+    # in-process multi-rank worlds dedupe by (host, pid) the same way;
+    # per-replica detail stays keyed by the reporting rank, counters
+    # sum across replica processes.
+    serving: Dict[str, Dict] = {}
+    seen_srv: set = set()
+    for r in sorted(stats_by_rank):
+        st = stats_by_rank[r]
+        if not isinstance(st, dict):
+            continue
+        srv = st.get("serving")
+        if not isinstance(srv, dict):
+            continue
+        pid = st.get("pid")
+        proc = (((st.get("addr") or "").rsplit(":", 1)[0], pid)
+                if pid is not None else ("rank", r))
+        if proc in seen_srv:
+            continue
+        seen_srv.add(proc)
+        for tname, rep in srv.items():
+            if not isinstance(rep, dict):
+                continue
+            ent = serving.setdefault(tname, {
+                "replicas": {}, "served": 0, "shed": 0, "deferred": 0,
+                "cache_hits": 0, "cache_misses": 0})
+            ent["replicas"][str(r)] = {
+                k: rep.get(k) for k in
+                ("epoch", "age_s", "bound_s", "refresh_ms",
+                 "cache_rows", "cache_hit_rate")}
+            for k in ("served", "shed", "deferred", "cache_hits",
+                      "cache_misses"):
+                ent[k] += int(rep.get(k) or 0)
+    if serving:
+        for ent in serving.values():
+            tot = ent["cache_hits"] + ent["cache_misses"]
+            ent["cache_hit_rate"] = (round(ent["cache_hits"] / tot, 4)
+                                     if tot else None)
+            dem = ent["served"] + ent["shed"]
+            ent["shed_rate"] = (round(ent["shed"] / dem, 4)
+                                if dem else None)
+        rec["serving"] = serving
     if hot:
         rec["hotkeys"] = {}
         for tname, sketches in hot.items():
@@ -276,6 +320,21 @@ def derive_rates(prev: Optional[Dict], cur: Dict) -> Optional[Dict]:
                          + int(ps_.get("gets") or 0)), 0)
                   for s, ps_ in pairs]), 3)}
         rates[tname] = d
+    # serving plane: per-table replica-served / shed rates over the
+    # interval, written INTO the serving entries (not the shard-rate
+    # block — a serving-only table must not fabricate shard rates)
+    prev_srv = prev.get("serving") or {}
+    for tname, ent in (cur.get("serving") or {}).items():
+        p = prev_srv.get(tname)
+        if not isinstance(p, dict):
+            continue
+        ent["rates"] = {
+            "served_per_s": round(
+                max(ent.get("served", 0) - p.get("served", 0), 0) / dt,
+                2),
+            "shed_per_s": round(
+                max(ent.get("shed", 0) - p.get("shed", 0), 0) / dt, 2),
+        }
     cur["rates"] = rates
     return rates
 
@@ -312,6 +371,9 @@ def compact_record(rec: Dict, top: int = 8,
             for tname, h in rec["hotkeys"].items()}
     if rec.get("rates"):
         out["rates"] = rec["rates"]
+    if rec.get("serving"):
+        # replica lag/hit-rate/shed summary (already compact)
+        out["serving"] = rec["serving"]
     mons: Dict[str, Any] = {}
     for n, m in sorted(rec.get("monitors", {}).items()):
         if not m.get("timed"):

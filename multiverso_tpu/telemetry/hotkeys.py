@@ -197,20 +197,29 @@ def merge_sketches(dicts: Iterable[Optional[Dict]],
             "items": items[:cap]}
 
 
-def hit_rate_curve(sketch: Dict, points: int = 10) -> List[List[float]]:
+def hit_rate_curve(sketch: Dict, points: int = 10,
+                   conservative: bool = False) -> List[List[float]]:
     """Estimated cache-hit-rate-if-cached curve: ``[[k, rate], ...]`` at
     k = 1, 2, 4, ... — the fraction of sketched row traffic the top-k
     keys would have absorbed had they been device-cached. The direct
     sizing input for a hot-row cache (ROADMAP item 2) and the DLRM
-    hot-user story (item 3); an upper-bound estimate, since Space-Saving
-    counts overestimate within ``err``."""
+    hot-user story (item 3). ``conservative=False`` (default) uses the
+    raw counts — an UPPER-bound estimate, since Space-Saving counts
+    overestimate within ``err`` (materially so when the sketch runs
+    well under capacity-to-distinct-keys: every eviction inherits the
+    minimum); ``conservative=True`` uses ``max(count - err, 0)`` — the
+    guaranteed LOWER bound. Both bound the SKETCHED traffic only: a
+    measured cache-hit rate over a raw request stream (the serving
+    replica's, tools/bench_serving.py) can legitimately exceed even
+    the upper curve, because shards sketch post-dedupe traffic — the
+    curves are a sizing floor for such caches, not a bracket."""
     items = sketch.get("items", [])
     total = sketch.get("total", 0)
     if not items or not total:
         return []
     csum, acc = [], 0
-    for _, c, _ in items:
-        acc += c
+    for _, c, e in items:
+        acc += max(c - e, 0) if conservative else c
         csum.append(acc)
     out: List[List[float]] = []
     k = 1

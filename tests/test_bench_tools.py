@@ -264,6 +264,32 @@ def test_run_bench_flags_skew_growth():
     assert flag_regressions(rec(1.0), {"extra": {}}) == []
 
 
+def test_run_bench_flags_serving_regressions():
+    """ISSUE 8 satellite: run_bench FLAGS (never fails) a >2x
+    run-over-run growth of the serving plane's inference p99 AND a >2x
+    served-QPS DROP (the higher-is-better mirror); missing serving data
+    (errored bench, older record) is skipped."""
+    from tools.run_bench import flag_regressions
+
+    def rec(p99, qps):
+        return {"extra": {"serving": {"infer_p99_ms": p99,
+                                      "served_qps": qps}}}
+
+    assert flag_regressions(rec(5.0, 1000), rec(9.0, 900)) == []
+    # p99 grew 2.4x: flagged
+    flags = flag_regressions(rec(5.0, 1000), rec(12.0, 1000))
+    assert len(flags) == 1 and "serving inference p99" in flags[0]
+    # served QPS dropped 2.5x: flagged (higher-is-better direction)
+    flags = flag_regressions(rec(5.0, 1000), rec(5.0, 400))
+    assert len(flags) == 1 and "serving served QPS" in flags[0]
+    assert "drop" in flags[0]
+    # QPS GROWTH is never flagged, nor is missing data
+    assert flag_regressions(rec(5.0, 1000), rec(5.0, 9000)) == []
+    assert flag_regressions({"extra": {}}, rec(12.0, 100)) == []
+    assert flag_regressions(
+        rec(5.0, 1000), {"extra": {"serving": {"error": "boom"}}}) == []
+
+
 def test_run_bench_flags_chaos_recovery_growth():
     """ISSUE 7 satellite: >2x run-over-run growth of the chaos bench's
     recovery-time-to-full-throughput (extra.chaos.recovery_s) is

@@ -1,0 +1,595 @@
+"""Serving plane (ISSUE 8): MSG_SNAPSHOT subscription RPC, bounded-
+staleness ReadReplica (parity, staleness enforcement, hot-row cache),
+admission control, the MSG_STATS serving block, cluster merge + mvtop
+panel, and the DLRM serving app."""
+
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.ps import service as svc
+from multiverso_tpu.ps.tables import AsyncMatrixTable, AsyncSparseKVTable
+from multiverso_tpu.serving import (AdmissionController, ReadReplica,
+                                    SheddingError, TokenBucket)
+from multiverso_tpu.serving import replica as replica_mod
+from multiverso_tpu.utils import config
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tables(ctxs, rows=64, cols=4, name="srv", **kw):
+    """The same sharded table on both ranks of an in-process world."""
+    return [AsyncMatrixTable(rows, cols, name=name, ctx=c, seed=0,
+                             init_scale=0.1, **kw) for c in ctxs]
+
+
+# ---------------------------------------------------------------------- #
+# MSG_SNAPSHOT: the replica subscription RPC
+# ---------------------------------------------------------------------- #
+class TestSnapshotRPC:
+    def test_snapshot_versions_and_rows(self, two_ranks):
+        t0, _t1 = _tables(two_ranks)
+        # remote shard (rank 1 owns rows [32, 64))
+        meta, arrays = svc.await_reply(
+            two_ranks[0].service.request(
+                1, svc.MSG_SNAPSHOT, {"table": "srv", "since": -1}),
+            30.0, "snapshot")
+        assert meta["lo"] == 32 and meta["rows"] == 32
+        v0 = meta["version"]
+        got = np.asarray(arrays[0], np.float32).reshape(32, 4)
+        direct = t0.get_rows(np.arange(32, 64))
+        np.testing.assert_array_equal(got, direct)
+        # unchanged since (gen, v0): tiny meta-only reply
+        meta2, arrays2 = svc.await_reply(
+            two_ranks[0].service.request(
+                1, svc.MSG_SNAPSHOT, {"table": "srv", "since": v0,
+                                      "since_gen": meta["gen"]}),
+            30.0, "snapshot")
+        assert meta2["unchanged"] and meta2["version"] == v0
+        assert arrays2 == [] or len(arrays2) == 0
+        # a write bumps the version; since=v0 now ships rows again
+        t0.add_rows([40], np.ones((1, 4), np.float32))
+        meta3, arrays3 = svc.await_reply(
+            two_ranks[0].service.request(
+                1, svc.MSG_SNAPSHOT, {"table": "srv", "since": v0,
+                                      "since_gen": meta["gen"]}),
+            30.0, "snapshot")
+        assert meta3["version"] > v0 and not meta3.get("unchanged")
+        got3 = np.asarray(arrays3[0], np.float32).reshape(32, 4)
+        np.testing.assert_array_equal(got3, t0.get_rows(np.arange(32, 64)))
+        # the shard counted both pulls apart from row gets
+        sh = t0.server_stats(1)["shards"]["srv"]
+        assert sh["snapshots"] == 3 and sh["snapshots_unchanged"] == 1
+
+    def test_unchanged_requires_matching_generation(self, two_ranks):
+        """A respawned incarnation restores an older checkpoint and
+        re-applies DIFFERENT ops — its version counter can coincide
+        with a replica's pre-crash version while the content diverged.
+        The dedupe token is therefore (generation, version): a stale
+        generation's version must be shipped rows, never 'unchanged'."""
+        _tables(two_ranks, name="srv_gen")
+        config.set_flag("ps_generation", 3)
+        meta, _ = svc.await_reply(
+            two_ranks[0].service.request(
+                1, svc.MSG_SNAPSHOT,
+                {"table": "srv_gen", "since": -1, "since_gen": 3}),
+            30.0, "snapshot")
+        v, g = meta["version"], meta["gen"]
+        assert g == 3
+        # matching (gen, version): deduped
+        m2, a2 = svc.await_reply(
+            two_ranks[0].service.request(
+                1, svc.MSG_SNAPSHOT,
+                {"table": "srv_gen", "since": v, "since_gen": g}),
+            30.0, "snapshot")
+        assert m2["unchanged"]
+        # same version, STALE generation: rows ship
+        m3, a3 = svc.await_reply(
+            two_ranks[0].service.request(
+                1, svc.MSG_SNAPSHOT,
+                {"table": "srv_gen", "since": v, "since_gen": g - 1}),
+            30.0, "snapshot")
+        assert not m3.get("unchanged") and len(a3) == 1
+
+    def test_snapshot_chunked_stream(self, two_ranks):
+        t0, _t1 = _tables(two_ranks, rows=200, cols=3, name="srv_big")
+        buf = np.empty((100, 3), np.float32)
+
+        def sink(cmeta, arrays):
+            r0, n = int(cmeta["row0"]), int(cmeta["rows"])
+            buf[r0:r0 + n] = np.asarray(arrays[0], np.float32).reshape(
+                n, 3)
+
+        meta, _ = svc.await_reply(
+            two_ranks[0].service.request(
+                1, svc.MSG_SNAPSHOT,
+                {"table": "srv_big", "since": -1, "chunk": 16},
+                chunk_sink=sink),
+            30.0, "snapshot")
+        assert meta["chunks"] == -(-100 // 16)
+        np.testing.assert_array_equal(buf, t0.get_rows(
+            np.arange(100, 200)))
+
+    def test_hash_shard_refuses_snapshot(self, two_ranks):
+        kv = AsyncSparseKVTable(4, name="srv_kv", ctx=two_ranks[0])
+        kv.add_rows([0], np.ones((1, 4), np.float32))   # key 0 -> rank 0
+        fut = two_ranks[0].service.request(
+            two_ranks[0].rank, svc.MSG_SNAPSHOT,
+            {"table": "srv_kv", "since": -1})
+        with pytest.raises(svc.PSError, match="row-partitioned"):
+            svc.await_reply(fut, 30.0, "snapshot")
+
+
+# ---------------------------------------------------------------------- #
+# ReadReplica
+# ---------------------------------------------------------------------- #
+class TestReadReplica:
+    def test_parity_and_versions(self, two_ranks):
+        t0, _t1 = _tables(two_ranks)
+        rep = ReadReplica(t0, start=False, staleness_s=30.0)
+        rep.refresh()
+        ids = np.arange(64)
+        np.testing.assert_array_equal(rep.get_rows(ids), t0.get_rows(ids))
+        # writes on both shards, refresh, exact parity again
+        t0.add_rows([3, 40], np.full((2, 4), 0.25, np.float32))
+        rep.refresh()
+        np.testing.assert_array_equal(rep.get_rows(ids), t0.get_rows(ids))
+        st = rep.stats()
+        for rank in (0, 1):
+            shard_v = t0.server_stats(rank)["shards"]["srv"]["version"]
+            assert st["versions"][str(rank)] == shard_v
+        rep.close()
+
+    def test_unchanged_pulls_are_deduped(self, two_ranks):
+        t0, _t1 = _tables(two_ranks)
+        rep = ReadReplica(t0, start=False, staleness_s=30.0)
+        rep.refresh()
+        rep.refresh()   # nothing applied: both shards answer unchanged
+        assert rep.stats()["unchanged_pulls"] == 2
+        # the snapshot buffer is REUSED on an all-unchanged epoch (no
+        # copy churn), and the epoch still advances
+        assert rep.stats()["epoch"] == 2
+        rep.close()
+
+    def test_staleness_bound_enforced(self, two_ranks):
+        # bound 0.5s: comfortably above a loaded box's pull time (a
+        # bound near the pull cost would test scheduler weather, not
+        # the enforcement)
+        t0, _t1 = _tables(two_ranks)
+        rep = ReadReplica(t0, start=False, staleness_s=0.5)
+        rep.refresh()
+        t0.add_rows([5], np.ones((1, 4), np.float32))
+        time.sleep(0.7)   # snapshot now over bound
+        rows, age = rep.get_rows([5], with_age=True)
+        # the read REFRESHED before serving: fresh data, in-bound age
+        assert age <= 0.5
+        np.testing.assert_array_equal(rows, t0.get_rows([5]))
+        assert rep.stats()["deferred"] >= 1
+        rep.close()
+
+    def test_concurrent_stale_readers_share_one_pull(self, two_ranks):
+        """K readers finding the snapshot over bound must be satisfied
+        by ONE pull, not perform K serialized full-table pulls against
+        the (already slow) owner: the deferred-refresh path relaxes
+        the single-flight dedupe to 'any pull started within the
+        bound'."""
+        t0, _t1 = _tables(two_ranks, name="srv_share")
+        rep = ReadReplica(t0, start=False, staleness_s=0.5)
+        rep.refresh()
+        time.sleep(0.7)   # over bound for everyone at once
+        e0 = rep.stats()["epoch"]
+        errs = []
+
+        def read():
+            try:
+                rep.get_rows([1], cls="train")
+            except Exception as e:   # noqa: BLE001
+                errs.append(e)
+
+        ths = [threading.Thread(target=read) for _ in range(6)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=30)
+        assert not errs, errs[:2]
+        # one pull (two at most, if a reader raced the bound edge)
+        assert rep.stats()["epoch"] - e0 <= 2, rep.stats()["epoch"] - e0
+        assert rep.stats()["deferred"] >= 1
+        rep.close()
+
+    def test_background_refresh_thread(self, two_ranks):
+        t0, _t1 = _tables(two_ranks)
+        rep = ReadReplica(t0, refresh_s=0.05, staleness_s=5.0)
+        try:
+            t0.add_rows([9], np.ones((1, 4), np.float32))
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if rep.stats()["epoch"] >= 2 and np.array_equal(
+                        rep.get_rows([9]), t0.get_rows([9])):
+                    break
+                time.sleep(0.05)
+            else:
+                pytest.fail("background refresh never caught up")
+        finally:
+            rep.close()
+
+    def test_out_buffer_fill(self, two_ranks):
+        t0, _t1 = _tables(two_ranks)
+        rep = ReadReplica(t0, start=False, staleness_s=30.0)
+        rep.refresh()
+        out = np.empty((5, 4), np.float32)
+        got = rep.get_rows([1, 2, 33, 40, 63], out=out)
+        assert got is out
+        np.testing.assert_array_equal(out,
+                                      t0.get_rows([1, 2, 33, 40, 63]))
+        rep.close()
+
+    def test_reads_served_while_writes_flow(self, two_ranks):
+        """Concurrent writer + replica reader: every read returns an
+        internally consistent epoch (rows from one adopted snapshot,
+        never a torn mix) — checked via a row pair written atomically
+        in one add frame, which must always agree."""
+        t0, _t1 = _tables(two_ranks, rows=16, cols=2, name="srv_tear")
+        # establish the invariant before any reader runs: the random
+        # init's two columns differ, writes keep them equal
+        t0.set_rows([2], np.zeros((1, 2), np.float32))
+        rep = ReadReplica(t0, start=False, staleness_s=30.0)
+        rep.refresh()
+        stop = threading.Event()
+        errs = []
+
+        def writer():
+            k = 0.0
+            while not stop.is_set():
+                k += 1.0
+                # rows 2 (rank 0) is written with a single value; the
+                # replica must serve each snapshot's bytes verbatim
+                t0.set_rows([2], np.full((1, 2), k, np.float32))
+                rep.refresh()
+
+        def reader():
+            while not stop.is_set():
+                r = rep.get_rows([2], cls="train")
+                if r[0, 0] != r[0, 1]:   # torn within one row/epoch
+                    errs.append(r.copy())
+
+        ths = [threading.Thread(target=writer, daemon=True),
+               threading.Thread(target=reader, daemon=True)]
+        for t in ths:
+            t.start()
+        time.sleep(0.7)
+        stop.set()
+        for t in ths:
+            t.join(timeout=10)
+        assert not errs, errs[:3]
+        rep.close()
+
+
+# ---------------------------------------------------------------------- #
+# hot-row cache (sketch-seeded, epoch-consistent)
+# ---------------------------------------------------------------------- #
+class TestHotRowCache:
+    def test_cache_seeded_and_counted(self, two_ranks):
+        # adagrad shards never register natively (PR-6 rule), so the
+        # serve path — and therefore the hot-key sketch that seeds the
+        # cache — stays python-deterministic on BOTH wire planes
+        t0, _t1 = _tables(two_ranks, rows=64, cols=4, name="srv_hot",
+                          updater="adagrad")
+        # make rows 7 and 50 hot on the shards' sketches (shard traffic
+        # is what seeds the cache)
+        for _ in range(20):
+            t0.get_rows([7, 50])
+        rep = ReadReplica(t0, start=False, staleness_s=30.0,
+                          cache_rows=8)
+        rep.refresh()
+        st = rep.stats()
+        assert st["cache_rows"] > 0
+        # a fully-cached request serves from the device array, bytes
+        # equal to the host snapshot (same epoch by construction)
+        dev = rep.cache_lookup([7, 50])
+        assert dev is not None
+        np.testing.assert_array_equal(np.asarray(dev),
+                                      t0.get_rows([7, 50]))
+        # an uncached id misses the device path
+        cold = int(np.setdiff1d(np.arange(64),
+                                np.asarray(rep._cache_ids))[0])
+        assert rep.cache_lookup([7, cold]) is None
+        # hit/miss accounting over a mixed request
+        h0, m0 = rep.stats()["cache_hits"], rep.stats()["cache_misses"]
+        rep.get_rows([7, 50, cold])
+        st = rep.stats()
+        assert st["cache_hits"] - h0 == 2
+        assert st["cache_misses"] - m0 == 1
+        rep.close()
+
+    def test_cache_follows_snapshot_epoch(self, two_ranks):
+        t0, _t1 = _tables(two_ranks, rows=64, cols=4, name="srv_hot2",
+                          updater="adagrad")
+        for _ in range(10):
+            t0.get_rows([3])
+        rep = ReadReplica(t0, start=False, staleness_s=30.0,
+                          cache_rows=4)
+        rep.refresh()
+        assert rep.cache_lookup([3]) is not None
+        t0.add_rows([3], np.ones((1, 4), np.float32))
+        rep.refresh()
+        np.testing.assert_array_equal(np.asarray(rep.cache_lookup([3])),
+                                      t0.get_rows([3]))
+        rep.close()
+
+
+# ---------------------------------------------------------------------- #
+# admission control
+# ---------------------------------------------------------------------- #
+class TestAdmission:
+    def test_token_bucket_refill(self):
+        b = TokenBucket(10.0, burst=2.0)
+        t = 1000.0
+        assert b.try_acquire(now=t) and b.try_acquire(now=t)
+        assert not b.try_acquire(now=t)          # burst drained
+        assert b.try_acquire(now=t + 0.1)        # 1 token refilled
+        assert not b.try_acquire(now=t + 0.1)
+        # refill caps at burst even after a long idle
+        assert b.try_acquire(now=t + 100.0, n=2.0)
+        assert not b.try_acquire(now=t + 100.0)
+
+    def test_clock_never_rewinds_tokens(self):
+        b = TokenBucket(10.0, burst=1.0)
+        assert b.try_acquire(now=1000.0)
+        # an out-of-order timestamp must not mint negative refill
+        assert not b.try_acquire(now=999.0)
+        assert b.try_acquire(now=1000.2)
+
+    def test_priority_classes(self):
+        adm = AdmissionController()
+        adm.set_limit("t", "infer", 1.0, burst=1.0)
+        assert adm.admit("t", "infer")
+        assert not adm.admit("t", "infer")       # over budget: shed
+        for _ in range(50):                       # train NEVER sheds
+            assert adm.admit("t", "train")
+        st = adm.stats()
+        assert st["t/infer"]["shed"] == 1
+        assert st["t/infer"]["admitted"] == 1
+        assert st["t/train"]["shed"] == 0
+        assert st["t/train"]["qps_limit"] is None
+
+    def test_flag_default_limit(self):
+        config.set_flag("serving_infer_qps", 1.0)
+        adm = AdmissionController()
+        assert adm.admit("x", "infer")            # burst of 1
+        assert not adm.admit("x", "infer")
+        assert adm.admit("x", "train")            # flag gates infer only
+
+    def test_explicit_exemption_beats_flag_default(self):
+        """set_limit(table, 'infer', 0) is an EXEMPTION, not just a
+        removal: it must override the serving_infer_qps flag default,
+        or the lazy default silently reinstalls the limit on the next
+        admit and one table can never be opted out."""
+        config.set_flag("serving_infer_qps", 1.0)
+        adm = AdmissionController()
+        adm.set_limit("x", "infer", 0)
+        for _ in range(20):
+            assert adm.admit("x", "infer")
+        # other tables still get the flag default
+        assert adm.admit("y", "infer")
+        assert not adm.admit("y", "infer")
+
+    def test_unknown_class_rejected(self):
+        with pytest.raises(ValueError, match="admission class"):
+            AdmissionController().set_limit("t", "batch", 1.0)
+
+    def test_replica_integration_sheds_and_counts(self, two_ranks):
+        t0, _t1 = _tables(two_ranks, name="srv_adm")
+        adm = AdmissionController()
+        adm.set_limit("srv_adm", "infer", 1.0, burst=1.0)
+        rep = ReadReplica(t0, start=False, staleness_s=30.0,
+                          admission=adm)
+        rep.refresh()
+        rep.get_rows([1])
+        with pytest.raises(SheddingError):
+            rep.get_rows([1])
+        rep.get_rows([1], cls="train")   # priority traffic unaffected
+        st = rep.stats()
+        assert st["shed"] == 1 and st["served"] == 2
+        assert st["admission"]["srv_adm/infer"]["shed"] == 1
+        # the Dashboard counters behind the zoo shutdown report
+        from multiverso_tpu.utils.dashboard import Dashboard
+        assert Dashboard.get("table[srv_adm].get.shed").count == 1
+        assert Dashboard.get("table[srv_adm].get.replica").count == 2
+        rep.close()
+
+
+# ---------------------------------------------------------------------- #
+# telemetry surfaces: MSG_STATS block, cluster merge, mvtop panel
+# ---------------------------------------------------------------------- #
+class TestServingTelemetry:
+    def test_stats_payload_and_msg_stats(self, two_ranks):
+        t0, _t1 = _tables(two_ranks, name="srv_tel")
+        rep = ReadReplica(t0, start=False, staleness_s=30.0)
+        rep.refresh()
+        rep.get_rows([1], cls="train")
+        # local payload
+        local = two_ranks[0].service.stats_payload()
+        assert local["serving"]["srv_tel"]["served"] == 1
+        # over the socket: rank 1 pulls rank 0's stats via MSG_STATS
+        remote = two_ranks[1].service.stats(0)
+        assert remote["serving"]["srv_tel"]["epoch"] == 1
+        assert remote["serving"]["srv_tel"]["bound_s"] == 30.0
+        rep.close()
+
+    def test_merge_cluster_serving_block(self):
+        from multiverso_tpu.telemetry import aggregator
+        rep_stats = {"epoch": 5, "age_s": 0.1, "bound_s": 2.0,
+                     "refresh_ms": 3.0, "cache_rows": 8,
+                     "cache_hit_rate": 0.5, "served": 100, "shed": 10,
+                     "deferred": 1, "cache_hits": 50, "cache_misses": 50}
+        mk = lambda rank, pid: {   # noqa: E731
+            "rank": rank, "pid": pid, "addr": f"127.0.0.1:{9000 + rank}",
+            "monitors": {}, "shards": {},
+            "serving": {"emb": dict(rep_stats)}}
+        # two ranks, same process: the block dedupes by (host, pid)
+        rec = aggregator.merge_cluster(
+            {0: mk(0, 42), 1: mk(1, 42)}, {0: {}, 1: {}}, world=2)
+        assert rec["serving"]["emb"]["served"] == 100
+        # two processes: counters sum
+        rec2 = aggregator.merge_cluster(
+            {0: mk(0, 42), 1: mk(1, 43)}, {0: {}, 1: {}}, world=2)
+        ent = rec2["serving"]["emb"]
+        assert ent["served"] == 200 and ent["shed"] == 20
+        assert ent["shed_rate"] == round(20 / 220, 4)
+        assert ent["cache_hit_rate"] == 0.5
+        assert set(ent["replicas"]) == {"0", "1"}
+
+    def test_derive_rates_serving(self):
+        from multiverso_tpu.telemetry import aggregator
+        prev = {"kind": "cluster", "ts": 100.0, "tables": {},
+                "serving": {"emb": {"served": 100, "shed": 0}}}
+        cur = {"kind": "cluster", "ts": 102.0, "tables": {},
+               "serving": {"emb": {"served": 300, "shed": 20}}}
+        aggregator.derive_rates(prev, cur)
+        assert cur["serving"]["emb"]["rates"]["served_per_s"] == 100.0
+        assert cur["serving"]["emb"]["rates"]["shed_per_s"] == 10.0
+
+    def test_mvtop_serving_panel(self):
+        sys.path.insert(0, os.path.join(_REPO, "tools"))
+        import mvtop
+        rec = {
+            "kind": "cluster", "ts": time.time(), "world": 2,
+            "polled": 2,
+            "ranks": {"0": {"status": "ok", "addr": "a:1"},
+                      "1": {"status": "ok", "addr": "b:2"}},
+            "monitors": {},
+            "tables": {"emb": {"shards": {"0": {}, "1": {}},
+                               "adds": 5, "gets": 9, "applies": 5,
+                               "queue_depth": 0, "skew": 1.0,
+                               "apply": {}}},
+            "serving": {"emb": {
+                "replicas": {"0": {"epoch": 7, "age_s": 0.12,
+                                   "bound_s": 2.0, "refresh_ms": 3.1,
+                                   "cache_rows": 64,
+                                   "cache_hit_rate": 0.83}},
+                "served": 1234, "shed": 26, "deferred": 1,
+                "cache_hits": 100, "cache_misses": 20,
+                "cache_hit_rate": 0.8333, "shed_rate": 0.0206,
+                "rates": {"served_per_s": 45.2, "shed_per_s": 1.0}}},
+        }
+        out = mvtop.render(rec)
+        assert "serving: replicas=1" in out
+        assert "served 1234 (45.2/s)" in out
+        assert "shed_rate 2.1%" in out
+        assert "replica@rank0: epoch 7  lag 0.120s/2.000s bound" in out
+        assert "cache 64 rows (83.0% hit)" in out
+        # a serving-only table (owners unreachable this poll) renders
+        rec2 = dict(rec, tables={})
+        assert "(serving only)" in mvtop.render(rec2)
+
+    def test_dump_metrics_cluster_serving_section(self):
+        sys.path.insert(0, os.path.join(_REPO, "tools"))
+        import dump_metrics
+        rec = {"kind": "cluster", "ts": 1.0, "world": 1, "polled": 1,
+               "ranks": {"0": {"status": "ok"}}, "tables": {},
+               "serving": {"emb": {
+                   "replicas": {"0": {"epoch": 3, "age_s": 0.1,
+                                      "bound_s": 2.0}},
+                   "served": 10, "shed": 1, "deferred": 0,
+                   "cache_hits": 4, "cache_misses": 6,
+                   "cache_hit_rate": 0.4, "shed_rate": 0.0909}}}
+        out = dump_metrics.format_cluster_record(rec)
+        assert "serving[emb]:" in out and "served=10" in out
+        assert "replica@rank0:" in out and "epoch=3" in out
+
+    def test_hit_rate_curve_conservative(self):
+        from multiverso_tpu.telemetry import hotkeys
+        sk = {"capacity": 4, "total": 100, "observed": 100,
+              "items": [[1, 50, 0], [2, 30, 20], [3, 10, 10]]}
+        up = dict(hotkeys.hit_rate_curve(sk))
+        lo = dict(hotkeys.hit_rate_curve(sk, conservative=True))
+        assert up[1] == 0.5 and lo[1] == 0.5
+        assert up[2] == 0.8 and lo[2] == 0.6    # err-discounted
+        assert lo[2] <= up[2]
+
+
+# ---------------------------------------------------------------------- #
+# the DLRM serving app
+# ---------------------------------------------------------------------- #
+class TestDLRMServingApp:
+    def test_train_while_serve(self, two_ranks):
+        from multiverso_tpu.apps.dlrm_serving import DLRMServing
+        from multiverso_tpu.models import dlrm
+        cfg = dlrm.DLRMConfig(vocab_sizes=(32, 16), embed_dim=8,
+                              dense_dim=4, bottom_mlp=(8,),
+                              top_mlp=(8, 1))
+        app = DLRMServing(cfg, ctx=two_ranks[0], name="app_t", lr=0.2,
+                          staleness_s=30.0, start_replica=False)
+        peer = AsyncMatrixTable(dlrm.total_rows(cfg), cfg.embed_dim,
+                                updater="adagrad", seed=0,
+                                init_scale=0.05, name=app.emb.name,
+                                ctx=two_ranks[1])
+        cat, dense, labels = dlrm.synthetic_ctr(cfg, 512, seed=3)
+        losses = []
+        for i in range(8):
+            loss, write_ms = app.train_step(cat[i * 64:(i + 1) * 64],
+                                            dense[i * 64:(i + 1) * 64],
+                                            labels[i * 64:(i + 1) * 64])
+            assert write_ms >= 0
+            losses.append(loss)
+        assert losses[-1] < losses[0], losses
+        # the inference path: replica rows -> forward -> probabilities
+        app.replica.refresh()
+        scores = app.infer(cat[:16], dense[:16])
+        assert scores.shape == (16,)
+        assert np.all((scores >= 0) & (scores <= 1))
+        # replica parity against the trained table
+        ids = np.arange(dlrm.total_rows(cfg))
+        np.testing.assert_array_equal(
+            app.replica.get_rows(ids, cls="train"), app.emb.get_rows(ids))
+        assert app.serving_stats()["served"] >= 2
+        app.close()
+        del peer
+
+
+# ---------------------------------------------------------------------- #
+# the bench tool (acceptance smoke at toy scale)
+# ---------------------------------------------------------------------- #
+def test_bench_serving_smoke():
+    """tools/bench_serving.py end to end at tier-1 scale through the
+    real subprocess contract: every acceptance gate (replica parity,
+    staleness bound, overload shed + bounded train degradation) is an
+    IN-RUN assert, so rc 0 means the serving plane held its whole
+    contract under real two-class traffic."""
+    import json
+    import subprocess
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    def run_once():
+        return subprocess.run(
+            [sys.executable,
+             os.path.join(_REPO, "tools", "bench_serving.py"),
+             "5", "3", "2"],
+            capture_output=True, text=True, timeout=300, env=env,
+            cwd=_REPO)
+
+    out = run_once()
+    if out.returncode != 0:
+        # the overload-degradation ratio is weather-bound (GIL
+        # scheduling on a loaded CI box): retry ONCE, same pattern as
+        # the chaos bench's slow test — the parity/staleness gates
+        # stay strict per run
+        out = run_once()
+    assert out.returncode == 0, (out.stdout[-500:], out.stderr[-800:])
+    line = [x for x in out.stdout.splitlines()
+            if x.startswith("RESULT ")][-1]
+    r = json.loads(line[len("RESULT "):])
+    assert r["parity_bit_for_bit"] and r["versions_match"]
+    assert r["staleness_ok"]
+    assert r["staleness_max_s"] <= r["staleness_bound_s"]
+    assert r["overload_contract_ok"] and r["shed_overload"] > 0
+    assert r["served_qps"] > 0 and r["infer_p99_ms"] > 0
+    assert r["cache"]["measured_hit_rate"] is not None
+    assert r["cache"]["estimated_hit_rate"] is not None

@@ -1,0 +1,436 @@
+#!/usr/bin/env python
+"""Online-serving bench: DLRM training writes vs a zipf inference storm.
+
+The read-dominated half of the PS story (ROADMAP open item 3;
+docs/SERVING.md). One process hosts a 2-rank async-PS world (every
+cross-rank op crosses a real localhost socket, the tier-2 fixture
+shape); the DLRM embedding table is row-sharded over both ranks, and
+two traffic classes hit it concurrently:
+
+* ``train_threads`` training workers run real DLRM steps — gather the
+  minibatch rows from the shards, jitted grad, push row-gradient
+  deltas as blocking adds (the ack means applied; its latency is the
+  bench's PROTECTED metric);
+* ``infer_threads`` inference clients hammer the bounded-staleness
+  :class:`ReadReplica` with a zipf key distribution (hot users — ONE
+  shared rank->id permutation, so training and inference agree on who
+  is hot, as they do in production), recording per-request latency,
+  the served snapshot's age, and admission sheds.
+
+Three phases: **calibration** (unpaced, no admission — measures the
+achievable inference rate UNDER the concurrent training load, which is
+what the admission budget must be set against; an unloaded calibration
+would pick a limit the loaded plane never reaches), then **steady**
+(paced inside the admission budget; shed-free), then **overload**
+(unpaced — demand far over the token-bucket limit). The acceptance
+contract is asserted IN-RUN:
+
+* measured replica staleness <= the advertised bound on every served
+  read;
+* replica-served bytes bit-identical to a direct shard read at the
+  advertised version (writes quiesced, one final refresh, full-table
+  compare);
+* the admission plane SHED inference load during overload while the
+  training-write p50 degraded <= 2x its steady value.
+
+It also closes the PR-6 loop: the Space-Saving sketch's
+cache-hit-if-cached ESTIMATE (at the replica cache's size) is recorded
+side by side with the cache's MEASURED hit rate (counted from overload
+start, after the sketch-seeded cache has warmed).
+
+    python tools/bench_serving.py [seconds] [infer_threads] [train_threads]
+
+Prints ``RESULT <json>`` (the bench.py worker contract); exits nonzero
+when an acceptance assert fails — a serving bench whose staleness or
+parity story broke must fail loudly, not record a QPS number.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+TABLE_BASE = "dlrm_srv"
+CACHE_ROWS = 128
+REFRESH_S = 0.2
+BOUND_S = 1.0
+ZIPF_A = 1.2
+# client backoff after a shed (retry-after): long enough that shed
+# ATTEMPTS don't themselves churn the GIL against the training plane —
+# shedding protects training only if refused clients actually yield
+SHED_BACKOFF_S = 0.005
+PHASES = ("calib", "steady", "overload")
+
+
+def _zipf_sampler(rng: np.random.Generator, n: int, perm: np.ndarray,
+                  a: float = ZIPF_A):
+    """Bounded zipf over [0, n): rank-frequency p(k) ~ 1/k^a. ``perm``
+    is the rank->id mapping — SHARED across every sampler in the run,
+    so all traffic classes agree on which ids are hot (each caller
+    still draws from its own rng)."""
+    p = 1.0 / np.arange(1, n + 1, dtype=np.float64) ** a
+    p /= p.sum()
+
+    def sample(size: int) -> np.ndarray:
+        return perm[rng.choice(n, size=size, p=p)]
+
+    return sample
+
+
+def _pct(samples, q):
+    return round(float(np.percentile(np.asarray(samples), q)), 4) \
+        if len(samples) else None
+
+
+def main(argv) -> int:
+    seconds = float(argv[0]) if argv else 10.0
+    infer_threads = int(argv[1]) if len(argv) > 1 else 4
+    train_threads = int(argv[2]) if len(argv) > 2 else 2
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import tempfile
+
+    from multiverso_tpu.apps.dlrm_serving import DLRMServing
+    from multiverso_tpu.models import dlrm
+    from multiverso_tpu.ps.service import (FileRendezvous, PSContext,
+                                           PSService)
+    from multiverso_tpu.ps.tables import AsyncMatrixTable
+    from multiverso_tpu.serving.admission import SheddingError
+    from multiverso_tpu.telemetry import hotkeys as hotkeys_mod
+    from multiverso_tpu.utils import config
+
+    config.set_flag("ps_timeout", 60.0)
+    config.set_flag("serving_snapshot_chunk_rows", 2048)
+    # sketch capacity sized to the workload's distinct-key count
+    # (~5.4k): at the 128 default every eviction inherits the min and
+    # the top-K counts overestimate several-fold — the estimate the
+    # bench validates would be an artifact of sketch pressure, not of
+    # the traffic (read BEFORE the shards construct)
+    config.set_flag("hotkeys_capacity", 1024)
+    rdv = FileRendezvous(tempfile.mkdtemp(prefix="mv_serving_"))
+    ctxs = [PSContext(r, 2, PSService(r, 2, rdv)) for r in range(2)]
+    cfg = dlrm.DLRMConfig(vocab_sizes=(4096, 1024, 256, 64),
+                          embed_dim=16, dense_dim=8,
+                          bottom_mlp=(32, 16), top_mlp=(16, 1))
+    app = DLRMServing(cfg, ctx=ctxs[0], name=TABLE_BASE, lr=0.05,
+                      cache_rows=CACHE_ROWS, refresh_s=REFRESH_S,
+                      staleness_s=BOUND_S)
+    # rank 1's half of the sharded embedding table (same seed: each
+    # shard inits its own rows from (seed, lo))
+    peer = AsyncMatrixTable(dlrm.total_rows(cfg), cfg.embed_dim,
+                            updater="adagrad", seed=0, init_scale=0.05,
+                            name=app.emb.name, ctx=ctxs[1])
+    table = app.emb.name
+
+    cat, dense, labels = dlrm.synthetic_ctr(cfg, 8192, seed=2)
+    # the ONE hot-user permutation every sampler shares
+    perm = np.random.default_rng(13).permutation(cfg.vocab_sizes[0])
+    zipf_train = _zipf_sampler(np.random.default_rng(11),
+                               cfg.vocab_sizes[0], perm)
+    # training's field-0 traffic rides the SAME zipf head as inference
+    # (hot users are hot everywhere), so the shard-side sketch — which
+    # only ever sees shard traffic, never replica-served reads — ranks
+    # the head the inference mix hits
+    cat[:, 0] = zipf_train(len(cat))
+
+    # ---------------- warmup: compile everything once ----------------- #
+    app.train_step(cat[:64], dense[:64], labels[:64])
+    app.replica.refresh()
+    app.infer(cat[:16], dense[:16])
+
+    # ---------------- the two-class traffic run ----------------------- #
+    stop = threading.Event()
+    ctl = {"phase": "calib", "pace": 0.0}   # workers read, main writes
+    results = []   # per-thread dicts, merged after the join
+    losses = []
+
+    def train_worker(j: int) -> None:
+        r = np.random.default_rng(100 + j)
+        my = {"write_ms": {p: [] for p in PHASES}, "errors": 0}
+        results.append(my)
+        bs = 64
+        while not stop.is_set():
+            idx = r.integers(0, len(labels), bs)
+            try:
+                loss, write_ms = app.train_step(cat[idx], dense[idx],
+                                                labels[idx])
+            except Exception:   # noqa: BLE001 — counted, not fatal
+                my["errors"] += 1
+                continue
+            losses.append(loss)
+            my["write_ms"][ctl["phase"]].append(write_ms)
+
+    def infer_worker(j: int) -> None:
+        r = np.random.default_rng(200 + j)
+        zipf = _zipf_sampler(np.random.default_rng(300 + j),
+                             cfg.vocab_sizes[0], perm)
+        my = {"lat_ms": {p: [] for p in PHASES},
+              "served": {p: 0 for p in PHASES},
+              "shed": {p: 0 for p in PHASES},
+              "age_max": 0.0, "errors": 0}
+        results.append(my)
+        B = 16
+        next_t = time.perf_counter()
+        while not stop.is_set():
+            c = np.stack(
+                [zipf(B)] + [r.integers(0, v, B)
+                             for v in cfg.vocab_sizes[1:]], axis=1)
+            ids = app._ids(c)
+            ph = ctl["phase"]
+            t0 = time.perf_counter()
+            try:
+                _rows, age = app.replica.get_rows(ids, with_age=True)
+            except SheddingError:
+                my["shed"][ph] += 1
+                time.sleep(SHED_BACKOFF_S)
+                continue
+            except Exception:   # noqa: BLE001
+                my["errors"] += 1
+                continue
+            my["lat_ms"][ph].append((time.perf_counter() - t0) * 1e3)
+            my["served"][ph] += 1
+            my["age_max"] = max(my["age_max"], age)
+            if my["served"][ph] % 64 == 0:
+                # every so often, the full app path (replica rows ->
+                # jitted forward -> scores): the serving story is an
+                # APP, not a gather microbench
+                try:
+                    app.infer(c, dense[: B])
+                except SheddingError:
+                    my["shed"][ph] += 1
+                except Exception:   # noqa: BLE001 — a transient owner
+                    # timeout in the deferred-refresh path must be
+                    # COUNTED, not kill this daemon worker silently
+                    # (the surviving threads would then report a
+                    # phantom served-QPS drop with errors=0)
+                    my["errors"] += 1
+            pace = ctl["pace"]
+            if pace > 0 and ph == "steady":
+                next_t = max(next_t + pace, time.perf_counter() - pace)
+                dt = next_t - time.perf_counter()
+                if dt > 0:
+                    time.sleep(dt)
+            # calib/overload: unpaced — demand is whatever the loop
+            # can push through
+
+    threads = [threading.Thread(target=train_worker, args=(j,),
+                                daemon=True)
+               for j in range(train_threads)]
+    threads += [threading.Thread(target=infer_worker, args=(j,),
+                                 daemon=True)
+                for j in range(infer_threads)]
+    calib_s = 1.0
+    steady_s = max(seconds * 0.5, 2.0)
+    overload_s = max(seconds * 0.5, 2.0)
+    for th in threads:
+        th.start()
+    # phase 1 — calibration: unpaced, no admission limit installed.
+    # Measures the achievable inference rate UNDER the training load;
+    # the budget derives from this, not from an unloaded microbench.
+    time.sleep(calib_s)
+    calib_served = sum(my["served"]["calib"] for my in results
+                       if "served" in my)
+    loaded_qps = max(calib_served / calib_s, 50.0)
+    # budget well under the achievable rate, steady paced AT ~the
+    # budget: overload then admits the same inference load steady
+    # carried (so the training plane feels no extra admitted work) and
+    # sheds the rest — which is exactly the protection contract the
+    # overload phase asserts
+    limit_qps = loaded_qps * 0.3
+    steady_qps = limit_qps * 0.95
+    # small burst: overload's admitted traffic then arrives nearly as
+    # evenly as steady's paced traffic, so the two phases put the SAME
+    # admitted load on the box and the degradation ratio isolates what
+    # the shed path itself costs
+    app.admission.set_limit(table, "infer", limit_qps,
+                            burst=max(limit_qps * 0.1, 2.0))
+    ctl["pace"] = infer_threads / steady_qps
+    ctl["phase"] = "steady"
+    time.sleep(steady_s)
+    # cache-hit accounting baseline: measured hit rate is counted from
+    # HERE (cache seeded + reseeded during steady; counting the cold
+    # start would understate what the warmed cache absorbs)
+    rs0 = app.replica.stats()
+    h0, m0 = rs0["cache_hits"], rs0["cache_misses"]
+    ctl["pace"] = 0.0
+    ctl["phase"] = "overload"
+    time.sleep(overload_s)
+    stop.set()
+    for th in threads:
+        th.join(timeout=60)
+    # cache measurement window closes HERE, before the parity sweep
+    # below reads the whole table through the replica (a 5.4k-row
+    # uniform sweep over a 128-row cache would dilute the measured
+    # workload hit rate with a non-workload artifact)
+    rs1 = app.replica.stats()
+    dh = rs1["cache_hits"] - h0
+    dm = rs1["cache_misses"] - m0
+
+    # ---------------- merge + derive ---------------------------------- #
+    train_ms = {p: [] for p in PHASES}
+    infer_ms = {p: [] for p in PHASES}
+    served = {p: 0 for p in PHASES}
+    shed = {p: 0 for p in PHASES}
+    age_max = 0.0
+    errors = 0
+    for my in results:
+        errors += my.get("errors", 0)
+        if "write_ms" in my:
+            for p in PHASES:
+                train_ms[p].extend(my["write_ms"][p])
+        else:
+            for p in PHASES:
+                infer_ms[p].extend(my["lat_ms"][p])
+                served[p] += my["served"][p]
+                shed[p] += my["shed"][p]
+            age_max = max(age_max, my["age_max"])
+
+    all_infer = infer_ms["steady"] + infer_ms["overload"]
+    train_p50_steady = _pct(train_ms["steady"], 50)
+    train_p50_overload = _pct(train_ms["overload"], 50)
+    degradation = (round(train_p50_overload / train_p50_steady, 3)
+                   if train_p50_steady and train_p50_overload else None)
+    demand_overload = served["overload"] + shed["overload"]
+    shed_rate_overload = (round(shed["overload"] / demand_overload, 4)
+                          if demand_overload else 0.0)
+
+    # ---------------- parity at the advertised version ---------------- #
+    # writes are quiesced (threads joined, blocking adds all acked);
+    # one final refresh pins the replica at the shards' final version,
+    # and the full-table compare must be bit-for-bit
+    app.emb.flush()
+    app.replica.refresh()
+    all_ids = np.arange(dlrm.total_rows(cfg))
+    direct = app.emb.get_rows(all_ids)
+    via_replica = app.replica.get_rows(all_ids, cls="train")
+    parity = bool(np.array_equal(direct, via_replica))
+    rep_stats = app.replica.stats()
+    shard_versions = {}
+    for rank in (0, 1):
+        try:
+            sh = app.emb.server_stats(rank)["shards"][table]
+            shard_versions[str(rank)] = {
+                "version": sh.get("version"),
+                "snapshots": sh.get("snapshots"),
+                "snapshots_unchanged": sh.get("snapshots_unchanged"),
+            }
+        except Exception as e:   # noqa: BLE001 — stats are best-effort
+            shard_versions[str(rank)] = {"error": str(e)[:120]}
+    versions_match = all(
+        str(rep_stats["versions"].get(r)) == str(v.get("version"))
+        for r, v in shard_versions.items() if "version" in v)
+
+    # ---------------- PR-6 loop: estimate vs measured hit rate -------- #
+    sketches = []
+    for rank in (0, 1):
+        try:
+            sk = (app.emb.server_stats(rank)["shards"][table]
+                  .get("hotkeys"))
+            if sk:
+                sketches.append(sk)
+        except Exception:   # noqa: BLE001
+            pass
+    merged = hotkeys_mod.merge_sketches(sketches)
+    k = rep_stats["cache_rows"]
+    items = merged.get("items", [])
+    total = merged.get("total") or 0
+    # the sketch's two curves bracket the truth: raw counts are the
+    # upper bound (overestimates within err), count-err the guaranteed
+    # lower bound; the MEASURED replica-cache hit rate must land
+    # between them (recorded side by side — the PR-6 loop closed)
+    est_hi = (round(sum(c for _k2, c, _e in items[:k]) / total, 4)
+              if k and total else None)
+    est_lo = (round(sum(max(c - e, 0)
+                        for _k2, c, e in items[:k]) / total, 4)
+              if k and total else None)
+    measured = round(dh / (dh + dm), 4) if (dh + dm) else None
+    # the validation contract: the sketch estimate is a sizing FLOOR,
+    # not a bracket. The sketch observes POST-dedupe shard traffic
+    # (the client's _dedupe_batch collapses a batch's duplicate hot
+    # ids to one, so a zipf head that appears 8x in a minibatch counts
+    # once), while the cache absorbs the raw pre-dedupe request
+    # stream — measured absorption therefore legitimately runs ABOVE
+    # the estimate, and the thing that must hold for the sketch to be
+    # a sound cache-sizing input is that it never OVER-promises:
+    # measured >= the conservative (count - err) estimate, with noise
+    # slack
+    floor_ok = (est_lo is not None and measured is not None
+                and measured >= est_lo - 0.05)
+    hit_rate = {
+        "cache_rows": k,
+        "estimated_hit_rate": est_hi,
+        "estimated_hit_rate_lower": est_lo,
+        "measured_hit_rate": measured,
+        "estimate_err": (round(measured - est_hi, 4)
+                         if est_hi is not None and measured is not None
+                         else None),
+        "estimate_is_floor_ok": floor_ok,
+        "hit_rate_curve": hotkeys_mod.hit_rate_curve(merged),
+        "hit_rate_curve_lower": hotkeys_mod.hit_rate_curve(
+            merged, conservative=True),
+    }
+
+    staleness_ok = age_max <= BOUND_S
+    overload_ok = (shed["overload"] > 0 and degradation is not None
+                   and degradation <= 2.0)
+    result = {
+        "served_qps": round((served["steady"] + served["overload"])
+                            / (steady_s + overload_s), 1),
+        "served_qps_steady": round(served["steady"] / steady_s, 1),
+        "served_qps_overload": round(served["overload"] / overload_s, 1),
+        "loaded_calib_qps": round(loaded_qps, 1),
+        "admission_limit_qps": round(limit_qps, 1),
+        "infer_p50_ms": _pct(all_infer, 50),
+        "infer_p99_ms": _pct(all_infer, 99),
+        "infer_p999_ms": _pct(all_infer, 99.9),
+        "train_p50_steady_ms": train_p50_steady,
+        "train_p50_overload_ms": train_p50_overload,
+        "train_write_degradation_x": degradation,
+        "shed_steady": shed["steady"], "shed_overload": shed["overload"],
+        "shed_rate_overload": shed_rate_overload,
+        "staleness_bound_s": BOUND_S,
+        "staleness_max_s": round(age_max, 4),
+        "staleness_ok": staleness_ok,
+        "parity_bit_for_bit": parity,
+        "versions_match": versions_match,
+        "overload_contract_ok": overload_ok,
+        "cache": hit_rate,
+        "replica": {k2: rep_stats[k2] for k2 in
+                    ("epoch", "refresh_ms", "unchanged_pulls",
+                     "deferred", "served", "versions")},
+        "shards": shard_versions,
+        "loss_first": round(float(losses[0]), 4) if losses else None,
+        "loss_last": round(float(np.mean(losses[-16:])), 4)
+        if losses else None,
+        "errors": errors,
+        "infer_threads": infer_threads, "train_threads": train_threads,
+        "seconds": seconds,
+    }
+    app.close()
+    for c in ctxs:
+        c.close()
+    del peer
+    print("RESULT " + json.dumps(result), flush=True)
+    # acceptance gates, asserted in-run: a serving bench whose
+    # staleness, parity, or overload-protection story broke must fail
+    # loudly rather than record a throughput number
+    if not (parity and staleness_ok and overload_ok):
+        sys.stderr.write(
+            f"bench_serving: acceptance failed (parity={parity}, "
+            f"staleness_ok={staleness_ok}, overload_ok={overload_ok})\n")
+        return 3
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

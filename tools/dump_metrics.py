@@ -128,6 +128,17 @@ def format_cluster_record(rec: Dict) -> str:
                 f"  apply(merged): count={apply_h['count']} "
                 f"p50={apply_h['p50_ms']:.3f} p99={apply_h['p99_ms']:.3f} "
                 f"max={apply_h['max_ms']:.3f} ms")
+    for tname in sorted(rec.get("serving", {})):
+        s = dict(rec["serving"][tname])
+        reps = s.pop("replicas", {})
+        s.pop("rates", None)
+        lines.append(f"serving[{tname}]: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(s.items()) if v is not None))
+        for r in sorted(reps, key=str):
+            e = reps[r]
+            lines.append(f"  replica@rank{r}: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(e.items())
+                if v is not None))
     for tname in sorted(rec.get("hotkeys", {})):
         h = rec["hotkeys"][tname]
         head = "  ".join(f"{k}:{c}" for k, c, _ in h.get("top", [])[:8])

@@ -137,6 +137,39 @@ def render(rec: Dict, prev: Optional[Dict] = None,
             lines.append(f"      {e['error']}")
     mons = rec.get("monitors", {})
     rates = rec.get("rates", {})
+    serving = rec.get("serving", {})
+
+    def _serving_lines(tname: str) -> list:
+        """Serving panel for one table: per-replica lag (epochs +
+        seconds vs the advertised bound), cache hit rate, shed rate,
+        and served QPS when consecutive polls derived rates."""
+        s = serving.get(tname)
+        if not s:
+            return []
+        sr = s.get("rates") or {}
+        head = (f"  serving: replicas={len(s.get('replicas', {}))}"
+                f"  served {s.get('served', 0)}"
+                + (f" ({_fmt(sr.get('served_per_s'), 1)}/s)"
+                   if sr else "")
+                + f"  shed {s.get('shed', 0)}"
+                + (f" ({_fmt(sr.get('shed_per_s'), 1)}/s)" if sr else "")
+                + (f"  shed_rate {s['shed_rate'] * 100:.1f}%"
+                   if s.get("shed_rate") is not None else "")
+                + (f"  cache_hit {s['cache_hit_rate'] * 100:.1f}%"
+                   if s.get("cache_hit_rate") is not None else ""))
+        out = [head]
+        for r in sorted(s.get("replicas", {}), key=str):
+            e = s["replicas"][r]
+            out.append(
+                f"    replica@rank{r}: epoch {_fmt(e.get('epoch'))}"
+                f"  lag {_fmt(e.get('age_s'))}s"
+                f"/{_fmt(e.get('bound_s'))}s bound"
+                f"  refresh {_fmt(e.get('refresh_ms'), 1)} ms"
+                f"  cache {_fmt(e.get('cache_rows'))} rows"
+                + (f" ({e['cache_hit_rate'] * 100:.1f}% hit)"
+                   if e.get("cache_hit_rate") is not None else ""))
+        return out
+
     for tname in sorted(rec.get("tables", {})):
         t = rec["tables"][tname]
         lines.append("")
@@ -176,6 +209,13 @@ def render(rec: Dict, prev: Optional[Dict] = None,
             if curve:
                 lines.append("  cache-hit-if-cached: " + "  ".join(
                     f"top{k}={r * 100:.0f}%" for k, r in curve))
+        lines.extend(_serving_lines(tname))
+    # replicas of tables with no shard visible in this poll (a serving
+    # sidecar whose owners did not answer) still render
+    for tname in sorted(set(serving) - set(rec.get("tables", {}))):
+        lines.append("")
+        lines.append(f"table[{tname}]  (serving only)")
+        lines.extend(_serving_lines(tname))
     return "\n".join(lines)
 
 

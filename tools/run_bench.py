@@ -78,6 +78,16 @@ _REGRESSION_KEYS = (
     # growth, never failed: box weather moves it, but a silent 2x
     # slide in how long a shard stays dark must reach the next session
     (("chaos", "recovery_s"), "chaos failover recovery time"),
+    # online-serving plane (tools/bench_serving.py): inference tail
+    # latency against the bounded-staleness replica
+    (("serving", "infer_p99_ms"), "serving inference p99"),
+)
+
+# bench-extra keys where HIGHER is better: flagged when the new run
+# DROPPED by more than the factor (the served-QPS mirror of the
+# latency-growth flags above)
+_REGRESSION_KEYS_HIGHER = (
+    (("serving", "served_qps"), "serving served QPS"),
 )
 
 
@@ -128,6 +138,16 @@ def flag_regressions(prev_headline, new_headline, factor: float = 2.0):
         if new > factor * old:
             out.append(f"{label}: {new} vs {old} previously "
                        f"({new / old:.1f}x, flag threshold {factor}x)")
+    # higher-is-better keys (served QPS): a >factor DROP is the flag
+    for path, label in _REGRESSION_KEYS_HIGHER:
+        old = _extra_value(prev_headline, path)
+        new = _extra_value(new_headline, path)
+        if old is None or new is None or new <= 0:
+            continue
+        if old > factor * new:
+            out.append(f"{label}: {new} vs {old} previously "
+                       f"({old / new:.1f}x drop, flag threshold "
+                       f"{factor}x)")
     # shard-skew growth: a scale-out run whose row traffic collapsed
     # onto one shard is a regression even when every latency held
     old_skews, new_skews = (_cluster_skews(prev_headline),
