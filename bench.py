@@ -352,7 +352,7 @@ def bench_we_async(world: int = 4, n_tokens: int = 1_000_000):
                                            "bench_we_async.py"),
               rdv, str(world), str(r), str(n_tokens)]
              for r in range(world)], timeout=600)
-    return {
+    out = {
         "world": world, "tokens": n_tokens,
         "words_per_sec_aggregate": round(
             sum(r["words_per_sec"] for r in results), 1),
@@ -360,6 +360,26 @@ def bench_we_async(world: int = 4, n_tokens: int = 1_000_000):
         "loss_mean": round(float(np.mean([r["loss"] for r in results])), 4),
         "loss_per_worker": [round(r["loss"], 4) for r in results],
     }
+    # step-profiler evidence (ISSUE 9): the worker profiles its measured
+    # epoch and asserts >= 90% attribution + zero steady recompiles
+    # in-run; the record keeps rank 0's per-step phase breakdown as the
+    # headline plus the cross-rank stall/attribution spread. bench.main
+    # lifts this to extra.profile so run_bench can flag PHASE-level
+    # regressions (stall growth, steady recompiles) run-over-run.
+    profs = [r["profile"] for r in results if isinstance(r, dict)
+             and r.get("profile")]
+    if profs:
+        head = dict(profs[0])
+        head["stall_fraction_per_worker"] = [
+            p["stall_fraction"] for p in profs]
+        head["attributed_fraction_per_worker"] = [
+            p["attributed_fraction"] for p in profs]
+        head["stall_fraction"] = round(float(np.max(
+            [p["stall_fraction"] for p in profs])), 4)
+        head["steady_recompiles"] = int(sum(
+            p["steady_recompiles"] for p in profs))
+        out["profile"] = head
+    return out
 
 
 def bench_aggregate_path(world: int = 4, mb: float = 16.0):
@@ -1137,6 +1157,11 @@ def main() -> None:
         "dashboard_hist": dashboard_hist,
         "flightrec_dumps": flightrec_dumps,
     }
+    # phase-level profile of the WE async measured epoch (step profiler,
+    # ISSUE 9): first-class extra key so tools/run_bench.py can flag
+    # stall-fraction growth and steady-state recompiles run-over-run
+    if isinstance(we_async_stats, dict) and we_async_stats.get("profile"):
+        extra["profile"] = we_async_stats["profile"]
     if cluster_stats is not None:
         extra["cluster"] = cluster_stats
     if _DEGENERATE_DIFFERENTIALS:

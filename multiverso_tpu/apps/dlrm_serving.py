@@ -38,6 +38,7 @@ from multiverso_tpu.models import dlrm
 from multiverso_tpu.ps.tables import AsyncMatrixTable
 from multiverso_tpu.serving.admission import AdmissionController
 from multiverso_tpu.serving.replica import ReadReplica
+from multiverso_tpu.telemetry import profiler as _prof
 from multiverso_tpu.updaters import AddOption
 
 
@@ -106,24 +107,37 @@ class DLRMServing:
         grad, push row-gradient deltas (blocking — the ack means
         applied). Returns ``(loss, write_ms)``: the write latency is
         the serving bench's protected metric (admission control exists
-        so THIS number survives an inference storm)."""
+        so THIS number survives an inference storm). Profiled as one
+        step (flag ``step_profile``): prepare / ps_wait / compute
+        phases + the table layer's ps.get / ps.add async spans."""
         import time
-        b, f = np.asarray(cat).shape
-        ids = self._ids(cat)
-        rows = self.emb.get_rows(ids).reshape(b, f, self.cfg.embed_dim)
-        loss, g_mlp, g_rows = self._grad(
-            self.mlp, jnp.asarray(rows), jnp.asarray(dense),
-            jnp.asarray(labels))
-        with self._mlp_lock:
-            self.mlp = jax.tree.map(lambda p, g: p - self._mlp_lr * g,
-                                    self.mlp, g_mlp)
-        g_host = np.asarray(g_rows).reshape(b * f, self.cfg.embed_dim)
-        t0 = time.perf_counter()
-        # duplicate ids (same user twice in a batch) f64-accumulate in
-        # the client's _dedupe_batch — scatter-add semantics, exactly
-        # the fused path's .at[].add
-        self.emb.add_rows(ids, g_host, self._opt)
-        return float(loss), (time.perf_counter() - t0) * 1e3
+        with _prof.step("dlrm.train_step"):
+            with _prof.phase("prepare"):
+                b, f = np.asarray(cat).shape
+                ids = self._ids(cat)
+            with _prof.phase("ps_wait"):
+                rows = self.emb.get_rows(ids).reshape(
+                    b, f, self.cfg.embed_dim)
+            with _prof.phase("compute"):
+                if _prof.enabled():
+                    _prof.watch_jit("dlrm.grad", self._grad)
+                    _prof.note_transfer(rows.nbytes)
+                loss, g_mlp, g_rows = self._grad(
+                    self.mlp, jnp.asarray(rows), jnp.asarray(dense),
+                    jnp.asarray(labels))
+                with self._mlp_lock:
+                    self.mlp = jax.tree.map(
+                        lambda p, g: p - self._mlp_lr * g,
+                        self.mlp, g_mlp)
+                g_host = np.asarray(g_rows).reshape(
+                    b * f, self.cfg.embed_dim)
+            t0 = time.perf_counter()
+            # duplicate ids (same user twice in a batch) f64-accumulate
+            # in the client's _dedupe_batch — scatter-add semantics,
+            # exactly the fused path's .at[].add
+            with _prof.phase("push"):
+                self.emb.add_rows(ids, g_host, self._opt)
+            return float(loss), (time.perf_counter() - t0) * 1e3
 
     def infer(self, cat, dense, cls: str = "infer") -> np.ndarray:
         """Score candidates against the replica (bounded staleness;

@@ -40,6 +40,7 @@ import numpy as np
 import multiverso_tpu as mv
 from multiverso_tpu.io.sample_reader import SampleReader
 from multiverso_tpu.models import logreg as model_lib
+from multiverso_tpu.telemetry import profiler as _prof
 from multiverso_tpu.updaters import AddOption
 from multiverso_tpu.utils import config as config_lib
 from multiverso_tpu.utils import log
@@ -210,18 +211,30 @@ class LogReg:
                                   cfg.minibatch_size, fmt=cfg.reader_type)
             batches = (self._sparse_lookahead(reader) if sparse_pipeline
                        else reader)
-            for batch_idx, item in enumerate(batches):
-                if sparse_pipeline:
-                    y_len = len(item["y"])
-                    loss = self._train_sparse_prepared(item)
-                elif cfg.sparse:
-                    x, y, keys = item
-                    y_len = len(y)
-                    loss = self._train_minibatch_sparse(x, y, keys)
-                else:
-                    x, y, keys = item
-                    y_len = len(y)
-                    loss = self._train_minibatch(x, y, batch_idx, pull_buffer)
+            # WE-shaped step bracketing (flag step_profile, no-op
+            # otherwise): each step consumes the CURRENT minibatch and
+            # fetches the NEXT one, so the reader's io_wait phase (and
+            # the producer thread's io.produce intervals) land on the
+            # training step they stalled/overlapped
+            batches_it = iter(batches)
+            item = next(batches_it, None)
+            batch_idx = 0
+            while item is not None:
+                with _prof.step("lr.minibatch"):
+                    if sparse_pipeline:
+                        y_len = len(item["y"])
+                        loss = self._train_sparse_prepared(item)
+                    elif cfg.sparse:
+                        x, y, keys = item
+                        y_len = len(y)
+                        loss = self._train_minibatch_sparse(x, y, keys)
+                    else:
+                        x, y, keys = item
+                        y_len = len(y)
+                        loss = self._train_minibatch(x, y, batch_idx,
+                                                     pull_buffer)
+                    item = next(batches_it, None)
+                batch_idx += 1
                 losses.append(float(loss))
                 if ssp_clock is not None:
                     ssp_clock.tick()

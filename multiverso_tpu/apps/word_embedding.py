@@ -46,6 +46,7 @@ import multiverso_tpu as mv
 from multiverso_tpu import native
 from multiverso_tpu.data.dictionary import Dictionary, build_huffman
 from multiverso_tpu.models import word2vec as w2v
+from multiverso_tpu.telemetry import profiler as _prof
 from multiverso_tpu.utils import log
 from multiverso_tpu.tables.matrix_table import _bucket_size
 from multiverso_tpu.utils.async_buffer import AsyncBuffer
@@ -465,12 +466,17 @@ class WordEmbedding:
                     futs[i] = None   # release the payload
                     words += block.size
         else:
+            # pipeline-fill prepare happens outside any step: steady-
+            # state steps each cover ONE (prepare of block N+1, train of
+            # block N) pair — the overlap the profiler exists to measure
             prepared = (self._prepare_block(schedule[0], child_rngs[0])
                         if schedule else None)
             for i, block in enumerate(schedule):
-                nxt = (self._prepare_block(schedule[i + 1], child_rngs[i + 1])
-                       if i + 1 < len(schedule) else None)
-                losses.append(self._train_prepared(prepared, nw))
+                with _prof.step("we.block"):
+                    nxt = (self._prepare_block(schedule[i + 1],
+                                               child_rngs[i + 1])
+                           if i + 1 < len(schedule) else None)
+                    losses.append(self._train_prepared(prepared, nw))
                 words += block.size
                 prepared = nxt
         if dev_losses:
@@ -559,7 +565,7 @@ class WordEmbedding:
         Get/Add over the wire here, in-graph gather/scatter there)."""
         cfg = self.cfg
         b = cfg.batch_size
-        with monitor("we.prepare"):
+        with monitor("we.prepare"), _prof.phase("prepare"):
             prep = self._block_arrays(block, rng)
             n = (prep["examples"].size // b) * b
             if n == 0:
@@ -610,22 +616,39 @@ class WordEmbedding:
                 return jnp.asarray(np.pad(
                     rows, [(0, kb - rows.shape[0]), (0, 0)]))
 
-            win_l = padded(self.table_in.wait(prep["pull_in"]), prep["kb"])
             sec_t = self._sec_table()
-            wsec_l = padded(
-                sec_t.wait(prep["pull_hs" if cfg.hs else "pull_out"]),
-                prep["hkb"] if cfg.hs else prep["kb"])
-            d_in, d_sec, loss = self._local_train_fn()(
-                win_l, wsec_l, jnp.asarray(prep["valid"]),
-                jax.device_put(prep["batch"]))
-            with monitor("we.push"):
+            # ps_wait: the residual of the pulls dispatched during
+            # prepare — the part the prefetch overlap did NOT hide
+            with _prof.phase("ps_wait"):
+                rows_in = self.table_in.wait(prep["pull_in"])
+                rows_sec = sec_t.wait(
+                    prep["pull_hs" if cfg.hs else "pull_out"])
+            with _prof.phase("compute"):
+                win_l = padded(rows_in, prep["kb"])
+                wsec_l = padded(rows_sec,
+                                prep["hkb"] if cfg.hs else prep["kb"])
+                if _prof.enabled():
+                    _prof.watch_jit("we.local_train",
+                                    self._local_train_fn())
+                    _prof.note_transfer(sum(
+                        int(np.asarray(a).nbytes)
+                        for a in prep["batch"]))
+                d_in, d_sec, loss = self._local_train_fn()(
+                    win_l, wsec_l, jnp.asarray(prep["valid"]),
+                    jax.device_put(prep["batch"]))
+                # materialize the deltas HERE: np.asarray is the device
+                # sync, so the scan's runtime lands in `compute`, not in
+                # the push's enqueue accounting (the push itself is an
+                # async ps.add span via the table layer)
+                d_in = np.asarray(d_in)
+                d_sec = np.asarray(d_sec)
+            with monitor("we.push"), _prof.phase("push"):
                 k = prep["vocab"].size
                 self.table_in.add_rows_async(
-                    prep["vocab"], np.asarray(d_in)[:k] / num_workers)
+                    prep["vocab"], d_in[:k] / num_workers)
                 ids_sec = prep["hs_rows"] if cfg.hs else prep["vocab"]
                 sec_t.add_rows_async(
-                    ids_sec,
-                    np.asarray(d_sec)[:ids_sec.size] / num_workers)
+                    ids_sec, d_sec[:ids_sec.size] / num_workers)
             return float(loss)
 
     # ------------------------------------------------------------------ #

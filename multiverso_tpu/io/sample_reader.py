@@ -33,11 +33,13 @@ from __future__ import annotations
 import queue
 import struct
 import threading
+import time
 from typing import IO, Iterator, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
 from multiverso_tpu.io.stream import TextReader, open_stream
+from multiverso_tpu.telemetry import profiler as _prof
 
 FORMATS = ("libsvm", "dense", "weight", "weight_dense", "bsparse")
 
@@ -155,31 +157,52 @@ class SampleReader:
         try:
             for _ in range(self._loop_epochs):
                 xs, ys, keys = [], [], set()
+                t_batch0 = time.time()
                 for label, x in self._samples():
                     ys.append(label)
                     xs.append(x)
                     if not self._dense_like:
                         keys.update(np.nonzero(x)[0].tolist())
                     if len(xs) == self.batch_size:
-                        self._emit(xs, ys, keys)
+                        self._emit(xs, ys, keys, t_batch0)
                         xs, ys, keys = [], [], set()
+                        t_batch0 = time.time()
                 if xs and not self.drop_remainder:
-                    self._emit(xs, ys, keys)
+                    self._emit(xs, ys, keys, t_batch0)
             self._queue.put(None)
         except BaseException as e:
             self._error = e
             self._queue.put(None)
 
-    def _emit(self, xs, ys, keys: Set[int]) -> None:
+    def _emit(self, xs, ys, keys: Set[int],
+              t_batch0: Optional[float] = None) -> None:
         X = np.stack(xs)
         y = np.asarray(ys, dtype=np.int32)
         k = (None if self._dense_like
              else np.asarray(sorted(keys), dtype=np.int64))
+        # stamp the interval's end BEFORE the put: a full queue blocks
+        # put() on backpressure (the consumer is the bottleneck), and
+        # folding that wait into io.produce would name the input
+        # pipeline the critical path precisely when the producer is
+        # idle — inverting the diagnosis
+        t_done = time.time()
         self._queue.put((X, y, k))
+        # step profiler: the producer thread holds no step of its own,
+        # so its per-batch parse+assemble interval attaches to the
+        # process's current step ("any") — which is how input-pipeline
+        # work shows up on the timeline of the training step it
+        # overlapped (or stalled)
+        if t_batch0 is not None and _prof.enabled():
+            _prof.note_async("io.produce", t_batch0, t_done,
+                             attach="any")
 
     def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]]:
         while True:
-            item = self._queue.get()
+            # io_wait: time the CONSUMER (the training step's thread)
+            # blocked on the producer — the "input pipeline is the
+            # critical path" phase, visible per step when profiling
+            with _prof.phase("io_wait"):
+                item = self._queue.get()
             if item is None:
                 if self._error is not None:
                     raise self._error

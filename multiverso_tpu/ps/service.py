@@ -55,6 +55,7 @@ from multiverso_tpu.serving import replica as _serving_replica
 from multiverso_tpu.telemetry import aggregator as _aggregator
 from multiverso_tpu.telemetry import exporter as _exporter
 from multiverso_tpu.telemetry import flightrec as _flight
+from multiverso_tpu.telemetry import profiler as _profiler
 from multiverso_tpu.telemetry import trace as _trace
 from multiverso_tpu.telemetry import watchdog as _watchdog
 from multiverso_tpu.utils import config, log
@@ -572,6 +573,7 @@ class PSService:
         # watchdog thread starts (flag-gated) to age its in-flight table
         _trace.configure(rank)
         _flight.configure(rank)
+        _profiler.configure(rank)
         log.set_rank(rank)
         _watchdog.ensure_started()
         self._peers: Dict[int, _Peer] = {}
@@ -820,6 +822,16 @@ class PSService:
             if serving:
                 payload["serving"] = serving
         except Exception:   # noqa: BLE001 — telemetry never breaks stats
+            pass
+        # step-profiler block (flag step_profile): per-process stall
+        # fraction / recompile summary — mvtop's stall%/recompiles
+        # columns and the aggregator pass it through like serving.
+        # Process-global (same (host, pid) collapse as the monitors).
+        try:
+            profile = _profiler.stats_snapshot()
+            if profile:
+                payload["profile"] = profile
+        except Exception:   # noqa: BLE001
             pass
         return payload
 
@@ -1427,6 +1439,7 @@ class PSContext:
             d = config.get_flag("metrics_dir")
             if d:
                 _trace.dump_to(d)
+                _profiler.dump_to(d)
         except Exception as e:  # noqa: BLE001 — telemetry never blocks
             log.error("telemetry flush at close failed: %s", e)  # shutdown
         self.service.close()

@@ -1078,7 +1078,9 @@ class RowShard:
                         "rows": self.n, "cols": self.num_col,
                         "unchanged": True}, []
             pin = self._pin_data_locked()
-        _flight.record(_flight.EV_GET_SERVE,
+        # serving traffic on the SAME tape as gets/adds (PR-8 coverage
+        # gap): a replica refresh storm must be visible in a fault dump
+        _flight.record(_flight.EV_SNAPSHOT_SERVE,
                        nbytes=self.n * self.num_col * self.dtype.itemsize)
         try:
             full = (pin.data[: self.n].copy() if self._np_mode
@@ -1087,7 +1089,7 @@ class RowShard:
             self._release_data(pin)
         self._stat_snapshots += 1
         if tr is not None:
-            _trace.add_span("shard.snapshot", t0, time.time(), trace=tr,
+            _trace.add_span("snapshot.serve", t0, time.time(), trace=tr,
                             args={"table": self.name,
                                   "version": int(version)})
         rmeta = {"version": int(version), "gen": gen, "lo": self.lo,

@@ -34,6 +34,7 @@ from multiverso_tpu.ps import service as svc
 from multiverso_tpu.ps import wire as wire_mod
 from multiverso_tpu.ps.shard import KVShard, RowShard
 from multiverso_tpu.telemetry import flightrec as _flight
+from multiverso_tpu.telemetry import profiler as _profiler
 from multiverso_tpu.telemetry import trace as ttrace
 from multiverso_tpu.updaters import AddOption
 from multiverso_tpu.utils import config, log
@@ -328,6 +329,35 @@ def _attach_reply_span(futs: List, name: str, t0: float, tid: int,
     for f in futs:
         if isinstance(f, cf.Future):
             f.add_done_callback(_done)
+
+
+def _attach_profile_end(futs: List, span) -> bool:
+    """Close a step-profiler async span when the LAST per-owner future
+    completes (runs on a peer recv thread) — the exact round-trip end
+    the overlap-credit math needs. Returns False when ANY future lacks
+    callback support (native-transport handles — including a MIXED
+    list, where a dead owner's already-failed cf placeholder would
+    otherwise fire the close at dispatch while the live native
+    round-trips are still in flight): the caller then leaves the span
+    open and the wait()/sweep fallback closes it conservatively."""
+    if any(not isinstance(f, cf.Future) for f in futs):
+        return False
+    remaining = [len(futs)]
+    if not remaining[0]:
+        return False
+    lock = threading.Lock()
+
+    def _done(_f):
+        with lock:
+            remaining[0] -= 1
+            last = remaining[0] == 0
+        if last:
+            span.end()
+
+    for f in futs:
+        if isinstance(f, cf.Future):
+            f.add_done_callback(_done)
+    return True
 
 
 class _RetainedFrame:
@@ -1249,6 +1279,9 @@ class _AsyncBase:
         # can surface them deterministically (sweep timing must not decide
         # whether a lost delta is seen)
         self._swept_failures: List[Exception] = []
+        # step-profiler async spans per tracked msg_id (flag
+        # step_profile; empty dict and one attribute read otherwise)
+        self._prof_spans: Dict[int, Any] = {}
 
     def _wire_for(self, rank: int) -> str:
         """Wire codec per destination rank (overridden by tables with a
@@ -1295,7 +1328,8 @@ class _AsyncBase:
     # and flush() still surfaces every failure deterministically
     _SWEEP_THRESHOLD = 32
 
-    def _track(self, futures: List[cf.Future], finalize=None) -> int:
+    def _track(self, futures: List[cf.Future], finalize=None,
+               op: Optional[str] = None) -> int:
         with self._lock:
             # sweep fire-and-forget adds whose futures are all done; their
             # failures are LOGGED, not raised — raising here would poison
@@ -1307,6 +1341,9 @@ class _AsyncBase:
                     if len(self._pending) >= self._SWEEP_THRESHOLD else ())
             for mid in done:
                 futs, _ = self._pending.pop(mid)
+                sp = self._prof_spans.pop(mid, None)
+                if sp is not None:   # native-handle span: close at the
+                    sp.end()         # sweep (its futures are all done)
                 for f in futs:
                     exc = f.exception()
                     if exc is not None:
@@ -1317,7 +1354,25 @@ class _AsyncBase:
             msg_id = self._next_msg_id
             self._next_msg_id += 1
             self._pending[msg_id] = (futures, finalize)
-            return msg_id
+        # step-profiler async span (one attribute read when off): the
+        # op's dispatch->reply interval is what the overlap-credit math
+        # intersects with compute phases. Reply callbacks close it at
+        # the true round-trip end; native handles (no callbacks) fall
+        # back to closing at wait()/step-finalize.
+        if op is not None and _profiler.PROFILER.enabled:
+            span = _profiler.async_begin(op, attach="thread")
+            if span is not None:
+                if not _attach_profile_end(futures, span):
+                    with self._lock:
+                        # re-check under the lock: a concurrent _track's
+                        # sweep may have already popped this msg_id (all
+                        # native acks landed) — storing now would leak an
+                        # open span under a dead id forever
+                        if msg_id in self._pending:
+                            self._prof_spans[msg_id] = span
+                        else:
+                            span.end()
+        return msg_id
 
     def wait(self, msg_id: int) -> Any:
         """Block until the op behind ``msg_id`` completes (ref Wait). For
@@ -1335,13 +1390,20 @@ class _AsyncBase:
         a per-owner send-lock sweep per op)."""
         with self._lock:
             entry = self._pending.pop(msg_id, None)
+            span = self._prof_spans.pop(msg_id, None)
         if entry is None:
+            if span is not None:
+                span.end()
             return None
         futures, finalize = entry
         timeout = config.get_flag("ps_timeout")
-        results = [svc.await_reply(f, timeout,
-                                   f"table[{self.name}] op {msg_id}")
-                   for f in futures]
+        try:
+            results = [svc.await_reply(f, timeout,
+                                       f"table[{self.name}] op {msg_id}")
+                       for f in futures]
+        finally:
+            if span is not None:   # native-handle span: the reply is in
+                span.end()         # by the time await_reply returned
         return finalize(results) if finalize is not None else None
 
     def flush(self) -> None:
@@ -1592,7 +1654,8 @@ class AsyncMatrixTable(_AsyncBase):
                 else:
                     parts = [(r, uids[m], vals[m])
                              for r, m in self._by_owner(uids)]
-                mid = self._track(self._window.submit(parts, opt, tid))
+                mid = self._track(self._window.submit(parts, opt, tid),
+                                  op="ps.add")
                 if tid is not None:
                     ttrace.add_span("client.enqueue", t_enq0, time.time(),
                                     trace=tid,
@@ -1606,8 +1669,10 @@ class AsyncMatrixTable(_AsyncBase):
                     self._owner_conns(uids), self.ctx.world, False,
                     self._rows_per, meta_b, uids,
                     np.ascontiguousarray(vals))
-                return self._track(_fanout_futures(
-                    parts, lambda c, s, m: _NativeAddFuture(c, s, m)))
+                return self._track(
+                    _fanout_futures(
+                        parts, lambda c, s, m: _NativeAddFuture(c, s, m)),
+                    op="ps.add")
             t_send0 = time.time() if tid is not None else 0.0
             futs = []
             for r, m in self._by_owner(uids):
@@ -1627,7 +1692,7 @@ class AsyncMatrixTable(_AsyncBase):
             if tid is not None:
                 _attach_reply_span(futs, "client.add_rows", t_send0, tid,
                                    self.name)
-        return self._track(futs)
+        return self._track(futs, op="ps.add")
 
     def add_rows(self, row_ids, values,
                  opt: Optional[AddOption] = None) -> None:
@@ -1677,7 +1742,7 @@ class AsyncMatrixTable(_AsyncBase):
                     # threads; results only carry completion
                     return buf if inv is None else buf[inv]
 
-                return self._track(futs, _assemble_native)
+                return self._track(futs, _assemble_native, op="ps.get")
             parts = list(self._by_owner(uids))
             if self._get_window is not None:
                 # coalesced single-flight fetches: each part resolves to
@@ -1697,7 +1762,7 @@ class AsyncMatrixTable(_AsyncBase):
                     np.take(buf, inv, axis=0, out=dest)
                     return dest
 
-                return self._track(futs, _assemble_win)
+                return self._track(futs, _assemble_win, op="ps.get")
             # remote peers share one packed meta (with the table's reply
             # wire); the local short-circuit keeps its uncompressed dict
             gw = self._reply_wire()
@@ -1769,7 +1834,7 @@ class AsyncMatrixTable(_AsyncBase):
                 np.take(buf, inv, axis=0, out=dest)
                 return dest
 
-        return self._track(futs, _assemble)
+        return self._track(futs, _assemble, op="ps.get")
 
     def get_rows(self, row_ids, out: Optional[np.ndarray] = None
                  ) -> np.ndarray:
@@ -2258,7 +2323,8 @@ class AsyncSparseKVTable(_SparseGetMixin, _AsyncBase):
                 else:
                     parts = [(r, uids[m], vals[m])
                              for r, m in self._by_owner(uids)]
-                mid = self._track(self._window.submit(parts, opt, tid))
+                mid = self._track(self._window.submit(parts, opt, tid),
+                                  op="ps.add")
                 if tid is not None:
                     ttrace.add_span("client.enqueue", t_enq0, time.time(),
                                     trace=tid,
@@ -2272,7 +2338,7 @@ class AsyncSparseKVTable(_SparseGetMixin, _AsyncBase):
                                              [uids[m], vals[m]],
                                              meta_b=meta_b)
                     for r, m in self._by_owner(uids)]
-        return self._track(futs)
+        return self._track(futs, op="ps.add")
 
     def add_rows(self, keys, values,
                  opt: Optional[AddOption] = None) -> None:
@@ -2296,7 +2362,7 @@ class AsyncSparseKVTable(_SparseGetMixin, _AsyncBase):
                     out[m] = arrays[0]
                 return out if inv is None else out[inv]
 
-        return self._track(futs, _assemble)
+        return self._track(futs, _assemble, op="ps.get")
 
     def get_rows(self, keys) -> np.ndarray:
         return self.wait(self.get_rows_async(keys))

@@ -290,15 +290,24 @@ class ReadReplica:
 
     def _pull_once(self) -> None:
         from multiverso_tpu.ps import service as svc
+        from multiverso_tpu.ps import wire as wire_mod
+        from multiverso_tpu.telemetry import flightrec as _flight
+        from multiverso_tpu.telemetry import trace as _trace
         t_start = time.monotonic()
+        # PR-3 trace plumbing (the PR-8 coverage gap): one trace ID per
+        # refresh cycle rides every shard's snapshot request meta, so
+        # the client-side replica.pull span and each shard's
+        # snapshot.serve span stitch on one timeline like gets/adds
+        tr = _trace.new_id() if _trace.enabled() else None
+        t_wall0 = time.time() if tr is not None else 0.0
         service = self.ctx.service
         chunk = int(config.get_flag("serving_snapshot_chunk_rows"))
         reqs = []
         for rank, lo, hi in self._ranges:
-            meta: Dict[str, Any] = {
+            meta: Dict[str, Any] = wire_mod.with_trace({
                 "table": self.name,
                 "since": int(self._versions.get(rank, -1)),
-                "since_gen": int(self._gens.get(rank, -1))}
+                "since_gen": int(self._gens.get(rank, -1))}, tr)
             sink = buf = None
             if chunk > 0 and (hi - lo) > chunk and rank != self.ctx.rank:
                 buf = np.empty((hi - lo, self.num_col), self.dtype)
@@ -361,6 +370,20 @@ class ReadReplica:
             self._last_refresh_ms = (time.monotonic() - t_start) * 1e3
             if cache_ids is not None:
                 self._cache_ids, self._cache_dev = cache_ids, cache_dev
+        # flight recorder + trace span: one refresh = one event/span, so
+        # serving refresh traffic appears on the same timeline as the
+        # data plane (nbytes = rows actually re-shipped this cycle)
+        _flight.record(
+            _flight.EV_REPLICA_PULL,
+            nbytes=sum(r.nbytes for r in changed.values()),
+            note=f"replica[{self.name}] epoch {self._epoch}")
+        if tr is not None:
+            _trace.add_span(
+                "replica.pull", t_wall0, time.time(), trace=tr,
+                cat="serving",
+                args={"table": self.name, "epoch": int(self._epoch),
+                      "changed": len(changed),
+                      "shards": len(self._ranges)})
 
     # ------------------------------------------------------------------ #
     # hot-row cache (Space-Saving sketch seeded, PR-6 loop closed)

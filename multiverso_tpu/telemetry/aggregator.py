@@ -252,6 +252,29 @@ def merge_cluster(stats_by_rank: Dict[int, Any],
             ent["shed_rate"] = (round(ent["shed"] / dem, 4)
                                 if dem else None)
         rec["serving"] = serving
+    # step-profiler blocks (flag step_profile; PR 9): passed through
+    # per reporting rank like the serving block, plus two at-a-glance
+    # fields folded into the rank entries (mvtop's stall%/recompiles
+    # columns). Process-global like the monitors — in-process
+    # multi-rank worlds report one process's summary under each of its
+    # ranks, the same documented collapse.
+    profile: Dict[str, Dict] = {}
+    for r in sorted(stats_by_rank):
+        st = stats_by_rank[r]
+        if not isinstance(st, dict):
+            continue
+        p = st.get("profile")
+        if not isinstance(p, dict):
+            continue
+        profile[str(r)] = p
+        ent = ranks.get(str(r))
+        if ent is not None:
+            sf = p.get("stall_fraction")
+            ent["stall_pct"] = (round(100.0 * sf, 1)
+                                if isinstance(sf, (int, float)) else None)
+            ent["recompiles"] = p.get("steady_recompiles")
+    if profile:
+        rec["profile"] = profile
     if hot:
         rec["hotkeys"] = {}
         for tname, sketches in hot.items():
@@ -374,6 +397,9 @@ def compact_record(rec: Dict, top: int = 8,
     if rec.get("serving"):
         # replica lag/hit-rate/shed summary (already compact)
         out["serving"] = rec["serving"]
+    if rec.get("profile"):
+        # per-rank step-profiler summaries (already compact)
+        out["profile"] = rec["profile"]
     mons: Dict[str, Any] = {}
     for n, m in sorted(rec.get("monitors", {}).items()):
         if not m.get("timed"):

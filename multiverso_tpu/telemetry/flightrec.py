@@ -107,6 +107,10 @@ EV_FAILOVER_RESPAWN = 28  # supervisor launched the replacement
 EV_FAILOVER_RESTORE = 29  # a shard restored from its checkpoint
 EV_FAILOVER_REPLAY = 30   # replay plane: frame re-flushed / dedup'd
 EV_FAILOVER_REJOIN = 31   # restored incarnation is serving again
+# serving plane (PR 8's coverage gap, closed in PR 9): snapshot serves
+# and replica refreshes ride the same tape as gets/adds
+EV_SNAPSHOT_SERVE = 32    # shard: MSG_SNAPSHOT export served
+EV_REPLICA_PULL = 33      # client: one ReadReplica refresh completed
 
 EV_NAMES = {
     EV_SEND: "send", EV_ACK: "ack", EV_ERR: "err", EV_RECV: "recv",
@@ -126,6 +130,39 @@ EV_NAMES = {
     EV_FAILOVER_RESTORE: "failover.restore",
     EV_FAILOVER_REPLAY: "failover.replay",
     EV_FAILOVER_REJOIN: "failover.rejoin",
+    EV_SNAPSHOT_SERVE: "snapshot.serve",
+    EV_REPLICA_PULL: "replica.pull",
+}
+
+# ---------------------------------------------------------------------- #
+# wire-opcode -> ring-event coverage map. Every MSG_* opcode defined in
+# ps/service.py MUST have an entry here naming the ring events that mark
+# its lifecycle on the tape — tools/check_obs_surface.py asserts the
+# mapping statically (tier-1). PR 8's MSG_SNAPSHOT shipped with no
+# flightrec/trace coverage precisely because nothing forced the
+# question; an EMPTY tuple is a legitimate answer (probe traffic is
+# deliberately excluded so 2 Hz polling cannot wrap the tape past
+# pre-wedge evidence, PR 4) but it must be GIVEN, not forgotten.
+# ---------------------------------------------------------------------- #
+MSG_EV_COVERAGE = {
+    "MSG_REPLY_OK": (EV_ACK, EV_REPLY),
+    "MSG_REPLY_ERR": (EV_ERR, EV_REPLY),
+    "MSG_REPLY_CHUNK": (EV_GET_CHUNK,),
+    "MSG_PING": (),          # probe: excluded from the tape (PR 4)
+    "MSG_ADD_ROWS": (EV_SEND, EV_RECV, EV_APPLY, EV_WIN_ENQ,
+                     EV_WIN_FLUSH, EV_WIN_ACK),
+    "MSG_GET_ROWS": (EV_SEND, EV_RECV, EV_GET_SERVE, EV_GET_WIN),
+    "MSG_SET_ROWS": (EV_SEND, EV_RECV, EV_APPLY),
+    "MSG_ADD_FULL": (EV_SEND, EV_RECV, EV_APPLY),
+    "MSG_GET_FULL": (EV_SEND, EV_RECV, EV_GET_SERVE),
+    "MSG_KV_ADD": (EV_SEND, EV_RECV, EV_APPLY),
+    "MSG_KV_GET": (EV_SEND, EV_RECV, EV_GET_SERVE),
+    "MSG_GET_STATE": (EV_SEND, EV_RECV),
+    "MSG_SET_STATE": (EV_SEND, EV_RECV),
+    "MSG_BATCH": (EV_SEND, EV_RECV, EV_WAVE, EV_WIN_FLUSH, EV_WIN_ACK),
+    "MSG_STATS": (),         # probe: excluded from the tape (PR 4)
+    "MSG_HEALTH": (),        # probe: excluded from the tape (PR 4)
+    "MSG_SNAPSHOT": (EV_SNAPSHOT_SERVE, EV_REPLICA_PULL),
 }
 
 

@@ -72,8 +72,10 @@ class Zoo:
         # its shard registry)
         from multiverso_tpu.telemetry import exporter as _exporter
         from multiverso_tpu.telemetry import flightrec as _flightrec
+        from multiverso_tpu.telemetry import profiler as _profiler
         from multiverso_tpu.telemetry import trace as _trace
         _trace.configure(self.rank())
+        _profiler.configure(self.rank())
         _exporter.ensure_started(self.rank())
         # flight-recorder plane: pin the rank, give the structured log
         # sink the same rank, and dump the black box if a fault signal
@@ -146,6 +148,11 @@ class Zoo:
                 _trace.dump_to(d)
             except OSError as e:
                 log.error("trace dump at shutdown failed: %s", e)
+            try:
+                from multiverso_tpu.telemetry import profiler as _profiler
+                _profiler.dump_to(d)
+            except OSError as e:
+                log.error("profile dump at shutdown failed: %s", e)
         if config.get_flag("dashboard"):
             Dashboard.display(log.info)
             # a second init/stop cycle must not reprint this run's

@@ -95,6 +95,10 @@ def _fresh_runtime():
     _exporter.stop_global()
     _trace.TRACER.reset()
     _trace.TRACER.enabled = False
+    # step profiler: drop records/aggregates and disable (a test that
+    # enabled step_profile must not leak steps into its neighbors)
+    from multiverso_tpu.telemetry import profiler as _profiler
+    _profiler.reset()
     # flight-recorder plane: drop the ring/in-flight table and stop the
     # watchdog so one test's wedged ops can't trip a neighbor's verdict;
     # unpin the logger's rank stamp too (first-caller-wins, like the

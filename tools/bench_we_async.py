@@ -8,6 +8,18 @@ Same config/corpus as bench.bench_wordembedding_ps()'s 1M-token run
 plane's ``loss_1M``. Each rank trains blocks[rank::world] of the shared
 corpus against async tables owned across the plane.
 
+The MEASURED epoch runs with the step profiler live (flag
+``step_profile``, telemetry/profiler.py): every block is one step with
+``prepare``/``ps_wait``/``compute``/``push`` phases and per-op
+``ps.get``/``ps.add`` async spans, and the RESULT carries the phase
+breakdown, stall fraction, overlap credit, and compile counts (bench
+``extra.profile``). Two in-run assertions (ISSUE 9 acceptance):
+the profiler must attribute >= 90% of per-step wall time (phases +
+async spans vs wall clock — interval-union math, so the number is
+honest about gaps), and the steady state must not recompile (warm
+epoch owns every compile; a mid-measure retrace is exactly the silent
+regression the profiler exists to catch).
+
 Invoked as: python tools/bench_we_async.py <rdv_dir> <world> <rank>
             <n_tokens>
 Prints "RESULT <json>".
@@ -27,6 +39,7 @@ def main():
     from multiverso_tpu.apps.word_embedding import (WEConfig, WordEmbedding,
                                                     synthetic_corpus)
     from multiverso_tpu.data.dictionary import Dictionary
+    from multiverso_tpu.telemetry import profiler as _prof
     from multiverso_tpu.utils import config
     from multiverso_tpu.utils.filesync import file_barrier
 
@@ -51,15 +64,62 @@ def main():
     file_barrier(rdv_dir, world, rank, "tables", timeout=180)
     we.train_ps_blocks(ids)               # warm: compile block programs
     file_barrier(rdv_dir, world, rank, "warm", timeout=180)
+    # profile the MEASURED epoch only: the warm epoch's compiles belong
+    # to warmup; steady-state steps must attribute >= 90% of wall and
+    # recompile zero times (both asserted below)
+    config.set_flag("step_profile", True)
+    _prof.configure()
     stats = we.train_ps_blocks(ids)       # measured epoch
+    config.set_flag("step_profile", False)
+    _prof.configure()
     file_barrier(rdv_dir, world, rank, "trained", timeout=180)
+    prof = _prof.summary()
+    profile = None
+    if prof["steps"]:
+        # ISSUE 9 acceptance, asserted IN-RUN: the phase/span instrument
+        # must account for >= 90% of the measured epoch's wall clock —
+        # a profiler that misses a tenth of the step cannot name the
+        # critical path. Interval-union math (profiler._finalize), so
+        # overlapping phases cannot inflate the fraction past 1.
+        assert prof["attributed_fraction"] >= 0.90, (
+            f"profiler attributed only "
+            f"{prof['attributed_fraction']:.1%} of step wall time")
+        # steady state must not recompile: every block program compiled
+        # during the warm epoch, and a silent mid-measure retrace is a
+        # perf regression the profiler exists to name
+        assert prof["steady_recompiles"] == 0, (
+            f"{prof['steady_recompiles']} steady-state recompiles "
+            "during the measured epoch")
+        phases = prof["phases"]
+        steps = max(prof["steps"], 1)
+        profile = {
+            "steps": prof["steps"],
+            "wall_ms_per_step": round(prof["wall_ms"] / steps, 2),
+            "attributed_fraction": prof["attributed_fraction"],
+            "stall_fraction": prof["stall_fraction"],
+            "overlap_ms_per_step": round(prof["overlap_ms"] / steps, 2),
+            # per-step EXCLUSIVE phase means — the ROADMAP item-2
+            # headline ("prepare dominates block") read off directly
+            "phase_ms_per_step": {n: round(v / steps, 2)
+                                  for n, v in phases.items()},
+            "prepare_dominates": bool(
+                phases.get("prepare", 0.0)
+                > phases.get("compute", 0.0)),
+            "steady_recompiles": prof["steady_recompiles"],
+            "compiles": prof["jax"]["compiles"],
+            "transfer_mb": round(
+                prof["jax"]["transfer_bytes"] / 1e6, 2),
+        }
     mv.shutdown()
-    print("RESULT " + json.dumps({
+    out = {
         "rank": rank,
         "words_per_sec": round(stats["words_per_sec"], 1),
         "seconds": round(stats["seconds"], 3),
         "loss": stats["loss"],
-    }), flush=True)
+    }
+    if profile is not None:
+        out["profile"] = profile
+    print("RESULT " + json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":

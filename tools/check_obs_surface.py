@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Static lint of the observability surface (tier-1 wraps this).
+
+PR 8 shipped a new wire opcode (MSG_SNAPSHOT) with no flight-recorder
+or trace coverage, and earlier PRs have shipped flags with no
+docs/TUNING.md row — both slipped because nothing asked the question at
+review time. This tool asks it mechanically:
+
+1. **Every ``MSG_*`` opcode** defined in ``ps/service.py`` /
+   ``ps/wire.py`` must have an entry in
+   ``telemetry/flightrec.MSG_EV_COVERAGE`` naming the ring events that
+   mark its lifecycle (an explicit EMPTY tuple is allowed — probe
+   traffic is deliberately off the tape — but it must be stated, not
+   forgotten), and every event named must exist in ``EV_NAMES``.
+2. **Every flag** registered via ``config.define_*`` anywhere under
+   ``multiverso_tpu/`` must appear in ``docs/TUNING.md`` — a knob an
+   operator cannot discover is a knob that does not exist.
+
+    python tools/check_obs_surface.py        # exit 0 clean, 1 findings
+
+Run by ``tests/test_profiler.py`` in tier-1, so a PR adding an opcode
+or flag without its observability/doc surface fails CI, not review.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import List
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+# trailing comments allowed: this codebase styles constants as
+# "MSG_X = 0x1E  # what it is", and a commented definition escaping the
+# scan would re-open the exact crack this tool closes
+_MSG_RE = re.compile(
+    r"^(MSG_[A-Z_0-9]+)\s*=\s*(?:0x[0-9a-fA-F]+|\d+)\s*(?:#.*)?$", re.M)
+_FLAG_RE = re.compile(
+    r"""define_(?:bool|int|float|string)\(\s*['"]([^'"]+)['"]""")
+
+
+def wire_opcodes() -> List[str]:
+    """MSG_* names defined in the wire/service layer (source scan — the
+    lint must see an opcode the moment it is committed, imported
+    anywhere or not)."""
+    names: List[str] = []
+    for rel in ("multiverso_tpu/ps/service.py", "multiverso_tpu/ps/wire.py"):
+        with open(os.path.join(_REPO, rel)) as f:
+            names += _MSG_RE.findall(f.read())
+    return sorted(set(names))
+
+
+def defined_flags() -> List[str]:
+    """Every config.define_* flag name under multiverso_tpu/."""
+    names: List[str] = []
+    for root, _dirs, files in os.walk(os.path.join(_REPO, "multiverso_tpu")):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            with open(os.path.join(root, fn)) as f:
+                names += _FLAG_RE.findall(f.read())
+    return sorted(set(names))
+
+
+def check() -> List[str]:
+    """All findings as human-readable strings ([] = clean)."""
+    findings: List[str] = []
+    from multiverso_tpu.telemetry import flightrec
+
+    cov = flightrec.MSG_EV_COVERAGE
+    for op in wire_opcodes():
+        if op not in cov:
+            findings.append(
+                f"{op}: no flightrec.MSG_EV_COVERAGE entry — name the "
+                "ring events marking its lifecycle (or an explicit () "
+                "with the probe-exclusion reason)")
+            continue
+        for ev in cov[op]:
+            if ev not in flightrec.EV_NAMES:
+                findings.append(
+                    f"{op}: coverage names unknown event id {ev!r} "
+                    "(not in flightrec.EV_NAMES)")
+    stale = sorted(set(cov) - set(wire_opcodes()))
+    for op in stale:
+        findings.append(
+            f"{op}: MSG_EV_COVERAGE entry for an opcode that no longer "
+            "exists in ps/service.py or ps/wire.py")
+
+    with open(os.path.join(_REPO, "docs", "TUNING.md")) as f:
+        tuning = f.read()
+    for flag in defined_flags():
+        # a flag is "documented" when its name appears anywhere in
+        # TUNING.md (knob row, prose, or the wiring-flag table)
+        if flag not in tuning:
+            findings.append(
+                f"flag {flag!r}: not mentioned in docs/TUNING.md — add "
+                "a knob row (or a wiring-flags table entry)")
+    return findings
+
+
+def main(argv=None) -> int:
+    findings = check()
+    for f in findings:
+        print(f"OBS-SURFACE: {f}")
+    if findings:
+        print(f"{len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    print(f"observability surface clean: "
+          f"{len(wire_opcodes())} opcodes covered, "
+          f"{len(defined_flags())} flags documented")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

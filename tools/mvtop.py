@@ -119,20 +119,26 @@ def render(rec: Dict, prev: Optional[Dict] = None,
              f"  (stats from {rec.get('polled', 0)})"]
     lines.append(f"{'rank':<5} {'status':<12} {'gen':>4} "
                  f"{'addr':<22} {'queue':>6} "
-                 f"{'infl':>5} {'oldest_s':>9} {'serve_age':>10}")
+                 f"{'infl':>5} {'oldest_s':>9} {'serve_age':>10} "
+                 f"{'stall%':>7} {'recomp':>6}")
     for r in sorted(rec.get("ranks", {}), key=int):
         e = rec["ranks"][r]
         status = e.get("status", "?")
         if e.get("stats_error"):
             status += "*"       # health answered, stats did not
         # incarnation generation: gen>0 = this rank was respawned by
-        # the failover plane (the at-a-glance restarted-shard signal)
+        # the failover plane (the at-a-glance restarted-shard signal).
+        # stall% / recomp come from the step profiler's MSG_STATS block
+        # (flag step_profile): wall time no phase claimed, and
+        # steady-state recompiles past step 1 — "-" when not profiling
         lines.append(
             f"{r:<5} {status:<12} {_fmt(e.get('gen')):>4} "
             f"{_fmt(e.get('addr')):<22} "
             f"{_fmt(e.get('queue_depth')):>6} {_fmt(e.get('inflight')):>5} "
             f"{_fmt(e.get('oldest_inflight_s')):>9} "
-            f"{_fmt(e.get('serve_age_s')):>10}")
+            f"{_fmt(e.get('serve_age_s')):>10} "
+            f"{_fmt(e.get('stall_pct'), 1):>7} "
+            f"{_fmt(e.get('recompiles')):>6}")
         if e.get("error"):
             lines.append(f"      {e['error']}")
     mons = rec.get("monitors", {})

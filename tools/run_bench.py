@@ -83,6 +83,13 @@ _REGRESSION_KEYS = (
     (("serving", "infer_p99_ms"), "serving inference p99"),
 )
 
+# healthy fully-attributed runs record stall_fraction ~0.0 — the
+# `old <= 0` guard in the ratio loop (written for impossible-zero
+# latencies) would then suppress stall-growth flags forever, so the
+# stall comparison floors the baseline at this value instead (a new
+# stall above 2 x 5% flags even against a perfect-zero prior)
+_STALL_BASELINE_FLOOR = 0.05
+
 # bench-extra keys where HIGHER is better: flagged when the new run
 # DROPPED by more than the factor (the served-QPS mirror of the
 # latency-growth flags above)
@@ -148,6 +155,29 @@ def flag_regressions(prev_headline, new_headline, factor: float = 2.0):
             out.append(f"{label}: {new} vs {old} previously "
                        f"({old / new:.1f}x drop, flag threshold "
                        f"{factor}x)")
+    # step-profiler stall fraction (ISSUE 9): wall time NO phase/span
+    # claimed in the WE async measured epoch — the number that rises
+    # when every latency monitor holds. Floored baseline (see
+    # _STALL_BASELINE_FLOOR): 0.0 is the HEALTHY prior here, not a
+    # skip-worthy missing measurement
+    old_sf = _extra_value(prev_headline, ("profile", "stall_fraction"))
+    new_sf = _extra_value(new_headline, ("profile", "stall_fraction"))
+    if old_sf is not None and new_sf is not None:
+        base = max(old_sf, _STALL_BASELINE_FLOOR)
+        if new_sf > factor * base:
+            out.append(f"WE step stall fraction: {new_sf} vs {old_sf} "
+                       f"previously (flag threshold {factor}x over "
+                       f"max(prev, {_STALL_BASELINE_FLOOR}))")
+    # steady-state recompiles (step profiler): ANY nonzero count past
+    # step 1 is flagged outright — not run-over-run — because a healthy
+    # steady state compiles exactly zero times and a silent mid-run
+    # retrace re-traces every step it touches (the worker also asserts
+    # this in-run; the flag catches records produced by older workers)
+    sr = _extra_value(new_headline, ("profile", "steady_recompiles"))
+    if sr:
+        out.append(f"steady-state recompiles: {sr} jit compiles "
+                   "attributed past step 1 (expected 0; see "
+                   "extra.profile and tools/mvprof.py)")
     # shard-skew growth: a scale-out run whose row traffic collapsed
     # onto one shard is a regression even when every latency held
     old_skews, new_skews = (_cluster_skews(prev_headline),
