@@ -1119,6 +1119,16 @@ def main() -> None:
         flightrec_dumps = flightrec.dump_stats()
     except Exception as e:
         flightrec_dumps = {"error": f"{type(e).__name__}: {e}"[:200]}
+    # memory plane (telemetry/memstats.py), snapshotted BEFORE shutdown
+    # like the dashboard: one final ledger sample, then the run's peaks
+    # — kernel-tracked VmHWM for RSS plus the sampled ledger/device
+    # high-waters. run_bench.py flags >2x run-over-run growth of the
+    # peak RSS / retained-frame bytes, never fails.
+    try:
+        from multiverso_tpu.telemetry import memstats as _memstats_mod
+        memory_stats_rec = _memstats_mod.bench_extra()
+    except Exception as e:
+        memory_stats_rec = {"error": f"{type(e).__name__}: {e}"[:200]}
     mv.shutdown()
 
     baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -1156,6 +1166,7 @@ def main() -> None:
         "serving": serving_stats,
         "dashboard_hist": dashboard_hist,
         "flightrec_dumps": flightrec_dumps,
+        "memory": memory_stats_rec,
     }
     # phase-level profile of the WE async measured epoch (step profiler,
     # ISSUE 9): first-class extra key so tools/run_bench.py can flag

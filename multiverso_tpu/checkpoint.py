@@ -18,14 +18,70 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 from typing import Dict, Optional
 
 import jax
 import numpy as np
 
 from multiverso_tpu.io.stream import open_stream
+from multiverso_tpu.telemetry import memstats as _memstats
 from multiverso_tpu.utils import log
 from multiverso_tpu.zoo import Zoo
+
+
+class _CheckpointGauges:
+    """Byte-ledger gauges for the checkpoint plane (telemetry/
+    memstats.py): host bytes STAGED by in-progress saves (owned copies
+    of shard data + updater-state leaves, nonzero only while a save
+    runs) and the on-disk size of the last committed tag per rank
+    base. One process-global instance — but NOT one save at a time:
+    an in-process multi-rank world runs one ShardCheckpointer thread
+    per rank, so staging ACCUMULATES (stage/unstage deltas under a
+    lock; one save zeroing a flat field would blank another rank's
+    live figure) and committed-tag sizes key by base directory."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._staging = 0
+        self._tags: Dict[str, int] = {}
+
+    def stage(self, nbytes: int) -> None:
+        with self._lock:
+            self._staging += int(nbytes)
+
+    def unstage(self, nbytes: int) -> None:
+        with self._lock:
+            self._staging -= int(nbytes)
+
+    def note_tag(self, base: str, nbytes: int) -> None:
+        with self._lock:
+            self._tags[base] = int(nbytes)
+
+    def memory_stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"staging_bytes": max(int(self._staging), 0),
+                    "disk_tag_bytes": int(sum(self._tags.values()))}
+
+
+_GAUGES = _CheckpointGauges()
+_memstats.register("checkpoint", _GAUGES)
+
+
+def _dir_bytes(path: str) -> int:
+    """Total file bytes under ``path`` (pull-time only; a missing tree
+    reads as 0 — the gauge must never fail a save)."""
+    total = 0
+    try:
+        for root, _dirs, files in os.walk(path):
+            for fn in files:
+                try:
+                    total += os.path.getsize(os.path.join(root, fn))
+                except OSError:
+                    pass
+    except OSError:
+        pass
+    return total
 
 
 def _join(base: str, *parts: str) -> str:
@@ -444,8 +500,18 @@ def save_shard_state(directory: str, rank: int, tables) -> str:
     metas = []
     for name, shard in shards:
         meta, arrays = shard.checkpoint_state()
+        # ledger gauge: this save's owned host copies, released as
+        # each shard's file lands (staging peaks at one shard's
+        # snapshot per concurrent save, not the whole rank's) —
+        # delta-accumulated so concurrent per-rank checkpointers in
+        # one process never blank each other's figure
+        staged = sum(int(getattr(a, "nbytes", 0)) for a in arrays)
         fname = f"{name}.mvs"
-        _save_shard_file(os.path.join(path, fname), meta, arrays)
+        _GAUGES.stage(staged)
+        try:
+            _save_shard_file(os.path.join(path, fname), meta, arrays)
+        finally:
+            _GAUGES.unstage(staged)
         manifest["tables"][name] = {"file": fname,
                                     "kind": meta.get("kind"),
                                     "version": meta.get("version")}
@@ -453,6 +519,7 @@ def save_shard_state(directory: str, rank: int, tables) -> str:
     with open(os.path.join(path, "manifest.json"), "w") as f:
         json.dump(manifest, f, indent=1)
     _write_commit(path)
+    _GAUGES.note_tag(base, _dir_bytes(path))
     # durable ONLY now: the marks must never run ahead of a commit a
     # replacement could actually restore
     for shard, meta in metas:

@@ -134,6 +134,20 @@ class ShardCheckpointer:
         self.last_path: Optional[str] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # memory ledger (telemetry/memstats.py): on-disk footprint of
+        # this rank's kept checkpoint tags. The walk runs ONCE here
+        # and once after each committed save/prune — the only moments
+        # the size changes — never on a ledger pull: pulls ride the
+        # watchdog's 0.5 s liveness sweep, and repeated synchronous
+        # directory walks (arbitrarily slow on NFS) would stall it
+        self._disk_bytes = checkpoint._dir_bytes(
+            checkpoint._shard_base(directory, self.rank))
+        from multiverso_tpu.telemetry import memstats as _memstats
+        _memstats.register(f"failover_ckpt[r{self.rank}]", self)
+
+    def memory_stats(self) -> Dict[str, int]:
+        return {"disk_bytes": int(self._disk_bytes),
+                "saves": self.saves}
 
     def checkpoint_now(self) -> Optional[str]:
         """One committed save + prune; returns the tag path (None when
@@ -146,6 +160,8 @@ class ShardCheckpointer:
         checkpoint.prune_shard_tags(self.directory, self.rank, self.keep)
         self.saves += 1
         self.last_path = path
+        self._disk_bytes = checkpoint._dir_bytes(
+            checkpoint._shard_base(self.directory, self.rank))
         return path
 
     def start(self) -> "ShardCheckpointer":

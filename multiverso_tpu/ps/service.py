@@ -55,6 +55,7 @@ from multiverso_tpu.serving import replica as _serving_replica
 from multiverso_tpu.telemetry import aggregator as _aggregator
 from multiverso_tpu.telemetry import exporter as _exporter
 from multiverso_tpu.telemetry import flightrec as _flight
+from multiverso_tpu.telemetry import memstats as _memstats
 from multiverso_tpu.telemetry import profiler as _profiler
 from multiverso_tpu.telemetry import trace as _trace
 from multiverso_tpu.telemetry import watchdog as _watchdog
@@ -576,6 +577,10 @@ class PSService:
         _profiler.configure(rank)
         log.set_rank(rank)
         _watchdog.ensure_started()
+        # memory sampler (flag memstats_interval_s; the byte LEDGER is
+        # always on and pull-only — this only starts the RSS/device-
+        # census cadence feeding the windowed leak verdicts)
+        _memstats.ensure_started()
         self._peers: Dict[int, _Peer] = {}
         self._peers_lock = threading.Lock()
         self._peer_locks: Dict[int, threading.Lock] = {}
@@ -780,6 +785,9 @@ class PSService:
                 reply = wire.encode(MSG_REPLY_OK, msg_id, rmeta, rarrays)
         except Exception as e:
             log.debug("ps handler error: %s", e)
+            if isinstance(e, MemoryError):
+                # OOM forensics (same rule as the python serve loop)
+                _memstats.oom_dump("MemoryError serving a punted request")
             reply = wire.encode(MSG_REPLY_ERR, msg_id,
                                 {"error": f"{type(e).__name__}: {e}"})
         # _native_raw, not _native: close() clears the latter while punts
@@ -832,6 +840,14 @@ class PSService:
             if profile:
                 payload["profile"] = profile
         except Exception:   # noqa: BLE001
+            pass
+        # memory plane (telemetry/memstats.py): the byte ledger + RSS +
+        # recent leak verdicts. Process-global like the monitors (same
+        # (host, pid) dedupe in the aggregator); always present — the
+        # ledger is always on, like the flight recorder.
+        try:
+            payload["memory"] = _memstats.stats_snapshot()
+        except Exception:   # noqa: BLE001 — telemetry never breaks stats
             pass
         return payload
 
@@ -1072,6 +1088,13 @@ class PSService:
                                    msg_id=msg_id)
                 except Exception as e:  # reply errors, don't kill the conn
                     log.debug("ps handler error: %s", e)
+                    if isinstance(e, MemoryError):
+                        # OOM forensics: dump the ledger + device census
+                        # through the flight recorder's fault path WHILE
+                        # the hoards are still reachable — the one
+                        # moment the byte ledger answers "what ate it"
+                        _memstats.oom_dump(
+                            "MemoryError serving a request")
                     with send_lock:
                         wire.send(conn, MSG_REPLY_ERR, msg_id,
                                   {"error": f"{type(e).__name__}: {e}"})

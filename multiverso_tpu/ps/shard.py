@@ -47,6 +47,7 @@ from multiverso_tpu.ps import wire
 from multiverso_tpu.table import _ceil_to
 from multiverso_tpu.telemetry import flightrec as _flight
 from multiverso_tpu.telemetry import hotkeys as _hotkeys
+from multiverso_tpu.telemetry import memstats as _memstats
 from multiverso_tpu.tables.matrix_table import _bucket_size
 from multiverso_tpu.telemetry import trace as _trace
 from multiverso_tpu.updaters import AddOption, Updater
@@ -330,6 +331,23 @@ class RowShard:
         self._durable_floor: Dict[str, int] = {}
         self._stamp_lock = threading.Lock()
         self._stat_dup_frames = 0
+        # memory ledger (telemetry/memstats.py): live pins by identity,
+        # id(pin) -> (t0 mono, buffer bytes, id(buffer)). The registry
+        # records bytes AT PIN TIME and never references the buffer —
+        # a ledger entry keeping a retired epoch alive would be this
+        # plane's own leak. One dict store/pop per get, under the lock
+        # the pin already takes; the gauges themselves are pull-only.
+        self._pin_reg: Dict[int, Tuple[float, int, int]] = {}
+        # last successful gauge pull, served when the shard lock is
+        # contended (see memory_stats): the LIVENESS sweep drives the
+        # ledger, and a sweep that blocked on a wedged apply would
+        # hang the watchdog on exactly the wedge it exists to report
+        self._mem_cache: Dict[str, Any] = {
+            "table_bytes": int(getattr(self._data, "nbytes", 0)),
+            "ustate_bytes": 0, "dtype": str(self.dtype),
+            "pins": 0, "pinned_epochs": 0, "retired_epochs": 0,
+            "retired_bytes": 0, "oldest_pin_age_s": 0.0}
+        _memstats.register(f"shard[{name}:{self.lo}-{self.hi}]", self)
 
     def _place_rows(self, host):
         """Place a row buffer honoring the size-gated local-device sharding
@@ -466,6 +484,61 @@ class RowShard:
         wedged — so this is deliberately NOT the stats() path."""
         return len(self._addq)
 
+    def memory_stats(self) -> Dict[str, Any]:
+        """Byte-ledger gauges (telemetry/memstats.py, pull-only): the
+        live data buffer, updater state, the pinned read epochs — how
+        many DISTINCT buffers pins hold, how many of those are RETIRED
+        (COW-swapped out, alive only through their pins: the exact
+        hoard the ``_pin_buf`` anchor bug silently carried) and their
+        deduped bytes, the oldest pin's age — and the apply queue's
+        pending payload. Counters and attr reads only; never touches
+        buffer contents.
+
+        NON-BLOCKING on the shard lock: the watchdog's liveness sweep
+        drives the verdict engine, and a pull that blocked behind a
+        multi-second (or wedged) apply would hang the watchdog on
+        exactly the condition it exists to report. A contended pull
+        serves the last successful reading marked ``"stale": True`` —
+        the ledger tolerates a one-sweep-old figure."""
+        if self._lock.acquire(blocking=False):
+            try:
+                data_nb = int(getattr(self._data, "nbytes", 0))
+                live_id = id(self._data)
+                pins = list(self._pin_reg.values())
+                ustate_nb = sum(int(getattr(l, "nbytes", 0))
+                                for l in jax.tree.leaves(self._ustate))
+            finally:
+                self._lock.release()
+            now = time.monotonic()
+            epochs: Dict[int, int] = {}
+            for _t0, nb, buf_id in pins:
+                epochs.setdefault(buf_id, nb)
+            retired = {b: nb
+                       for b, nb in epochs.items() if b != live_id}
+            oldest = max((now - t0 for t0, _nb, _b in pins),
+                         default=0.0)
+            core = {
+                "table_bytes": data_nb,
+                "ustate_bytes": int(ustate_nb),
+                "dtype": str(self.dtype),
+                "pins": len(pins),
+                "pinned_epochs": len(epochs),
+                "retired_epochs": len(retired),
+                "retired_bytes": int(sum(retired.values())),
+                "oldest_pin_age_s": round(oldest, 3),
+            }
+            self._mem_cache = core
+        else:
+            core = dict(self._mem_cache)
+            core["stale"] = True
+        with self._addq_lock:   # short holds only — never spans a jit
+            qd = len(self._addq)
+            qb = sum(e.local.nbytes + e.vals.nbytes for e in self._addq)
+        out = dict(core)
+        out["queue_depth"] = qd
+        out["queue_pending_bytes"] = int(qb)
+        return out
+
     @property
     def scratch(self) -> int:
         return self.n
@@ -493,7 +566,11 @@ class RowShard:
             self._pin_buf = self._data
             self._cur_pins = 0
         self._cur_pins += 1
-        return _DataPin(self._data, self._version)
+        pin = _DataPin(self._data, self._version)
+        self._pin_reg[id(pin)] = (time.monotonic(),
+                                  int(getattr(self._data, "nbytes", 0)),
+                                  id(self._data))
+        return pin
 
     def _pin_data(self) -> _DataPin:
         with self._lock:
@@ -501,6 +578,7 @@ class RowShard:
 
     def _release_data(self, pin: _DataPin) -> None:
         with self._lock:
+            self._pin_reg.pop(id(pin), None)
             if pin.data is self._pin_buf and self._cur_pins > 0:
                 self._cur_pins -= 1
                 if self._cur_pins == 0:

@@ -34,6 +34,7 @@ from multiverso_tpu.ps import service as svc
 from multiverso_tpu.ps import wire as wire_mod
 from multiverso_tpu.ps.shard import KVShard, RowShard
 from multiverso_tpu.telemetry import flightrec as _flight
+from multiverso_tpu.telemetry import memstats as _memstats
 from multiverso_tpu.telemetry import profiler as _profiler
 from multiverso_tpu.telemetry import trace as ttrace
 from multiverso_tpu.updaters import AddOption
@@ -495,6 +496,11 @@ class _SendWindow:
         # stamped, retained, re-flushed frames. The peer-death hook is
         # weakref-bound — the service's hook list outlives any one
         # table and must not pin it (same rule as the flusher thread)
+        # memory ledger (telemetry/memstats.py): pending window payloads
+        # + the replay retention tail — the PR-7 hoard that grows
+        # silently when no failover checkpointer advances the durable
+        # floor. Registration only; gauges are pull-time.
+        _memstats.register(f"window[{table.name}]", self)
         self._replay: Optional[_ReplayBuffer] = None
         if config.get_flag("ps_replay"):
             self._replay = _ReplayBuffer(table)
@@ -563,6 +569,53 @@ class _SendWindow:
             self._deadline = None
         for owner in owners:
             self._flush_owner(owner)
+
+    def memory_stats(self) -> Dict[str, Any]:
+        """Byte-ledger gauges (telemetry/memstats.py, pull-only): queued
+        window payloads awaiting flush, and the replay plane's retained
+        frames — per owner and total, with how many are ARMED for
+        re-send (armed > 0 means the owner is dead/being failed over,
+        which the retention-leak verdict treats as failover working,
+        not hoarding). Bytes are the frames' actual wire blobs."""
+        with self._cv:
+            pending_ops = sum(len(q) for q in self._pending.values())
+            pending_bytes = sum(self._nbytes.values())
+        out: Dict[str, Any] = {
+            "pending_ops": int(pending_ops),
+            "pending_bytes": int(pending_bytes),
+            "retained_frames": 0, "retained_bytes": 0,
+            "armed_frames": 0,
+        }
+        rp = self._replay
+        if rp is None:
+            return out
+        def _nb(a) -> int:
+            # lazy fallback: frame blobs are ndarrays (nbytes); a raw
+            # bytes blob falls back to len only when nbytes is absent
+            nb = getattr(a, "nbytes", None)
+            return int(nb) if nb is not None else len(a)
+
+        owners: Dict[str, Dict[str, int]] = {}
+        with rp.lock:
+            for owner, q in rp.retained.items():
+                fb = sum(sum(_nb(a) for a in fr.arrays)
+                         for fr in q.values())
+                # armed PER OWNER: the retention-leak verdict judges
+                # each owner separately — one dead owner's re-armed
+                # tail (failover working) must not mask another LIVE
+                # owner's unpruned hoard
+                owners[str(owner)] = {
+                    "retained_frames": len(q),
+                    "retained_bytes": int(fb),
+                    "armed_frames": max(
+                        int(rp.pending_send.get(owner, 0)), 0)}
+                out["retained_frames"] += len(q)
+                out["retained_bytes"] += int(fb)
+            out["armed_frames"] = sum(max(int(n), 0)
+                                      for n in rp.pending_send.values())
+        if owners:
+            out["owners"] = owners
+        return out
 
     # idle condvar waits are bounded so the flusher can notice its window
     # died (see _window_loop's weakref) instead of pinning it forever

@@ -63,6 +63,7 @@ import numpy as np
 from multiverso_tpu.serving.admission import (AdmissionController,
                                               SheddingError)
 from multiverso_tpu.telemetry import hotkeys as _hotkeys
+from multiverso_tpu.telemetry import memstats as _memstats
 from multiverso_tpu.utils import config, log
 from multiverso_tpu.utils.dashboard import Dashboard
 
@@ -210,9 +211,29 @@ class ReadReplica:
 
         self._closed = False
         self._thread: Optional[threading.Thread] = None
+        # in-flight refresh staging copy (bytes; nonzero only while a
+        # pull is assembling its fresh buffer) — the memory ledger's
+        # view of the transient second table each refresh costs
+        self._staging_nb = 0
         _REPLICAS.add(self)
+        _memstats.register(f"replica[{self.name}]", self)
         if start:
             self.start()
+
+    def memory_stats(self) -> Dict[str, Any]:
+        """Byte-ledger gauges (telemetry/memstats.py, pull-only): the
+        adopted snapshot buffer, the device-resident hot-row cache, and
+        the transient refresh staging copy."""
+        with self._swap_lock:
+            data, cdev, cids = self._data, self._cache_dev, self._cache_ids
+        return {
+            "snapshot_bytes": int(getattr(data, "nbytes", 0) or 0)
+            if data is not None else 0,
+            "cache_device_bytes": (int(getattr(cdev, "nbytes", 0) or 0)
+                                   if cdev is not None else 0),
+            "cache_rows": 0 if cids is None else int(cids.size),
+            "staging_bytes": int(self._staging_nb),
+        }
 
     # ------------------------------------------------------------------ #
     # lifecycle
@@ -356,12 +377,15 @@ class ReadReplica:
         else:
             staging = cur   # nothing applied anywhere: the epoch
             #                 advances, the buffer stays
+        if staging is not cur:
+            self._staging_nb = int(staging.nbytes)   # ledger gauge
         for (lo, hi), rows in changed.items():
             staging[lo:hi] = rows
         cache_ids = cache_dev = None
         if self.cache_capacity > 0:
             cache_ids, cache_dev = self._build_cache(staging)
         with self._swap_lock:
+            snapshot_moved = staging is not cur
             self._data = staging
             self._versions = versions
             self._gens = gens
@@ -370,6 +394,19 @@ class ReadReplica:
             self._last_refresh_ms = (time.monotonic() - t_start) * 1e3
             if cache_ids is not None:
                 self._cache_ids, self._cache_dev = cache_ids, cache_dev
+            elif snapshot_moved:
+                # the snapshot content moved but no same-epoch cache was
+                # built (no hot ids yet / device placement failed): DROP
+                # the old cache at the swap commit. Keeping it would (a)
+                # pin a full device-resident row block from a RETIRED
+                # epoch until whenever the next successful build lands —
+                # the same shape as the PR-5 _pin_buf identity-anchor
+                # hoard — and (b) let cache_lookup serve rows the
+                # adopted snapshot no longer contains, breaking the
+                # "cache and snapshot are always the same epoch"
+                # contract the class docstring promises.
+                self._cache_ids = self._cache_dev = None
+            self._staging_nb = 0
         # flight recorder + trace span: one refresh = one event/span, so
         # serving refresh traffic appears on the same timeline as the
         # data plane (nbytes = rows actually re-shipped this cycle)
@@ -418,7 +455,10 @@ class ReadReplica:
         caller installs the result under the same lock hold that swaps
         the snapshot in, so cache rows and snapshot rows are always
         the same epoch. Returns ``(ids, device_rows)`` or ``(None,
-        None)`` (= leave the previous cache in place)."""
+        None)`` — the swap then DROPS the previous cache when the
+        snapshot content moved (an old-epoch device cache must neither
+        stay pinned nor serve retired rows) and keeps it only across
+        unchanged epochs."""
         ids = self._hot_ids
         if ids is None or ids.size == 0:
             return None, None
@@ -426,8 +466,9 @@ class ReadReplica:
             import jax.numpy as jnp
             return ids, jnp.asarray(data[ids])
         except Exception as e:   # noqa: BLE001 — a device placement
-            # failure must not fail the snapshot swap; the cache just
-            # stays on its previous epoch (or off)
+            # failure must not fail the snapshot swap; the swap drops
+            # the cache for this epoch (served from host until a build
+            # succeeds) rather than serving a retired epoch's rows
             log.debug("replica[%s] cache build failed: %s",
                       self.name, e)
             return None, None

@@ -45,6 +45,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from multiverso_tpu import updaters as updaters_lib
 from multiverso_tpu.ops import wire_codec
+from multiverso_tpu.telemetry import memstats as _memstats
 from multiverso_tpu.updaters import AddOption
 from multiverso_tpu.utils import config, log
 from multiverso_tpu.utils.dashboard import Dashboard, monitor
@@ -237,6 +238,24 @@ class Table:
         self._addq_cv = threading.Condition()
         self._addq_inflight = 0
         self._add_applier: Optional[threading.Thread] = None
+        # memory ledger (telemetry/memstats.py): the PR-1 get cache and
+        # the write-triggered prefetch staging buffer are the sync
+        # plane's two table-sized hoards; gauges are pull-only
+        _memstats.register(f"table[{name}]", self)
+
+    def memory_stats(self) -> Dict[str, Any]:
+        """Byte-ledger gauges: cached whole-table Get host copy +
+        in-flight prefetch snapshot (device) bytes. Lock-free reads of
+        the two tuple refs — benign vs the dispatch lock, and the
+        ledger tolerates a one-sample-stale figure."""
+        cache = self._get_cache
+        pf = self._get_prefetch
+        return {
+            "cache_bytes": (int(cache[1].nbytes)
+                            if cache is not None else 0),
+            "prefetch_bytes": (int(getattr(pf[1], "nbytes", 0))
+                               if pf is not None else 0),
+        }
 
     # ------------------------------------------------------------------ #
     # construction helpers

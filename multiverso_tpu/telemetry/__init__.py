@@ -9,7 +9,7 @@ the shutdown report (and any exporter) sees p50/p90/p99/max — tail
 regressions on the batched, compressed PS plane do not hide behind a
 stable mean.
 
-Seven cooperating pieces:
+Eight cooperating pieces:
 
 * :mod:`~multiverso_tpu.telemetry.histogram` — the lock-free (caller-
   synchronized) log2-bucket histogram every Monitor embeds.
@@ -33,6 +33,13 @@ Seven cooperating pieces:
   memory Space-Saving heavy-hitter sketch each shard keeps over its
   served row ids; feeds ``stats()["hotkeys"]`` and the cluster top-K +
   cache-hit-if-cached curve.
+* :mod:`~multiverso_tpu.telemetry.memstats` — the ALWAYS-ON byte
+  ledger: every owning component (shard, send window, table, replica,
+  checkpointer) registers pull-only memory gauges; a flag-gated
+  sampler adds host RSS + a ``jax.live_arrays()`` device census, leak
+  verdicts (epoch-hoard, retention-leak, rss-creep) ride the watchdog
+  sweep, and every flight-recorder dump carries the ledger + sample
+  history for OOM forensics (docs/OBSERVABILITY.md "Memory view").
 * :mod:`~multiverso_tpu.telemetry.aggregator` — the controller-side
   cluster plane: flag-gated (``stats_poll_interval_s``) polling of
   every rank's MSG_STATS + MSG_HEALTH over one-shot probe connections,

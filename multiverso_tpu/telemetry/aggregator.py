@@ -95,6 +95,18 @@ def merge_hist_dicts(dicts: List[Optional[Dict]]) -> Dict:
     return out
 
 
+def _proc_key(st: Dict, rank) -> tuple:
+    """The (addr host, pid) process identity used to dedupe PROCESS-
+    global payload blocks (monitors, serving, profile, memory) when
+    several in-process ranks report the same registry; payloads
+    without a pid (older peers) fall back to per-rank identity. ONE
+    definition — four merge sections key on it."""
+    pid = st.get("pid")
+    if pid is None:
+        return ("rank", rank)
+    return ((st.get("addr") or "").rsplit(":", 1)[0], pid)
+
+
 def _skew(traffic: List[float]) -> float:
     """Max/mean imbalance of per-shard traffic; 1.0 = perfectly even
     (and the degenerate empty/zero cases, where no imbalance exists)."""
@@ -155,10 +167,8 @@ def merge_cluster(stats_by_rank: Dict[int, Any],
         st = stats_by_rank[r]
         if not isinstance(st, dict):
             continue
-        pid = st.get("pid")
-        if pid is not None:
-            addr = st.get("addr") or ""
-            proc = (addr.rsplit(":", 1)[0], pid)
+        if st.get("pid") is not None:
+            proc = _proc_key(st, r)
             if proc in seen_procs:
                 continue
             seen_procs.add(proc)
@@ -183,9 +193,7 @@ def merge_cluster(stats_by_rank: Dict[int, Any],
         st = stats_by_rank[r]
         if not isinstance(st, dict):
             continue
-        pid = st.get("pid")
-        proc = (((st.get("addr") or "").rsplit(":", 1)[0], pid)
-                if pid is not None else ("rank", r))
+        proc = _proc_key(st, r)
         for tname, sh in st.get("shards", {}).items():
             if not isinstance(sh, dict) or "error" in sh:
                 tables.setdefault(tname, {"shards": {}})["shards"][
@@ -224,9 +232,7 @@ def merge_cluster(stats_by_rank: Dict[int, Any],
         srv = st.get("serving")
         if not isinstance(srv, dict):
             continue
-        pid = st.get("pid")
-        proc = (((st.get("addr") or "").rsplit(":", 1)[0], pid)
-                if pid is not None else ("rank", r))
+        proc = _proc_key(st, r)
         if proc in seen_srv:
             continue
         seen_srv.add(proc)
@@ -275,6 +281,51 @@ def merge_cluster(stats_by_rank: Dict[int, Any],
             ent["recompiles"] = p.get("steady_recompiles")
     if profile:
         rec["profile"] = profile
+    # memory plane (telemetry/memstats.py): per-rank ledger digests +
+    # cluster totals. The block is PROCESS-global like the monitors
+    # (one ledger per OS process), so totals dedupe by (host, pid) —
+    # an in-process multi-rank world reports the same process under
+    # each of its ranks but is summed once.
+    memory: Dict[str, Dict] = {}
+    mem_totals: Dict[str, float] = {}
+    seen_mem: set = set()
+    for r in sorted(stats_by_rank):
+        st = stats_by_rank[r]
+        if not isinstance(st, dict):
+            continue
+        m = st.get("memory")
+        if not isinstance(m, dict):
+            continue
+        t = m.get("totals") or {}
+        ent = {
+            "rss_mb": m.get("rss_mb"), "hwm_mb": m.get("hwm_mb"),
+            "device_bytes": m.get("device_bytes"),
+            "table_bytes": t.get("table_bytes"),
+            "retained_bytes": t.get("retained_bytes"),
+            "pending_bytes": t.get("pending_bytes"),
+            "pinned_epochs": t.get("pinned_epochs"),
+            "retired_bytes": t.get("retired_bytes"),
+            "samples": m.get("samples"),
+            "verdicts": [v.get("kind") for v in (m.get("verdicts") or [])
+                         if isinstance(v, dict)][-4:],
+        }
+        memory[str(r)] = ent
+        proc = _proc_key(st, r)
+        if proc in seen_mem:
+            continue
+        seen_mem.add(proc)
+        for k in ("rss_mb", "device_bytes", "table_bytes",
+                  "retained_bytes", "pending_bytes", "retired_bytes",
+                  "pinned_epochs"):
+            v = ent.get(k)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                mem_totals[k] = mem_totals.get(k, 0) + v
+    if memory:
+        rec["memory"] = {
+            "ranks": memory,
+            "totals": {k: (round(v, 3) if k == "rss_mb" else int(v))
+                       for k, v in sorted(mem_totals.items())},
+        }
     if hot:
         rec["hotkeys"] = {}
         for tname, sketches in hot.items():
@@ -400,6 +451,10 @@ def compact_record(rec: Dict, top: int = 8,
     if rec.get("profile"):
         # per-rank step-profiler summaries (already compact)
         out["profile"] = rec["profile"]
+    if rec.get("memory"):
+        # per-rank RSS/device/ledger digests + cluster totals (already
+        # compact) — run_bench compares peak figures run-over-run
+        out["memory"] = rec["memory"]
     mons: Dict[str, Any] = {}
     for n, m in sorted(rec.get("monitors", {}).items()):
         if not m.get("timed"):

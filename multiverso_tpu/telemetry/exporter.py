@@ -96,6 +96,30 @@ def prometheus_text(payload: Dict) -> str:
                 lines.append(
                     f'mv_shard_{k}{{table="{_prom_name(table)}",'
                     f'rank="{rank}"}} {v}')
+    # memory plane (telemetry/memstats.py): process gauges + per-
+    # component byte gauges off the MSG_STATS "memory" block
+    mem = payload.get("memory")
+    if isinstance(mem, dict):
+        lines.append("# TYPE mv_mem_rss_mb gauge")
+        lines.append("# TYPE mv_mem_component gauge")
+        for k in ("rss_mb", "hwm_mb", "device_bytes", "samples"):
+            v = mem.get(k)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                lines.append(f'mv_mem_{k}{{rank="{rank}"}} {v}')
+        for k, v in sorted((mem.get("totals") or {}).items()):
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                lines.append(f'mv_mem_total_{k}{{rank="{rank}"}} {v}')
+        for comp in sorted(mem.get("components") or {}):
+            g = mem["components"][comp]
+            if not isinstance(g, dict):
+                continue
+            for k, v in sorted(g.items()):
+                if (isinstance(v, (int, float))
+                        and not isinstance(v, bool)):
+                    lines.append(
+                        f'mv_mem_component{{component='
+                        f'"{_prom_name(comp)}",field="{_prom_name(k)}",'
+                        f'rank="{rank}"}} {v}')
     return "\n".join(lines) + "\n"
 
 

@@ -111,6 +111,13 @@ EV_FAILOVER_REJOIN = 31   # restored incarnation is serving again
 # and replica refreshes ride the same tape as gets/adds
 EV_SNAPSHOT_SERVE = 32    # shard: MSG_SNAPSHOT export served
 EV_REPLICA_PULL = 33      # client: one ReadReplica refresh completed
+# memory observability plane (telemetry/memstats.py): leak verdicts +
+# the OOM-forensics dump trigger, one event per episode (deduped by
+# the ledger until the condition clears — never a per-sweep flood)
+EV_MEM_HOARD = 34         # epoch-hoard: aged pin holding retired buffers
+EV_MEM_LEAK = 35          # retention-leak: replay tail growing, live owner
+EV_MEM_RSS = 36           # rss-creep / rss soft-limit trip
+EV_MEM_DUMP = 37          # OOM forensics dump fired (MemoryError/limit)
 
 EV_NAMES = {
     EV_SEND: "send", EV_ACK: "ack", EV_ERR: "err", EV_RECV: "recv",
@@ -132,6 +139,10 @@ EV_NAMES = {
     EV_FAILOVER_REJOIN: "failover.rejoin",
     EV_SNAPSHOT_SERVE: "snapshot.serve",
     EV_REPLICA_PULL: "replica.pull",
+    EV_MEM_HOARD: "mem.epoch_hoard",
+    EV_MEM_LEAK: "mem.retention_leak",
+    EV_MEM_RSS: "mem.rss",
+    EV_MEM_DUMP: "mem.oom_dump",
 }
 
 # ---------------------------------------------------------------------- #
@@ -164,6 +175,22 @@ MSG_EV_COVERAGE = {
     "MSG_HEALTH": (),        # probe: excluded from the tape (PR 4)
     "MSG_SNAPSHOT": (EV_SNAPSHOT_SERVE, EV_REPLICA_PULL),
 }
+
+
+# ---------------------------------------------------------------------- #
+# auxiliary dump providers: other telemetry planes (memstats' byte
+# ledger + sample history) register a zero-arg callable returning extra
+# JSONL records to append to every dump — fault OR routine — so one
+# artifact carries the wire tape AND the memory timeline. Providers run
+# at DUMP time only (never on the hot path) and are individually
+# fault-isolated: a provider raising must not cost the ring's tape.
+# ---------------------------------------------------------------------- #
+_dump_providers: List[Any] = []
+
+
+def add_dump_provider(fn) -> None:
+    if fn not in _dump_providers:
+        _dump_providers.append(fn)
 
 
 def resolve_dir() -> Optional[str]:
@@ -415,6 +442,12 @@ class FlightRecorder:
                             "thread": names.get(tid, "?"),
                             "frames": [ln.strip()
                                        for ln in lines[-24:]]}) + "\n")
+                for prov in list(_dump_providers):
+                    try:
+                        for rec in prov() or ():
+                            f.write(json.dumps(rec) + "\n")
+                    except Exception:   # noqa: BLE001 — a provider bug
+                        pass            # must not cost the ring's tape
             # commit: _dump_lock (held for this whole method) serializes
             # racing dumps, so a fault dump either finished before this
             # routine one started (the re-check below sees the flag) or

@@ -88,6 +88,17 @@ class Watchdog:
             status = "slow"
         if snap:
             self._report_slow(snap, slow_s)
+        # memory leak verdicts ride the same sweep (telemetry/memstats):
+        # an aged read-epoch pin hoarding retired COW buffers is a wedge
+        # the in-flight table cannot see — the byte ledger can. Late
+        # import (memstats imports flightrec; the watchdog must stay
+        # importable standalone) and fault-isolated like everything
+        # else in this loop.
+        try:
+            from multiverso_tpu.telemetry import memstats as _memstats
+            _memstats.LEDGER.check_verdicts()
+        except Exception as e:   # noqa: BLE001 — verdicts must never
+            log.debug("memstats verdict sweep failed: %s", e)  # kill it
         # live keys only: an op that completed may reuse its msg id much
         # later on a reconnected peer and must be reportable again
         live = {(p, mid) for p, mid, _, _, _ in snap}

@@ -99,6 +99,13 @@ def _fresh_runtime():
     # enabled step_profile must not leak steps into its neighbors)
     from multiverso_tpu.telemetry import profiler as _profiler
     _profiler.reset()
+    # memory plane: stop a leaked sampler thread and drop the ledger's
+    # sample history / verdict episodes / peaks (a test's deliberate
+    # leak must not verdict a neighbor's sweep). Registrations stay:
+    # they are weakrefs — dead components self-prune — and the
+    # import-time module gauges (checkpoint.py) register only once.
+    from multiverso_tpu.telemetry import memstats as _memstats
+    _memstats.reset()
     # flight-recorder plane: drop the ring/in-flight table and stop the
     # watchdog so one test's wedged ops can't trip a neighbor's verdict;
     # unpin the logger's rank stamp too (first-caller-wins, like the

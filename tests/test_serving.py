@@ -321,6 +321,60 @@ class TestHotRowCache:
                                       t0.get_rows([3]))
         rep.close()
 
+    def test_stale_device_cache_dropped_at_swap_commit(self, two_ranks):
+        """Regression (ISSUE 10 satellite): when the snapshot content
+        moves but no same-epoch cache was built (no hot ids / build
+        failure), the swap must DROP the previous device cache — not
+        keep an old-epoch device array pinned (the PR-5 ``_pin_buf``
+        anchor shape) and serving retired rows — while an UNCHANGED
+        epoch keeps it (same content, still epoch-consistent)."""
+        t0, _t1 = _tables(two_ranks, rows=64, cols=4, name="srv_hot3",
+                          updater="adagrad")
+        for _ in range(10):
+            t0.get_rows([3])
+        rep = ReadReplica(t0, start=False, staleness_s=30.0,
+                          cache_rows=4)
+        rep.refresh()
+        assert rep._cache_dev is not None
+        # unchanged epoch + no rebuild: keeping the cache is safe
+        rep._hot_ids = None
+        rep.refresh()
+        assert rep._cache_dev is not None
+        # content moved + no rebuild: the old-epoch cache must go
+        t0.add_rows([3], np.ones((1, 4), np.float32))
+        rep.refresh()
+        assert rep._cache_dev is None and rep._cache_ids is None
+        assert rep.cache_lookup([3]) is None
+        rep.close()
+
+    def test_gc_census_no_device_array_growth_across_refreshes(
+            self, two_ranks):
+        """gc-census regression (ISSUE 10 satellite): 3 refresh cycles
+        with content changes and cache rebuilds must hold the live
+        device-array census flat — each swap's rebind releases the
+        previous epoch's device cache, nothing accumulates."""
+        import gc
+
+        import jax
+        t0, _t1 = _tables(two_ranks, rows=64, cols=4, name="srv_gc",
+                          updater="adagrad")
+        for _ in range(10):
+            t0.get_rows([5, 9])
+        rep = ReadReplica(t0, start=False, staleness_s=30.0,
+                          cache_rows=4)
+        rep.refresh()
+        gc.collect()
+        baseline = len(jax.live_arrays())
+        for i in range(3):
+            t0.add_rows([5], np.full((1, 4), float(i + 1), np.float32))
+            rep.refresh()
+            gc.collect()
+            count = len(jax.live_arrays())
+            assert count <= baseline, (
+                f"live device arrays grew across refresh {i}: "
+                f"{baseline} -> {count} (old-epoch cache retained?)")
+        rep.close()
+
 
 # ---------------------------------------------------------------------- #
 # admission control

@@ -15,19 +15,30 @@ review time. This tool asks it mechanically:
 2. **Every flag** registered via ``config.define_*`` anywhere under
    ``multiverso_tpu/`` must appear in ``docs/TUNING.md`` — a knob an
    operator cannot discover is a knob that does not exist.
+3. **Every top-level key** the stats surface emits — the shard
+   ``stats()`` methods, ``PSService.stats_payload``, the exporter's
+   ``default_stats_fn``, and the memstats ``"memory"`` block — must be
+   RENDERED by at least one of ``tools/mvtop.py`` /
+   ``tools/dump_metrics.py`` (its quoted name appears in their
+   source), or sit on the explicit raw-key allowlist. This is the
+   exact crack that would let a new stats block ship and go dark: the
+   payload grows a key, no pane of glass ever shows it, and the next
+   leak's evidence is emitted into the void.
 
     python tools/check_obs_surface.py        # exit 0 clean, 1 findings
 
-Run by ``tests/test_profiler.py`` in tier-1, so a PR adding an opcode
-or flag without its observability/doc surface fails CI, not review.
+Run by ``tests/test_profiler.py`` in tier-1, so a PR adding an opcode,
+flag, or stats key without its observability/doc surface fails CI, not
+review.
 """
 
 from __future__ import annotations
 
+import ast
 import os
 import re
 import sys
-from typing import List
+from typing import Dict, List
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO not in sys.path:
@@ -65,6 +76,105 @@ def defined_flags() -> List[str]:
     return sorted(set(names))
 
 
+# ---------------------------------------------------------------------- #
+# stats-surface rule (lint 3): emitted keys vs the rendering tools
+# ---------------------------------------------------------------------- #
+# (file, function) pairs whose emitted top-level keys ARE the stats
+# surface — jax-free ast scans, so the lint runs on a bare host
+_STATS_SOURCES = (
+    ("multiverso_tpu/ps/shard.py", "stats"),
+    ("multiverso_tpu/ps/service.py", "stats_payload"),
+    ("multiverso_tpu/telemetry/exporter.py", "default_stats_fn"),
+    ("multiverso_tpu/telemetry/memstats.py", "stats_snapshot"),
+)
+_RENDERERS = ("tools/mvtop.py", "tools/dump_metrics.py")
+
+# intentionally raw keys: shard-stat SCALARS whose only rendering is
+# dump_metrics' generic "k=v" shard join (format_record prints every
+# shard key, so a first-class column would add nothing), plus process
+# identity plumbing. New BLOCK keys (serving/profile/memory-style)
+# never belong here — blocks are structured, not generically joined,
+# and an unrendered block is exactly what this lint exists to catch.
+_STATS_RAW_KEYS = frozenset({
+    "kind", "lo", "rows", "cols", "bytes", "version", "wave_ops",
+    "wave_max_ops", "get_chunks", "cow_applies", "read_pins",
+    "dup_frames", "replay_clients", "snapshots", "snapshots_unchanged",
+    "dirty_rows", "keys", "pending_bytes",
+    "pid",   # the aggregator's (host, pid) process-dedupe token
+})
+
+
+def stats_keys(rel_path: str, func: str,
+               repo: str = _REPO) -> List[str]:
+    """Top-level string keys emitted by every function named ``func``
+    in ``rel_path``: dict-literal keys, ``.update(k=...)`` keyword
+    args, ``.setdefault("k", ...)``, and ``x["k"] = ...`` subscript
+    assigns. Over-approximates (nested literals count too) — a spare
+    entry costs one allowlist line, a missed one costs a dark key."""
+    with open(os.path.join(repo, rel_path)) as f:
+        tree = ast.parse(f.read())
+    keys = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef))
+                and node.name == func):
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Dict):
+                for k in sub.keys:
+                    if (isinstance(k, ast.Constant)
+                            and isinstance(k.value, str)):
+                        keys.add(k.value)
+            elif isinstance(sub, ast.Call):
+                fn = sub.func
+                if isinstance(fn, ast.Attribute) \
+                        and fn.attr in ("update", "setdefault"):
+                    for kw in sub.keywords:
+                        if kw.arg:
+                            keys.add(kw.arg)
+                    if (fn.attr == "setdefault" and sub.args
+                            and isinstance(sub.args[0], ast.Constant)
+                            and isinstance(sub.args[0].value, str)):
+                        keys.add(sub.args[0].value)
+            elif isinstance(sub, ast.Assign):
+                for tgt in sub.targets:
+                    if (isinstance(tgt, ast.Subscript)
+                            and isinstance(tgt.slice, ast.Constant)
+                            and isinstance(tgt.slice.value, str)):
+                        keys.add(tgt.slice.value)
+    return sorted(keys)
+
+
+def stats_surface_findings(
+        keys_by_src: Dict[str, List[str]] = None,
+        renderer_text: str = None,
+        allow: frozenset = _STATS_RAW_KEYS) -> List[str]:
+    """Lint 3 proper: every emitted key must appear quoted in a
+    renderer's source or on the allowlist. Parameters are injectable
+    so tests can prove the rule CATCHES a fabricated dark key."""
+    if keys_by_src is None:
+        keys_by_src = {f"{path}:{func}()": stats_keys(path, func)
+                       for path, func in _STATS_SOURCES}
+    if renderer_text is None:
+        renderer_text = ""
+        for rel in _RENDERERS:
+            with open(os.path.join(_REPO, rel)) as f:
+                renderer_text += f.read()
+    findings = []
+    for src, keys in sorted(keys_by_src.items()):
+        for key in keys:
+            if key in allow:
+                continue
+            if f'"{key}"' in renderer_text or f"'{key}'" in renderer_text:
+                continue
+            findings.append(
+                f"stats key {key!r} (emitted by {src}): rendered by "
+                "neither tools/mvtop.py nor tools/dump_metrics.py — "
+                "add a panel/row (or an explicit raw-key allowlist "
+                "entry) so the block cannot go dark")
+    return findings
+
+
 def check() -> List[str]:
     """All findings as human-readable strings ([] = clean)."""
     findings: List[str] = []
@@ -98,6 +208,7 @@ def check() -> List[str]:
             findings.append(
                 f"flag {flag!r}: not mentioned in docs/TUNING.md — add "
                 "a knob row (or a wiring-flags table entry)")
+    findings.extend(stats_surface_findings())
     return findings
 
 
@@ -108,9 +219,11 @@ def main(argv=None) -> int:
     if findings:
         print(f"{len(findings)} finding(s)", file=sys.stderr)
         return 1
+    nkeys = sum(len(stats_keys(p, fn)) for p, fn in _STATS_SOURCES)
     print(f"observability surface clean: "
           f"{len(wire_opcodes())} opcodes covered, "
-          f"{len(defined_flags())} flags documented")
+          f"{len(defined_flags())} flags documented, "
+          f"{nkeys} stats keys rendered/allowlisted")
     return 0
 
 

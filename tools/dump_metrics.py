@@ -90,6 +90,42 @@ def _monitor_table(mons: Dict) -> List[str]:
     return lines
 
 
+def _mb(v) -> str:
+    return "-" if not isinstance(v, (int, float)) else f"{v / 1e6:.2f}"
+
+
+def _memory_lines(mem: Dict) -> List[str]:
+    """Per-rank MSG_STATS ``memory`` block -> the component byte table
+    (shared by show; telemetry/memstats.py defines the shape)."""
+    lines = [
+        "memory: rss %s MB (hwm %s)  device %s MB  samples %s"
+        % (mem.get("rss_mb", "-"), mem.get("hwm_mb", "-"),
+           _mb(mem.get("device_bytes")), mem.get("samples", 0))]
+    comps = mem.get("components") or {}
+    if comps:
+        lines.append(f"  {'component':<34} {'bytes':>12} {'detail'}")
+        for name in sorted(comps):
+            g = comps[name]
+            if not isinstance(g, dict):
+                continue
+            main = sum(v for k, v in g.items()
+                       if k.endswith("_bytes")
+                       and isinstance(v, (int, float))
+                       and not isinstance(v, bool))
+            detail = ", ".join(
+                f"{k}={v}" for k, v in sorted(g.items())
+                if not isinstance(v, dict))
+            lines.append(f"  {name:<34} {int(main):>12} {detail}")
+    for v in (mem.get("verdicts") or [])[-4:]:
+        if isinstance(v, dict):
+            lines.append("  verdict[%s] %s: " % (v.get("kind"),
+                                                 v.get("component"))
+                         + ", ".join(f"{k}={x}" for k, x in sorted(
+                             v.items())
+                             if k not in ("kind", "component")))
+    return lines
+
+
 def format_record(rec: Dict) -> str:
     """One record -> the human table (pure function; tested directly).
     Cluster records (``kind: "cluster"``) dispatch to
@@ -128,6 +164,9 @@ def format_record(rec: Dict) -> str:
         if phases:
             lines.append("  phases(ms): " + "  ".join(
                 f"{n}={v}" for n, v in sorted(phases.items())))
+    mem = rec.get("memory")
+    if isinstance(mem, dict):
+        lines.extend(_memory_lines(mem))
     for name in sorted(rec.get("notes", {})):
         lines.append(f"note[{name}] {rec['notes'][name]}")
     return "\n".join(lines)
@@ -237,6 +276,16 @@ def format_cluster_record(rec: Dict) -> str:
             % (r, p.get("steps"),
                100.0 * (p.get("stall_fraction") or 0.0),
                p.get("steady_recompiles")))
+    mem = rec.get("memory")
+    if isinstance(mem, dict):
+        t = mem.get("totals", {})
+        lines.append("memory(cluster): " + ", ".join(
+            f"{k}={v}" for k, v in sorted(t.items())))
+        for r in sorted(mem.get("ranks", {}), key=str):
+            e = mem["ranks"][r]
+            lines.append(f"  memory@rank{r}: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(e.items())
+                if v not in (None, [])))
     for tname in sorted(rec.get("hotkeys", {})):
         h = rec["hotkeys"][tname]
         head = "  ".join(f"{k}:{c}" for k, c, _ in h.get("top", [])[:8])
@@ -281,6 +330,17 @@ def diff_cluster_records(a: Dict, b: Dict) -> str:
                     deltas.append(f"{k}: {ra.get(k, 0)} -> {rb.get(k, 0)}")
             if deltas:
                 lines.append("  " + ", ".join(deltas))
+    ma, mb_ = a.get("memory") or {}, b.get("memory") or {}
+    if ma or mb_:
+        ta, tb = ma.get("totals") or {}, mb_.get("totals") or {}
+        deltas = []
+        for k in sorted(set(ta) | set(tb)):
+            va, vb = ta.get(k, 0), tb.get(k, 0)
+            if va != vb and isinstance(va, (int, float)) \
+                    and isinstance(vb, (int, float)):
+                deltas.append(f"{k}: {va} -> {vb} ({vb - va:+g})")
+        if deltas:
+            lines.append("memory totals deltas: " + ", ".join(deltas))
     lines.append("")
     lines.append(diff_records({"monitors": a.get("monitors", {})},
                               {"monitors": b.get("monitors", {})}))
@@ -293,6 +353,7 @@ def diff_records(a: Dict, b: Dict) -> str:
     cluster records dispatch to :func:`diff_cluster_records`."""
     if a.get("kind") == "cluster" and b.get("kind") == "cluster":
         return diff_cluster_records(a, b)
+    mem_lines = diff_memory(a.get("memory"), b.get("memory"))
     am, bm = a.get("monitors", {}), b.get("monitors", {})
     names = sorted(set(am) | set(bm))
     lines = [f"{'monitor':<44} {'count a':>8} {'count b':>8} "
@@ -312,7 +373,29 @@ def diff_records(a: Dict, b: Dict) -> str:
             if ma.get("p99_ms"):
                 row += f" {mb['p99_ms'] / ma['p99_ms']:>8.2f}"
         lines.append(row)
+    lines.extend(mem_lines)
     return "\n".join(lines)
+
+
+def diff_memory(ma: Optional[Dict], mb: Optional[Dict]) -> List[str]:
+    """RSS / device / ledger-total deltas between two records' memory
+    blocks (b relative to a); [] when either side lacks the block."""
+    if not isinstance(ma, dict) or not isinstance(mb, dict):
+        return []
+    lines = ["memory deltas (b - a):"]
+    for k, scale, unit in (("rss_mb", 1.0, "MB"),
+                           ("device_bytes", 1e-6, "MB")):
+        va, vb = ma.get(k), mb.get(k)
+        if isinstance(va, (int, float)) and isinstance(vb, (int, float)):
+            lines.append(f"  {k}: {va} -> {vb} "
+                         f"({(vb - va) * scale:+.2f} {unit})")
+    ta, tb = ma.get("totals") or {}, mb.get("totals") or {}
+    for k in sorted(set(ta) | set(tb)):
+        va, vb = ta.get(k, 0), tb.get(k, 0)
+        if va != vb and isinstance(va, (int, float)) \
+                and isinstance(vb, (int, float)):
+            lines.append(f"  totals.{k}: {va} -> {vb} ({vb - va:+g})")
+    return lines if len(lines) > 1 else []
 
 
 def to_perfetto(trace_jsonl: str, out_path: str) -> int:

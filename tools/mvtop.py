@@ -141,6 +141,38 @@ def render(rec: Dict, prev: Optional[Dict] = None,
             f"{_fmt(e.get('recompiles')):>6}")
         if e.get("error"):
             lines.append(f"      {e['error']}")
+    # memory panel (telemetry/memstats.py, MSG_STATS "memory" block):
+    # per-rank RSS / device bytes / live table bytes / replay-retained
+    # bytes / pinned read epochs, plus the (host, pid)-deduped cluster
+    # totals. "-" = the rank's payload carried no memory block (an
+    # older peer) or the figure is unavailable (no /proc, no sampler).
+    mem = rec.get("memory")
+    if mem:
+
+        def _mmb(v):
+            return "-" if not isinstance(v, (int, float)) \
+                else f"{v / 1e6:.2f}"
+
+        t = mem.get("totals", {})
+        lines.append("")
+        lines.append(
+            f"memory: rss {_fmt(t.get('rss_mb'), 1)} MB"
+            f"  device {_mmb(t.get('device_bytes'))} MB"
+            f"  tables {_mmb(t.get('table_bytes'))} MB"
+            f"  retained {_mmb(t.get('retained_bytes'))} MB"
+            f"  pinned epochs {t.get('pinned_epochs', 0)}")
+        lines.append(f"  {'rank':<5} {'rss_mb':>8} {'device_mb':>10} "
+                     f"{'table_mb':>9} {'retained_mb':>12} {'pins':>5} "
+                     f"{'verdicts':<20}")
+        for r in sorted(mem.get("ranks", {}), key=str):
+            e = mem["ranks"][r]
+            vd = ",".join(e.get("verdicts") or []) or "-"
+            lines.append(
+                f"  {r:<5} {_fmt(e.get('rss_mb'), 1):>8} "
+                f"{_mmb(e.get('device_bytes')):>10} "
+                f"{_mmb(e.get('table_bytes')):>9} "
+                f"{_mmb(e.get('retained_bytes')):>12} "
+                f"{_fmt(e.get('pinned_epochs')):>5} {vd:<20}")
     mons = rec.get("monitors", {})
     rates = rec.get("rates", {})
     serving = rec.get("serving", {})

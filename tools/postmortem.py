@@ -51,9 +51,13 @@ def _msg_names() -> Dict[int, str]:
 
 
 def load_dump(path: str) -> Optional[Dict]:
-    """One dump file -> {"header", "events", "inflight", "stacks"};
-    None for an unreadable/foreign file."""
+    """One dump file -> {"header", "events", "inflight", "stacks",
+    "memory", "memsamples"}; None for an unreadable/foreign file. The
+    memory records are the memstats dump provider's ledger snapshot +
+    bounded sample history (telemetry/memstats.py) — the memory
+    timeline rendered next to the wire timeline."""
     header, events, inflight, stacks = None, [], [], []
+    memory, memsamples = [], []
     try:
         with open(path) as f:
             for line in f:
@@ -70,12 +74,17 @@ def load_dump(path: str) -> Optional[Dict]:
                     inflight.append(rec)
                 elif kind == "stack":
                     stacks.append(rec)
+                elif kind == "memory":
+                    memory.append(rec)
+                elif kind == "memsample":
+                    memsamples.append(rec)
     except (OSError, json.JSONDecodeError):
         return None
     if header is None:
         return None
     return {"header": header, "events": events, "inflight": inflight,
-            "stacks": stacks, "path": path}
+            "stacks": stacks, "memory": memory,
+            "memsamples": memsamples, "path": path}
 
 
 def _expand(args: List[str]) -> (List[str], List[str]):
@@ -199,6 +208,37 @@ def dead_suspects(dumps: List[Dict]) -> List[Dict]:
             for r, v in sorted(why.items())]
 
 
+def memory_report(dumps: List[Dict]) -> Dict:
+    """The memory forensics view across every rank's dump: each rank's
+    LAST ledger snapshot (RSS, device census total, component bytes,
+    verdicts) plus the merged sample timeline — RSS/device readings on
+    one wall clock (memstats samples carry wall ``ts`` directly, no
+    monotonic anchor needed). ``{"ranks": {}, "timeline": []}`` when no
+    dump carried memory records (pre-memstats artifacts)."""
+    ranks: Dict[str, Dict] = {}
+    timeline: List[Dict] = []
+    for d in dumps:
+        rank = d["header"].get("rank", -1)
+        mems = d.get("memory") or []
+        if mems:
+            m = mems[-1]
+            census = m.get("census") or {}
+            ranks[str(rank)] = {
+                "ts": m.get("ts"), "rss_mb": m.get("rss_mb"),
+                "hwm_mb": m.get("hwm_mb"),
+                "device_bytes": census.get("bytes"),
+                "totals": m.get("totals", {}),
+                "components": m.get("components", {}),
+                "verdicts": m.get("verdicts", []),
+            }
+        for s in d.get("memsamples") or []:
+            r = dict(s)
+            r["rank"] = rank
+            timeline.append(r)
+    timeline.sort(key=lambda r: r.get("ts") or 0.0)
+    return {"ranks": ranks, "timeline": timeline}
+
+
 _RECOVERY_EVS = ("failover.detect", "failover.respawn",
                  "failover.restore", "failover.replay",
                  "failover.rejoin")
@@ -264,6 +304,45 @@ def render_report(dumps: List[Dict], log_lines: List[Dict] = (),
                      if "t_plus_s" in e else "")
             lines.append(f"  {e['ts']:.6f} rank{e['rank']} "
                          f"{e['phase']}{about}{note}{tplus}")
+    mem = memory_report(dumps)
+    if mem["ranks"]:
+        lines.append("memory at dump time (byte ledger):")
+        for r in sorted(mem["ranks"], key=str):
+            e = mem["ranks"][r]
+            dev = e.get("device_bytes")
+            lines.append(
+                f"  rank {r}: rss {e.get('rss_mb', '-')} MB "
+                f"(hwm {e.get('hwm_mb', '-')})  device "
+                + ("-" if not isinstance(dev, (int, float))
+                   else f"{dev / 1e6:.1f} MB"))
+            comps = e.get("components") or {}
+            for name in sorted(comps):
+                g = comps[name]
+                if not isinstance(g, dict):
+                    continue
+                nb = sum(v for k, v in g.items()
+                         if k.endswith("_bytes")
+                         and isinstance(v, (int, float))
+                         and not isinstance(v, bool))
+                lines.append(f"    {name}: {int(nb)} bytes")
+            for v in (e.get("verdicts") or [])[-4:]:
+                if isinstance(v, dict):
+                    lines.append(f"    VERDICT {v.get('kind')} "
+                                 f"({v.get('component')})")
+    if mem["timeline"]:
+        tl = mem["timeline"]
+        lines.append(f"memory timeline (last {min(tail, len(tl))} of "
+                     f"{len(tl)} samples):")
+        for s in tl[-tail:]:
+            dev = s.get("device_bytes")
+            lines.append(
+                f"  {s.get('ts', 0):.3f} rank{s.get('rank', '?')} "
+                f"rss {s.get('rss_mb', '-')} MB  device "
+                + ("-" if not isinstance(dev, (int, float))
+                   else f"{dev / 1e6:.1f} MB")
+                + "  " + " ".join(
+                    f"{k}={v}" for k, v in sorted(
+                        (s.get("totals") or {}).items()) if v))
     pairs = stuck_pairs(dumps)
     if pairs:
         lines.append("oldest unacked request per (src, dst):")
@@ -318,6 +397,7 @@ def main(argv=None) -> int:
             "suspects": dead_suspects(dumps),
             "stuck_pairs": stuck_pairs(dumps),
             "recovery": recovery_timeline(dumps, log_lines),
+            "memory": memory_report(dumps),
             "timeline": timeline(dumps, log_lines)[-args.tail:],
         }, indent=1))
     else:

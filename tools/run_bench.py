@@ -81,6 +81,11 @@ _REGRESSION_KEYS = (
     # online-serving plane (tools/bench_serving.py): inference tail
     # latency against the bounded-staleness replica
     (("serving", "infer_p99_ms"), "serving inference p99"),
+    # memory plane (ISSUE 10): peak process RSS over the whole bench
+    # (VmHWM — kernel-tracked, no sampling cadence can under-read it).
+    # Growth is a regression like latency growth: higher is worse, so
+    # it rides the standard lower-is-better table
+    (("memory", "peak_rss_mb"), "bench peak RSS"),
 )
 
 # healthy fully-attributed runs record stall_fraction ~0.0 — the
@@ -89,6 +94,13 @@ _REGRESSION_KEYS = (
 # stall comparison floors the baseline at this value instead (a new
 # stall above 2 x 5% flags even against a perfect-zero prior)
 _STALL_BASELINE_FLOOR = 0.05
+
+# replay retained-frame bytes: a healthy run with a live failover
+# checkpointer records ~0 here (frames prune at the durable floor), so
+# the `old <= 0` ratio guard would suppress retention-growth flags
+# forever — same directionality fix as the stall floor: the baseline
+# floors at 1 MB and any new peak over 2 x max(prev, floor) flags
+_RETAINED_BASELINE_FLOOR_BYTES = 1 << 20
 
 # bench-extra keys where HIGHER is better: flagged when the new run
 # DROPPED by more than the factor (the served-QPS mirror of the
@@ -178,6 +190,18 @@ def flag_regressions(prev_headline, new_headline, factor: float = 2.0):
         out.append(f"steady-state recompiles: {sr} jit compiles "
                    "attributed past step 1 (expected 0; see "
                    "extra.profile and tools/mvprof.py)")
+    # replay retained-frame bytes peak (memory plane): floored baseline
+    # like the stall fraction — a healthy 0-byte prior must not
+    # suppress the flag the first time a run starts hoarding frames
+    old_rb = _extra_value(prev_headline, ("memory", "peak_retained_bytes"))
+    new_rb = _extra_value(new_headline, ("memory", "peak_retained_bytes"))
+    if old_rb is not None and new_rb is not None:
+        base = max(old_rb, _RETAINED_BASELINE_FLOOR_BYTES)
+        if new_rb > factor * base:
+            out.append(
+                f"replay retained-frame bytes peak: {new_rb} vs {old_rb} "
+                f"previously (flag threshold {factor}x over max(prev, "
+                f"{_RETAINED_BASELINE_FLOOR_BYTES}))")
     # shard-skew growth: a scale-out run whose row traffic collapsed
     # onto one shard is a regression even when every latency held
     old_skews, new_skews = (_cluster_skews(prev_headline),
