@@ -341,17 +341,39 @@ def bench_we_async(world: int = 4, n_tokens: int = 1_000_000):
     reference's actual product shape (N independent processes, async
     tables, ref trainer.cpp:44-49 words/sec) — so the async plane has a
     tracked perf number, not just the sync/fused paths. Same corpus/seed
-    as bench_wordembedding_ps's 1M run: the losses are comparable."""
+    as bench_wordembedding_ps's 1M run: the losses are comparable.
+
+    Two stages (ISSUE 11): the measured np=world run takes the pipelined
+    path (producer-thread prepared-block queue + hot-row training cache);
+    a parity stage then reruns a REDUCED corpus at world=1 twice —
+    pipelined vs the unpipelined/uncached oracle — and asserts the
+    embedding digests match BIT-FOR-BIT (single-writer runs are
+    deterministic, so any divergence is a real pipeline/cache bug, the
+    class the test suite's tiny corpus might miss at bench scale)."""
     import sys
     import tempfile
 
     repo = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(repo, "tools", "bench_we_async.py")
     with tempfile.TemporaryDirectory(prefix="mv_bench_we_async_") as rdv:
         results = _collect_worker_results(
-            [[sys.executable, os.path.join(repo, "tools",
-                                           "bench_we_async.py"),
-              rdv, str(world), str(r), str(n_tokens)]
+            [[sys.executable, worker, rdv, str(world), str(r),
+              str(n_tokens), "pipeline"]
              for r in range(world)], timeout=600)
+    # parity stage: world=1, reduced corpus, pipeline vs oracle
+    parity_tokens = max(30_000, n_tokens // 8)
+    digests = {}
+    for mode in ("pipeline", "oracle"):
+        with tempfile.TemporaryDirectory(
+                prefix=f"mv_bench_we_parity_{mode}_") as rdv:
+            digests[mode] = _collect_worker_results(
+                [[sys.executable, worker, rdv, "1", "0",
+                  str(parity_tokens), mode]], timeout=600)[0]["emb_sha"]
+    parity_ok = digests["pipeline"] == digests["oracle"]
+    assert parity_ok, (
+        "ISSUE-11 parity gate: pipelined WE run is NOT bit-identical to "
+        f"the unpipelined/uncached oracle at {parity_tokens} tokens "
+        f"({digests['pipeline'][:16]} != {digests['oracle'][:16]})")
     out = {
         "world": world, "tokens": n_tokens,
         "words_per_sec_aggregate": round(
@@ -359,7 +381,19 @@ def bench_we_async(world: int = 4, n_tokens: int = 1_000_000):
         "words_per_sec_per_worker": [r["words_per_sec"] for r in results],
         "loss_mean": round(float(np.mean([r["loss"] for r in results])), 4),
         "loss_per_worker": [round(r["loss"], 4) for r in results],
+        "parity": {"ok": parity_ok, "tokens": parity_tokens},
+        "perf_gate": results[0].get("perf_gate"),
     }
+    caches = [r["train_cache"] for r in results if r.get("train_cache")]
+    if caches:
+        hits = sum(c["hits"] for c in caches)
+        misses = sum(c["misses"] for c in caches)
+        out["train_cache"] = {
+            "hit_rate": (round(hits / (hits + misses), 4)
+                         if hits + misses else None),
+            "mode": caches[0]["mode"],
+            "rows_per_worker": [c["rows"] for c in caches],
+        }
     # step-profiler evidence (ISSUE 9): the worker profiles its measured
     # epoch and asserts >= 90% attribution + zero steady recompiles
     # in-run; the record keeps rank 0's per-step phase breakdown as the
@@ -1173,6 +1207,25 @@ def main() -> None:
     # stall-fraction growth and steady-state recompiles run-over-run
     if isinstance(we_async_stats, dict) and we_async_stats.get("profile"):
         extra["profile"] = we_async_stats["profile"]
+    # ISSUE 11: the tracked WE scale metric — words/s plus the per-phase
+    # breakdown, parity verdict, and cache hit rate, first-class under
+    # extra.we so run_bench flags a >2x words/s DROP run-over-run (the
+    # higher-is-better direction) and the scale trajectory has a number
+    if isinstance(we_async_stats, dict) \
+            and "words_per_sec_aggregate" in we_async_stats:
+        we_extra = {
+            "words_per_s": we_async_stats["words_per_sec_aggregate"],
+            "parity_ok": int(bool(
+                we_async_stats.get("parity", {}).get("ok"))),
+        }
+        tc = we_async_stats.get("train_cache")
+        if tc and tc.get("hit_rate") is not None:
+            we_extra["train_cache_hit_rate"] = tc["hit_rate"]
+        prof_b = we_async_stats.get("profile") or {}
+        if prof_b.get("phase_ms_per_step"):
+            we_extra["phase_ms_per_step"] = prof_b["phase_ms_per_step"]
+            we_extra["stall_fraction"] = prof_b.get("stall_fraction")
+        extra["we"] = we_extra
     if cluster_stats is not None:
         extra["cluster"] = cluster_stats
     if _DEGENERATE_DIFFERENTIALS:

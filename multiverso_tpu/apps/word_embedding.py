@@ -35,7 +35,9 @@ Usage: ``python -m multiverso_tpu.apps.word_embedding -train_file f.txt
 from __future__ import annotations
 
 import sys
+import threading
 import time
+from collections import OrderedDict
 from typing import Dict, Iterator, List, Optional, Tuple
 
 import jax
@@ -45,12 +47,31 @@ import numpy as np
 import multiverso_tpu as mv
 from multiverso_tpu import native
 from multiverso_tpu.data.dictionary import Dictionary, build_huffman
+from multiverso_tpu.io.sample_reader import BlockPrepareQueue
 from multiverso_tpu.models import word2vec as w2v
+from multiverso_tpu.ops import row_assemble as _rowasm
+from multiverso_tpu.telemetry import memstats as _memstats
 from multiverso_tpu.telemetry import profiler as _prof
-from multiverso_tpu.utils import log
+from multiverso_tpu.utils import config, log
 from multiverso_tpu.tables.matrix_table import _bucket_size
 from multiverso_tpu.utils.async_buffer import AsyncBuffer
 from multiverso_tpu.utils.dashboard import monitor
+
+config.define_int(
+    "we_prepare_depth", 4,
+    "WordEmbedding prepared-block queue depth (blocks produced but not "
+    "yet trained, BOTH PS planes): bounds host prep memory while letting "
+    "producers run ahead of the consumer — the ISSUE-11 pipeline's K")
+config.define_int(
+    "we_prepare_threads", 2,
+    "producer threads feeding the WordEmbedding prepared-block queue "
+    "(pair generation, negative sampling, remap/pack run here, OFF the "
+    "training thread's critical path)")
+config.define_int(
+    "we_pair_cache_corpora", 4,
+    "bounded LRU capacity (corpora) of the fused path's device-resident "
+    "pair-batch cache — multi-corpus alternating epochs used to thrash "
+    "the old keep-one cache every epoch")
 
 
 def _gen_pairs(ids: np.ndarray, window: int, seed: int):
@@ -131,6 +152,13 @@ class WEConfig:
         if self.ps_block_dtype not in ("f32", "bf16"):
             raise ValueError(
                 f"unknown ps_block_dtype {self.ps_block_dtype!r}")
+        # ISSUE-11 pipelined prepare: "1" (default) produces blocks on a
+        # bounded K-deep queue of producer threads and dispatches the row
+        # pulls at dequeue (same program-order point as inline, so results
+        # stay bit-identical); "0" = the legacy inline one-lookahead path
+        # (the parity oracle)
+        self.pipeline = str(kw.get("pipeline", "1")) in ("1", "true",
+                                                         "True")
         self.data_presplit = str(kw.get("data_presplit", "0")) in (
             "1", "true", "True")
         self.max_vocab = kw.get("max_vocab")
@@ -207,7 +235,16 @@ class WordEmbedding:
         self._dev_negs = (not cfg.hs and cfg.negative > 0
                           and 4 * v <= cfg.data_block_size * cfg.negative)
         self._fused_cache: Dict[str, object] = {}
-        self._pair_cache: Dict[object, object] = {}
+        # bounded LRU of device-resident pair batches, keyed by corpus
+        # fingerprint (flag we_pair_cache_corpora): multi-corpus
+        # alternating epochs no longer thrash it every epoch, and its
+        # device bytes ride the PR-10 ledger
+        self._pair_cache: "OrderedDict[object, object]" = OrderedDict()
+        # guards the LRU against the memstats sampler thread's gauge
+        # pull (mutation is per corpus-epoch — the lock is never hot)
+        self._pair_cache_lock = threading.Lock()
+        _memstats.register(f"we.pair_cache[{self.table_in.name}]", self,
+                           attr="pair_cache_memory_stats")
         if cfg.hs:
             codes, points, lengths = build_huffman(dictionary.counts)
             self._hs = (codes, points, lengths)
@@ -240,14 +277,33 @@ class WordEmbedding:
         """
         key = (ids.shape, hash(ids.tobytes()),
                self.cfg.window, self.cfg.seed, self.cfg.batch_size)
-        hit = self._pair_cache.get(key)
-        if hit is None:
-            centers, contexts = _gen_pairs(ids, self.cfg.window,
-                                           self.cfg.seed)
-            cb, xb = self._batches(centers, contexts)
-            hit = (jnp.asarray(cb), jnp.asarray(xb), cb.size)
-            self._pair_cache = {key: hit}  # hold one corpus at a time
+        with self._pair_cache_lock:
+            hit = self._pair_cache.get(key)
+            if hit is not None:
+                self._pair_cache.move_to_end(key)
+                return hit
+        # pair gen + device put happen OFF the lock (one-time corpus
+        # preprocessing — a concurrent gauge pull must not stall on it);
+        # a racing duplicate build just overwrites with equal content
+        centers, contexts = _gen_pairs(ids, self.cfg.window,
+                                       self.cfg.seed)
+        cb, xb = self._batches(centers, contexts)
+        hit = (jnp.asarray(cb), jnp.asarray(xb), cb.size)
+        with self._pair_cache_lock:
+            self._pair_cache[key] = hit
+            cap = max(1, int(config.get_flag("we_pair_cache_corpora")))
+            while len(self._pair_cache) > cap:   # bounded LRU
+                self._pair_cache.popitem(last=False)
         return hit
+
+    def pair_cache_memory_stats(self) -> Dict[str, int]:
+        """PR-10 ledger gauges for the pair-batch LRU (pull-only)."""
+        with self._pair_cache_lock:   # vs the training thread's insert
+            entries = list(self._pair_cache.values())
+        dev = sum(int(getattr(a, "nbytes", 0) or 0)
+                  for cb, xb, _n in entries
+                  for a in (cb, xb))
+        return {"corpora": len(entries), "device_bytes": dev}
 
     # ------------------------------------------------------------------ #
     # fused path (device-resident training)
@@ -449,24 +505,54 @@ class WordEmbedding:
         if device_plane and schedule:
             if self._neg_host is None and not cfg.hs:
                 self._host_negs(1, 1, np.random.default_rng(0))  # build once
-            from concurrent.futures import ThreadPoolExecutor
-            depth = 4   # blocks in flight: bounds host+device prep memory
-            with ThreadPoolExecutor(2) as pool:
-                futs = [pool.submit(self._prepare_block_device,
-                                    schedule[i], child_rngs[i])
-                        for i in range(min(depth, len(schedule)))]
+            # K-deep ordered producer queue (io/sample_reader): replaces
+            # the PR-5 fixed pool — same 2-thread default, but depth and
+            # threads are now the shared we_prepare_* knobs and the
+            # producers report io.produce / the consumer io_wait
+            with BlockPrepareQueue(
+                    list(range(len(schedule))),
+                    lambda idx, _i: self._prepare_block_device(
+                        schedule[idx], child_rngs[idx]),
+                    depth=int(config.get_flag("we_prepare_depth")),
+                    threads=int(config.get_flag("we_prepare_threads"))
+                    ) as q:
                 for i, block in enumerate(schedule):
-                    j = i + depth
-                    if j < len(schedule):
-                        futs.append(pool.submit(self._prepare_block_device,
-                                                schedule[j], child_rngs[j]))
-                    prepared = futs[i].result()
+                    prepared = q.next()
                     if prepared is not None:
                         dev_losses.append(self._train_block_device(prepared))
-                    futs[i] = None   # release the payload
                     words += block.size
+        elif schedule and cfg.pipeline and len(schedule) > 1:
+            # ISSUE-11 pipelined host plane: producers run the CPU-heavy
+            # prepare (pair gen, negative sampling, remap/pack) K blocks
+            # ahead; the consumer dispatches each block's row pulls at
+            # DEQUEUE — the same point in program order (before the
+            # previous block's push) the inline path dispatches them, so
+            # the pulled rows, and therefore the training results, are
+            # bit-identical to pipeline=0
+            if self._neg_host is None and not cfg.hs:
+                self._host_negs(1, 1, np.random.default_rng(0))  # build once
+            with BlockPrepareQueue(
+                    list(range(len(schedule))),
+                    lambda idx, _i: self._produce_block(
+                        schedule[idx], child_rngs[idx]),
+                    depth=int(config.get_flag("we_prepare_depth")),
+                    threads=int(config.get_flag("we_prepare_threads"))
+                    ) as q:
+                prepared = self._dispatch_pulls(q.next())
+                for i, block in enumerate(schedule):
+                    with _prof.step("we.block"):
+                        nxt = None
+                        if i + 1 < len(schedule):
+                            produced = q.next()   # io_wait-timed
+                            with _prof.phase("we.pipeline"):
+                                nxt = self._dispatch_pulls(produced)
+                        losses.append(self._train_prepared(prepared, nw))
+                    words += block.size
+                    prepared = nxt
         else:
-            # pipeline-fill prepare happens outside any step: steady-
+            # legacy inline one-lookahead path (-pipeline 0): the parity
+            # oracle the pipelined path is asserted bit-identical to.
+            # Pipeline-fill prepare happens outside any step: steady-
             # state steps each cover ONE (prepare of block N+1, train of
             # block N) pair — the overlap the profiler exists to measure
             prepared = (self._prepare_block(schedule[0], child_rngs[0])
@@ -557,15 +643,20 @@ class WordEmbedding:
             seen[np.asarray(a).reshape(-1)] = True
         return np.flatnonzero(seen)
 
-    def _prepare_block(self, block: np.ndarray, rng) -> Optional[Dict]:
-        """Host-plane block prep: *dispatch* the row pulls
-        (ref RequestParameter, communicator.cpp:104-142) and pack the
-        batch arrays for the local-train scan. Compute is the SAME packed
-        ``lax.scan`` as the device plane — only pull/push differ (table
-        Get/Add over the wire here, in-graph gather/scatter there)."""
+    def _produce_block(self, block: np.ndarray, rng,
+                       dispatch_early: bool = False) -> Optional[Dict]:
+        """The PURE host-CPU half of host-plane block prep — pair/negative
+        generation, remap, packing — safe on a producer thread: it reads
+        no table state, so K-deep production cannot reorder the wire.
+        The pulls are dispatched separately (:meth:`_dispatch_pulls`) on
+        the consumer thread, in program order — EXCEPT the inline path
+        (``dispatch_early``, consumer thread by definition), which
+        dispatches them before the ~35 ms packing work so the
+        wire/gather latency hides under it (packing makes no wire ops,
+        so the dispatch point within prepare never changes results)."""
         cfg = self.cfg
         b = cfg.batch_size
-        with monitor("we.prepare"), _prof.phase("prepare"):
+        with monitor("we.prepare"):
             prep = self._block_arrays(block, rng)
             n = (prep["examples"].size // b) * b
             if n == 0:
@@ -587,18 +678,58 @@ class WordEmbedding:
                 # to zero, the scatter just needs a valid index)
                 remap_hs = np.full(self.table_hs.shape[0] + 1, hkb, np.int64)
                 remap_hs[hs_rows] = np.arange(hs_rows.size)
-                prep["pull_hs"] = self.table_hs.get_rows_async(hs_rows)
             remap = np.full(len(self.dict), kb, np.int64)   # default: dummy
             remap[vocab] = np.arange(k)
-            # dispatch the pulls BEFORE the ~35 ms packing work so the
-            # wire/gather latency hides under it
-            prep["pull_in"] = self.table_in.get_rows_async(vocab)
-            if not cfg.hs:
-                prep["pull_out"] = self.table_out.get_rows_async(vocab)
+            prep.update(kb=kb, hkb=hkb)
+            if dispatch_early:
+                self._dispatch_pulls(prep)
             batch, valid = self._pack_batches(prep, n, nbb, remap, kb,
                                               remap_hs, hkb)
-            prep.update(batch=batch, valid=valid, kb=kb, hkb=hkb)
+            prep.update(batch=batch, valid=valid)
             return prep
+
+    def _dispatch_pulls(self, prep: Optional[Dict]) -> Optional[Dict]:
+        """Dispatch a produced block's row pulls (ref RequestParameter,
+        communicator.cpp:104-142) — the one ordered step kept on the
+        consumer thread: a pull must enter the conn FIFO before the
+        PREVIOUS block's push, exactly where the inline path dispatches
+        it, or the pulled rows (hence the results) would change. Tables
+        with a warm training cache serve a fully-covered block as a
+        device-resident (bucket, D) array instead — one fused gather/pad
+        program (ops/row_assemble), nothing crossing the host boundary —
+        and cold/partial blocks fall back to get_rows_async, whose cache
+        split fetches only the residual cold rows over the wire."""
+        if prep is None:
+            return None
+        if "dev_in" in prep or "pull_in" in prep:
+            return prep   # already dispatched (the inline early path)
+        cfg = self.cfg
+
+        def pull(table, ids, bucket, k_dev, k_pull):
+            f = getattr(table, "train_cache_device_block", None)
+            blk = f(ids, bucket) if f is not None else None
+            if blk is not None:
+                prep[k_dev] = blk
+            else:
+                prep[k_pull] = table.get_rows_async(ids)
+
+        pull(self.table_in, prep["vocab"], prep["kb"], "dev_in", "pull_in")
+        if cfg.hs:
+            pull(self.table_hs, prep["hs_rows"], prep["hkb"],
+                 "dev_sec", "pull_hs")
+        else:
+            pull(self.table_out, prep["vocab"], prep["kb"],
+                 "dev_sec", "pull_out")
+        return prep
+
+    def _prepare_block(self, block: np.ndarray, rng) -> Optional[Dict]:
+        """Inline host-plane block prep (-pipeline 0, the parity oracle):
+        produce + dispatch on the calling thread, profiled as the step's
+        ``prepare`` phase. Compute is the SAME packed ``lax.scan`` as the
+        device plane — only pull/push differ (table Get/Add over the wire
+        here, in-graph gather/scatter there)."""
+        with _prof.phase("prepare"):
+            return self._produce_block(block, rng, dispatch_early=True)
 
     def _train_prepared(self, prep: Optional[Dict],
                         num_workers: int) -> float:
@@ -612,21 +743,28 @@ class WordEmbedding:
         if prep is None:
             return 0.0
         with monitor("we.block"):
-            def padded(rows, kb):
-                return jnp.asarray(np.pad(
-                    rows, [(0, kb - rows.shape[0]), (0, 0)]))
+            # device pad (ops/row_assemble): ONE transfer of the real
+            # rows, the zero padding materializes in-graph — the old
+            # np.pad + jnp.asarray paid a host copy of the padded block
+            padded = _rowasm.pad_rows
 
             sec_t = self._sec_table()
             # ps_wait: the residual of the pulls dispatched during
-            # prepare — the part the prefetch overlap did NOT hide
+            # prepare — the part the prefetch overlap did NOT hide.
+            # Cache-served blocks (dev_in/dev_sec) already sit on device.
             with _prof.phase("ps_wait"):
-                rows_in = self.table_in.wait(prep["pull_in"])
-                rows_sec = sec_t.wait(
-                    prep["pull_hs" if cfg.hs else "pull_out"])
+                rows_in = (None if "dev_in" in prep
+                           else self.table_in.wait(prep["pull_in"]))
+                rows_sec = (None if "dev_sec" in prep
+                            else sec_t.wait(
+                                prep["pull_hs" if cfg.hs else "pull_out"]))
             with _prof.phase("compute"):
-                win_l = padded(rows_in, prep["kb"])
-                wsec_l = padded(rows_sec,
-                                prep["hkb"] if cfg.hs else prep["kb"])
+                win_l = (prep["dev_in"] if rows_in is None
+                         else padded(rows_in, prep["kb"]))
+                wsec_l = (prep["dev_sec"] if rows_sec is None
+                          else padded(rows_sec,
+                                      prep["hkb"] if cfg.hs
+                                      else prep["kb"]))
                 if _prof.enabled():
                     _prof.watch_jit("we.local_train",
                                     self._local_train_fn())

@@ -34,7 +34,8 @@ import queue
 import struct
 import threading
 import time
-from typing import IO, Iterator, Optional, Sequence, Set, Tuple
+from typing import (IO, Any, Callable, Iterator, Optional, Sequence, Set,
+                    Tuple)
 
 import numpy as np
 
@@ -109,6 +110,117 @@ def _iter_bsparse(uri: str, input_dim: int
             x = np.zeros(input_dim, np.float32)
             x[keys[(keys >= 0) & (keys < input_dim)]] = weight
             yield label, x
+
+
+class BlockPrepareQueue:
+    """Bounded K-deep ORDERED prefetch queue over a finite work list.
+
+    The WordEmbedding block pipeline's producer side (ISSUE 11): ``fn(item,
+    index)`` runs on ``threads`` producer threads for items AHEAD of the
+    consumer, at most ``depth`` outstanding (claimed-but-unconsumed), and
+    :meth:`next` yields results strictly IN ORDER — so a pure ``fn`` gives
+    bit-identical results to calling it inline, regardless of thread
+    scheduling. Generalizes this module's single-reader ring (SampleReader)
+    to N producers with ordered delivery; the same profiler contract
+    applies: each production interval lands as an ``io.produce`` async span
+    attached to whichever step it overlapped (``attach="any"``), and the
+    consumer's blocked time is the ``io_wait`` phase of ITS step.
+
+    A producer exception is delivered at the corresponding :meth:`next`
+    call (order preserved) and ends the queue. ``close()`` releases the
+    threads early; they are daemons either way.
+    """
+
+    def __init__(self, items: Sequence[Any],
+                 fn: Callable[[Any, int], Any],
+                 depth: int = 4, threads: int = 2):
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self._items = items
+        self._fn = fn
+        self._depth = int(depth)
+        self._cond = threading.Condition()
+        self._results: dict = {}          # index -> ("ok"|"err", payload)
+        self._next_claim = 0              # producer side
+        self._next_emit = 0               # consumer side
+        self._closed = False
+        self._threads = [
+            threading.Thread(target=self._produce, daemon=True,
+                             name=f"mv-blockprep-{i}")
+            for i in range(max(1, min(int(threads), len(items) or 1)))]
+        for t in self._threads:
+            t.start()
+
+    def _produce(self) -> None:
+        n = len(self._items)
+        while True:
+            with self._cond:
+                while (not self._closed and self._next_claim < n
+                       and self._next_claim - self._next_emit
+                       >= self._depth):
+                    self._cond.wait()
+                if self._closed or self._next_claim >= n:
+                    return
+                i = self._next_claim
+                self._next_claim += 1
+            t0 = time.time()
+            try:
+                out = ("ok", self._fn(self._items[i], i))
+            except BaseException as e:   # noqa: BLE001 — delivered in
+                out = ("err", e)         # order at the consumer's next()
+            t1 = time.time()
+            with self._cond:
+                if self._closed:   # closed mid-produce: drop the payload
+                    return         # (close() already purged _results)
+                self._results[i] = out
+                self._cond.notify_all()
+            if _prof.enabled():
+                _prof.note_async("io.produce", t0, t1, attach="any")
+
+    def next(self) -> Any:
+        """The next result in submission order (io_wait-timed when the
+        producers are behind). Raises StopIteration past the last item,
+        or the producer's exception for THIS index."""
+        i = self._next_emit
+        if i >= len(self._items):
+            raise StopIteration
+        with _prof.phase("io_wait"):
+            with self._cond:
+                while i not in self._results and not self._closed:
+                    self._cond.wait()
+                if i not in self._results:
+                    raise RuntimeError("BlockPrepareQueue closed while "
+                                       f"item {i} was pending")
+                kind, payload = self._results.pop(i)
+                self._next_emit = i + 1
+                self._cond.notify_all()
+        if kind == "err":
+            self.close()
+            raise payload
+        return payload
+
+    def __iter__(self) -> Iterator[Any]:
+        while True:
+            try:
+                yield self.next()
+            except StopIteration:
+                return
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            # ends the queue for REAL: already-produced later items are
+            # dropped, so a post-error/post-close next() deterministically
+            # raises instead of racing the producers for whatever they
+            # happened to finish first
+            self._results.clear()
+            self._cond.notify_all()
+
+    def __enter__(self) -> "BlockPrepareQueue":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 class SampleReader:

@@ -1,0 +1,114 @@
+"""Jitted sparse-row assemble/apply kernels for the training read path.
+
+The PS block pipeline moves row blocks between three homes — the wire
+(host numpy), the hot-row cache's device mirror, and the padded
+``(bucket, D)`` scan layout the block trainer consumes — and each move
+used to be a host-side ``np.pad``/copy followed by a full
+``device_put``.  These kernels keep the moves on device:
+
+* :func:`pad_rows` — zero-pad a host row block straight into the scan
+  bucket: ONE transfer of the real rows, the padding materializes
+  in-graph (the old ``np.pad`` + ``jnp.asarray`` paid a full host copy
+  of the padded block first).
+* :func:`gather_pad_rows` — serve a block from the cache's device
+  mirror: gather the requested positions and pad to the bucket in one
+  program; nothing crosses the host boundary.
+* :func:`scatter_add_rows` — write-through maintenance of the device
+  mirror: scatter-add a pushed delta into the cached rows in-graph, so
+  a push costs one small fused program instead of a full mirror
+  rebuild.
+
+All three are bucketed like every other row-batch program in the repo
+(matrix_table's static-shape rule): one compiled program per (bucket,
+dim, dtype), position arrays padded by the caller-facing wrappers so
+retraces never key on the batch's exact size. Bit-parity with the
+numpy equivalents is asserted by tests/test_we_pipeline.py — the
+write-through cache's correctness story rests on the scatter-add
+landing the IEEE-identical f32 sums the shard's updater lands.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnums=1, donate_argnums=())
+def _pad_rows(rows: jax.Array, bucket: int) -> jax.Array:
+    return jnp.pad(rows, ((0, bucket - rows.shape[0]), (0, 0)))
+
+
+def pad_rows(rows, bucket: int) -> jax.Array:
+    """Host (n, D) rows -> device (bucket, D) zero-padded block."""
+    rows = jnp.asarray(rows)
+    if rows.shape[0] == bucket:
+        return rows
+    if rows.shape[0] > bucket:
+        raise ValueError(f"pad_rows: {rows.shape[0]} rows > bucket "
+                         f"{bucket}")
+    return _pad_rows(rows, bucket)
+
+
+@partial(jax.jit, static_argnums=2)
+def _gather_pad(rows: jax.Array, pos: jax.Array, bucket: int) -> jax.Array:
+    """pos is padded to a stable length with an out-of-range sentinel;
+    jnp.take in 'fill' mode lands zeros there — the pad rows of the
+    output block, produced by the same gather that serves the real
+    rows."""
+    return jnp.take(rows, pos, axis=0, mode="fill", fill_value=0)
+
+
+def gather_pad_rows(rows_dev, positions, bucket: int) -> jax.Array:
+    """Device (H, D) cache mirror + host positions -> (bucket, D) padded
+    block: one fused gather, no host assembly. ``positions`` may be any
+    length <= bucket; the tail pads with zero rows (sentinel = H, PAST
+    the last row — 'fill' mode wraps NEGATIVE indices like plain numpy,
+    so -1 would gather the last real row instead of filling)."""
+    pos = np.asarray(positions, np.int64).reshape(-1)
+    if pos.size > bucket:
+        raise ValueError(f"gather_pad_rows: {pos.size} positions > "
+                         f"bucket {bucket}")
+    full = np.full(bucket, rows_dev.shape[0], np.int64)   # -> fill 0
+    full[: pos.size] = pos
+    return _gather_pad(rows_dev, jnp.asarray(full), bucket)
+
+
+def bucket_rows(n: int, floor: int = 8) -> int:
+    """Next power of two >= n (>= floor): the static-shape rule every
+    row-batch program in the repo follows — one compiled program per
+    (bucket, dim, dtype), never one per exact batch size. Without it the
+    scatter-add retraced on every new (mirror height, push size) pair,
+    which the bench's zero-steady-recompiles gate caught in the wild."""
+    b = floor
+    while b < n:
+        b <<= 1
+    return b
+
+
+@jax.jit
+def _scatter_add(rows: jax.Array, pos: jax.Array,
+                 delta: jax.Array) -> jax.Array:
+    return rows.at[pos].add(delta, mode="drop")
+
+
+def scatter_add_rows(rows_dev, positions, delta) -> jax.Array:
+    """Device (H, D) mirror + pushed (n, D) delta -> updated mirror,
+    scatter-add in-graph. Positions must be unique (the add path's
+    _prep dedupe contract) so each row sees exactly ONE f32 add — the
+    same operand order the shard's default updater applies, hence the
+    bit-identical write-through guarantee. The batch is padded to a
+    power-of-two bucket (sentinel position H = out of range, dropped by
+    ``mode="drop"``; zero delta rows ride along dead) so steady-state
+    pushes of varying size reuse ONE compiled program."""
+    pos = np.asarray(positions, np.int64).reshape(-1)
+    delta = np.asarray(delta)
+    b = bucket_rows(pos.size)
+    if b != pos.size:
+        full = np.full(b, rows_dev.shape[0], np.int64)   # dropped
+        full[: pos.size] = pos
+        pad = np.zeros((b - pos.size,) + delta.shape[1:], delta.dtype)
+        pos, delta = full, np.concatenate([delta, pad])
+    return _scatter_add(rows_dev, jnp.asarray(pos), jnp.asarray(delta))

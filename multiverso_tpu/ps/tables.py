@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import collections
 import concurrent.futures as cf
+import contextlib
 import itertools
 import os
 import threading
@@ -33,6 +34,7 @@ from multiverso_tpu import updaters as updaters_lib
 from multiverso_tpu.ps import service as svc
 from multiverso_tpu.ps import wire as wire_mod
 from multiverso_tpu.ps.shard import KVShard, RowShard
+from multiverso_tpu.serving import hotcache as _hotcache
 from multiverso_tpu.telemetry import flightrec as _flight
 from multiverso_tpu.telemetry import memstats as _memstats
 from multiverso_tpu.telemetry import profiler as _profiler
@@ -1609,7 +1611,67 @@ class AsyncMatrixTable(_AsyncBase):
             # mean read-your-writes, so the native fast path (its own
             # socket = no cross-plane ordering) stays off for this table
             self._native_ok = False
+        # hot-row TRAINING cache (flag train_cache_rows; ISSUE 11): cached
+        # rows serve gets locally, only cold rows cross the wire. Write-
+        # through is bit-exact only when the local push delta IS what the
+        # shard applies: plain-add updater, lossless wire, no sparse
+        # dirty-bit protocol, and NO send window (a window may merge two
+        # queued deltas into one summed add — one f32 add at the shard vs
+        # two in the cache is a bit divergence)
+        # (the get coalescer disqualifies write-through like the send
+        # window does: _GetWindow.fetch may QUEUE a cold fetch behind an
+        # in-flight one, so dispatch order is no longer conn-FIFO order
+        # and a push landing in between would be replayed onto a reply
+        # that already contains it)
+        self._train_cache = _hotcache.make_train_cache(
+            name, self.num_col, self.dtype,
+            writethrough_ok=(wire == "none" and shard_workers == 0
+                             and self._window is None
+                             and self._get_window is None
+                             and getattr(self.updater, "name", "")
+                             == "default"))
+        # cache/dispatch ordering lock: the cache's push-log seq must
+        # order pushes vs get dispatch EXACTLY as the conn FIFO does —
+        # a push logged after a get's token but entering the FIFO before
+        # its cold fetch would be replayed onto a reply that already
+        # contains it (double-apply), and the inverse interleave would
+        # skip a replay the reply needs. Held across {on_push + add
+        # dispatch} and {token + local serve + cold-get dispatch}, in
+        # BOTH modes: invalidate needs it too — a push logged (seq
+        # bumped, rows dropped) whose frames have NOT yet entered the
+        # FIFO lets a concurrent get capture a current token, have its
+        # cold fetch served pre-push rows, and fill_since admit them
+        # with nothing ever invalidating them again. The shipped
+        # single-writer WE pipeline never contends on it.
+        self._tc_order = (threading.Lock()
+                          if self._train_cache is not None else None)
         self.table_id = _maybe_register_in_zoo(self)
+
+    # ------------------------------------------------------------------ #
+    # hot-row training cache (serving/hotcache.TrainRowCache)
+    # ------------------------------------------------------------------ #
+    def train_cache_stats(self) -> Optional[Dict]:
+        """Hit/miss/occupancy of the training cache (None when off)."""
+        tc = self._train_cache
+        return None if tc is None else tc.stats()
+
+    def _tc_ordered(self):
+        """The cache/dispatch ordering lock as a context (no-op when the
+        cache is off)."""
+        return (self._tc_order if self._tc_order is not None
+                else contextlib.nullcontext())
+
+    def train_cache_device_block(self, row_ids, bucket: int):
+        """Serve ``row_ids`` as a zero-padded ``(bucket, num_col)``
+        DEVICE block straight from the training cache's device mirror —
+        one fused gather/pad program (ops/row_assemble), nothing crosses
+        the host boundary. None unless the cache is on and EVERY id is
+        cached; the caller then falls back to the normal get path (which
+        does the hit/cold split and the counting itself)."""
+        tc = self._train_cache
+        if tc is None:
+            return None
+        return tc.device_block_counted(row_ids, bucket)
 
     # ------------------------------------------------------------------ #
     def raw(self):
@@ -1679,8 +1741,14 @@ class AsyncMatrixTable(_AsyncBase):
                        opt: Optional[AddOption] = None) -> int:
         opt = opt or AddOption(worker_id=self.ctx.rank)
         self._zoo_dirty()
-        with monitor(f"table[{self.name}].add_rows"):
+        with monitor(f"table[{self.name}].add_rows"), self._tc_ordered():
             uids, vals, _ = self._prep(row_ids, values)
+            if self._train_cache is not None:
+                # AT DISPATCH, before any transport: the cache must see
+                # this push at the same point in program order the conn
+                # FIFO will (write-through applies the exact deduped
+                # delta the shard will add; invalidate drops the rows)
+                self._train_cache.on_push(uids, vals)
             # per-request trace ID (telemetry/trace.py): rides the frame
             # meta so client spans and the owning shard's serve/wave
             # spans stitch by ID; None (the default) costs one attribute
@@ -1773,11 +1841,99 @@ class AsyncMatrixTable(_AsyncBase):
 
     def get_rows_async(self, row_ids,
                        out: Optional[np.ndarray] = None) -> int:
+        tc = self._train_cache
+        if tc is not None:
+            return self._train_cache_get(row_ids, out)
+        return self._track(*self._get_rows_futs(row_ids, out),
+                           op="ps.get")
+
+    def _train_cache_get(self, row_ids,
+                         out: Optional[np.ndarray] = None) -> int:
+        """Cache-aware get: cached rows fill locally (host copy under
+        the cache lock, captured AT DISPATCH — the same point in program
+        order the wire snapshot would be taken, which is what makes
+        write-through bit-identical to the uncached path); only the
+        residual cold rows ride the wire, and the reply warms the cache
+        for the next block."""
+        tc = self._train_cache
+        tc.on_get()
+        uids, _, inv = self._prep(row_ids)
+        # PRIVATE scatter target: cached rows land in it at DISPATCH, so
+        # it must not alias the caller's out= — a cold residual failing
+        # at wait() would leave out torn (the chunked plane's untouched-
+        # on-failure rule); _expand commits into out only at finalize
+        buf = np.empty((uids.size, self.num_col), self.dtype)
+        with self._tc_ordered():
+            # serve_into is ONE lock hold: token + membership + gather —
+            # a concurrent fill/drop can't skew positions between them,
+            # and under the cache/dispatch ordering lock the token
+            # orders against pushes exactly as the conn FIFO will order
+            # the cold fetch dispatched below
+            token, hit = tc.serve_into(uids, buf)
+            nhit = int(np.count_nonzero(hit))
+            tc.count(nhit, uids.size - nhit)
+
+            def _expand(res: np.ndarray) -> np.ndarray:
+                if inv is None:
+                    if res is not out and self._can_take_reply(
+                            out, res.shape[0]):
+                        np.copyto(out, res)
+                        return out
+                    return res
+                dest = self._reply_buffer(out, inv.size)
+                np.take(res, inv, axis=0, out=dest)
+                return dest
+
+            if nhit == uids.size:
+                # full local serve, zero wire ops. Read-your-writes holds
+                # without the window fence: write-through already applied
+                # any queued pushes to the cache, and invalidate dropped
+                # their rows (so they cannot full-hit). Still a
+                # table-level get: count it in the get_rows monitor
+                # (mvtop's get counters must not flatline on a warm
+                # cache) — incr only, no wire latency to record
+                Dashboard.get(f"table[{self.name}].get_rows").incr()
+                return self._track([], lambda _res: _expand(buf),
+                                   op="ps.get")
+            full_miss = nhit == 0
+            cold_sel = np.flatnonzero(~hit)
+            cold_uids = uids[cold_sel]
+            cold_buf = (buf if full_miss else
+                        np.empty((cold_uids.size, self.num_col),
+                                 self.dtype))
+            futs, inner_fin = self._get_rows_futs(
+                cold_uids, out=cold_buf, prepped=True)
+
+        def _fin(results):
+            rows_cold = inner_fin(results)
+            if not full_miss:
+                buf[cold_sel] = rows_cold
+            elif rows_cold is not buf:
+                np.copyto(buf, rows_cold)
+            # warm the cache, reconciled against pushes dispatched since
+            # the token (write-through replay / exclusion — fill_since)
+            tc.fill_since(cold_uids, rows_cold, token)
+            return _expand(buf)
+
+        return self._track(futs, _fin, op="ps.get")
+
+    def _get_rows_futs(self, row_ids,
+                       out: Optional[np.ndarray] = None,
+                       prepped: bool = False):
+        """The wire get: returns ``(futures, finalize)`` for
+        :meth:`_track` (split out so the training cache can fetch just
+        its cold residual through the same three transports).
+        ``prepped=True`` marks ``row_ids`` as already validated sorted-
+        unique int64 (the cache's cold residual) — the _prep dedupe sort
+        is the biggest per-op host cost and must not run twice."""
         # ordering fence: a get must observe every windowed add this
         # caller already issued (read-your-writes over per-conn FIFO)
         self._flush_window()
         with monitor(f"table[{self.name}].get_rows"):
-            uids, _, inv = self._prep(row_ids)
+            if prepped:
+                uids, inv = np.asarray(row_ids, np.int64), None
+            else:
+                uids, _, inv = self._prep(row_ids)
             if self._native_ok:
                 from multiverso_tpu.ps import native as ps_native
                 # no duplicate ids: the C++ recv threads scatter replies
@@ -1795,7 +1951,7 @@ class AsyncMatrixTable(_AsyncBase):
                     # threads; results only carry completion
                     return buf if inv is None else buf[inv]
 
-                return self._track(futs, _assemble_native, op="ps.get")
+                return futs, _assemble_native
             parts = list(self._by_owner(uids))
             if self._get_window is not None:
                 # coalesced single-flight fetches: each part resolves to
@@ -1815,7 +1971,7 @@ class AsyncMatrixTable(_AsyncBase):
                     np.take(buf, inv, axis=0, out=dest)
                     return dest
 
-                return self._track(futs, _assemble_win, op="ps.get")
+                return futs, _assemble_win
             # remote peers share one packed meta (with the table's reply
             # wire); the local short-circuit keeps its uncompressed dict
             gw = self._reply_wire()
@@ -1887,7 +2043,7 @@ class AsyncMatrixTable(_AsyncBase):
                 np.take(buf, inv, axis=0, out=dest)
                 return dest
 
-        return self._track(futs, _assemble, op="ps.get")
+        return futs, _assemble
 
     def get_rows(self, row_ids, out: Optional[np.ndarray] = None
                  ) -> np.ndarray:
@@ -1953,6 +2109,13 @@ class AsyncMatrixTable(_AsyncBase):
         futs = [self.ctx.service.request(r, svc.MSG_SET_ROWS, meta,
                                          [uids[m], vals[m]])
                 for r, m in self._by_owner(uids)]
+        if self._train_cache is not None:
+            # not a replayable add: drop + poison, AFTER the frames
+            # entered the conn FIFOs — an overwrite logged before
+            # dispatch lets a get slip into the window, fetch
+            # pre-overwrite rows from the shard and cache them under a
+            # current fill token, permanently stale
+            self._train_cache.on_overwrite(uids)
         self.wait(self._track(futs, lambda rs: None))
 
     # ------------------------------------------------------------------ #
@@ -1964,6 +2127,18 @@ class AsyncMatrixTable(_AsyncBase):
         # fence: queued windowed row adds must land before a whole-table
         # delta (floating-point accumulation does not commute bit-wise)
         self._flush_window()
+        try:
+            return self._add_full_dispatch(delta, opt)
+        finally:
+            if self._train_cache is not None:
+                # whole-table delta: conservative wholesale drop, AFTER
+                # the frames entered the conn FIFOs — a clear logged
+                # before dispatch lets a get slip into the window, fetch
+                # pre-add rows from the shard and cache them under a
+                # current fill token, permanently stale
+                self._train_cache.clear()
+
+    def _add_full_dispatch(self, delta, opt: AddOption) -> int:
         with monitor(f"table[{self.name}].add"):
             delta = np.ascontiguousarray(
                 np.asarray(delta, self.dtype).reshape(self.shape))

@@ -62,6 +62,7 @@ import numpy as np
 
 from multiverso_tpu.serving.admission import (AdmissionController,
                                               SheddingError)
+from multiverso_tpu.serving.hotcache import HotRowCache, match_positions
 from multiverso_tpu.telemetry import hotkeys as _hotkeys
 from multiverso_tpu.telemetry import memstats as _memstats
 from multiverso_tpu.utils import config, log
@@ -189,10 +190,13 @@ class ReadReplica:
         self._epoch = 0
         self._last_refresh_ms = 0.0
         self._unchanged_pulls = 0         # shard replies deduped by since=
-        # hot-row cache (same epoch as _data by construction)
+        # hot-row cache (same epoch as _data by construction): the shared
+        # serving/hotcache.HotRowCache under the replica discipline —
+        # whole-cache install at each snapshot swap, never mutated between
         self._hot_ids: Optional[np.ndarray] = None
-        self._cache_ids: Optional[np.ndarray] = None   # sorted
-        self._cache_dev = None                          # device rows
+        self._cache = HotRowCache(self.num_col, self.dtype,
+                                  capacity=self.cache_capacity,
+                                  name=self.name)
         # single-flight refresh
         self._refresh_lock = threading.Lock()
         # serving counters (ints for stats(); Dashboard monitors beside
@@ -225,13 +229,13 @@ class ReadReplica:
         adopted snapshot buffer, the device-resident hot-row cache, and
         the transient refresh staging copy."""
         with self._swap_lock:
-            data, cdev, cids = self._data, self._cache_dev, self._cache_ids
+            data = self._data
+        cstats = self._cache.memory_stats()
         return {
             "snapshot_bytes": int(getattr(data, "nbytes", 0) or 0)
             if data is not None else 0,
-            "cache_device_bytes": (int(getattr(cdev, "nbytes", 0) or 0)
-                                   if cdev is not None else 0),
-            "cache_rows": 0 if cids is None else int(cids.size),
+            "cache_device_bytes": cstats["device_bytes"],
+            "cache_rows": cstats["rows"],
             "staging_bytes": int(self._staging_nb),
         }
 
@@ -393,7 +397,11 @@ class ReadReplica:
             self._epoch += 1
             self._last_refresh_ms = (time.monotonic() - t_start) * 1e3
             if cache_ids is not None:
-                self._cache_ids, self._cache_dev = cache_ids, cache_dev
+                # atomic whole-cache replace (hotcache install: the
+                # replica discipline) — cache and snapshot swap in under
+                # the same lock hold, so they are always the same epoch
+                self._cache.install(cache_ids, None,
+                                    device_rows=cache_dev)
             elif snapshot_moved:
                 # the snapshot content moved but no same-epoch cache was
                 # built (no hot ids yet / device placement failed): DROP
@@ -405,7 +413,7 @@ class ReadReplica:
                 # adopted snapshot no longer contains, breaking the
                 # "cache and snapshot are always the same epoch"
                 # contract the class docstring promises.
-                self._cache_ids = self._cache_dev = None
+                self._cache.clear()
             self._staging_nb = 0
         # flight recorder + trace span: one refresh = one event/span, so
         # serving refresh traffic appears on the same timeline as the
@@ -477,19 +485,10 @@ class ReadReplica:
         """Device-resident rows for ``row_ids`` when EVERY id is cached
         (same epoch as the last adopted snapshot), else None. For
         inference pipelines that consume rows on-device; hit/miss
-        accounting stays with :meth:`get_rows`."""
-        with self._swap_lock:
-            cids, cdev = self._cache_ids, self._cache_dev
-        if cids is None or cdev is None:
-            return None
-        ids = np.asarray(row_ids, np.int64).reshape(-1)
-        pos = np.searchsorted(cids, ids)
-        ok = (pos < cids.size) & (cids[np.minimum(pos, cids.size - 1)]
-                                  == ids)
-        if not bool(ok.all()):
-            return None
-        import jax.numpy as jnp
-        return jnp.take(cdev, jnp.asarray(pos), axis=0)
+        accounting stays with :meth:`get_rows`. (The membership math
+        and the fused serve live in serving/hotcache — shared with the
+        training-path cache.)"""
+        return self._cache.take_device(row_ids)
 
     # ------------------------------------------------------------------ #
     # the read path
@@ -514,7 +513,7 @@ class ReadReplica:
             with self._swap_lock:
                 age = time.monotonic() - self._pulled_at
                 if self._data is not None and age <= self.staleness_s:
-                    return self._data, age, self._cache_ids
+                    return self._data, age, self._cache.ids()
             self._deferred += 1
             self._mon_deferred.incr()
             # any pull started within the bound satisfies this reader —
@@ -567,10 +566,8 @@ class ReadReplica:
         else:
             rows = data[ids]
         if cids is not None and cids.size:
-            pos = np.searchsorted(cids, ids)
-            hits = int(np.count_nonzero(
-                (pos < cids.size)
-                & (cids[np.minimum(pos, cids.size - 1)] == ids)))
+            _pos, ok = match_positions(cids, ids)
+            hits = int(np.count_nonzero(ok))
             if hits:
                 self._hits += hits
                 self._mon_cache_hit.incr(hits)
@@ -589,8 +586,7 @@ class ReadReplica:
             age = time.monotonic() - self._pulled_at
             epoch = self._epoch
             versions = {str(r): int(v) for r, v in self._versions.items()}
-            cache_rows = (0 if self._cache_ids is None
-                          else int(self._cache_ids.size))
+            cache_rows = len(self._cache)
             refresh_ms = self._last_refresh_ms
         total = self._hits + self._misses
         out: Dict[str, Any] = {

@@ -238,6 +238,10 @@ class Table:
         self._addq_cv = threading.Condition()
         self._addq_inflight = 0
         self._add_applier: Optional[threading.Thread] = None
+        # hot-row training cache (serving/hotcache; row-table subclasses
+        # create it behind the train_cache_rows flag — base ops only need
+        # to INVALIDATE on coarse mutations)
+        self._train_cache = None
         # memory ledger (telemetry/memstats.py): the PR-1 get cache and
         # the write-triggered prefetch staging buffer are the sync
         # plane's two table-sized hoards; gauges are pull-only
@@ -480,6 +484,12 @@ class Table:
         self._data = state["data"]
         self._ustate = state["ustate"]
         self._version_applied()
+        if self._train_cache is not None:
+            # wholesale rewrite: all rows stale. AFTER the rebind — a
+            # clear logged before the mutation is visible lets a racing
+            # get re-fill pre-adopt rows under a current fill token,
+            # and nothing would ever invalidate them again
+            self._train_cache.clear()
 
     def pad_delta(self, delta: jax.Array) -> jax.Array:
         pad = self._padded_rows - self.shape[0]
@@ -688,6 +698,13 @@ class Table:
                 self._maybe_prefetch()
             for e in batch:
                 e.token = token
+            if self._train_cache is not None:
+                # the delta is VISIBLE only now (add_async's clear ran
+                # at enqueue time, before the apply): a get that won the
+                # dispatch lock ahead of this apply filled pre-add rows
+                # under a then-current token — drop them, or every later
+                # full hit would serve pre-add values forever
+                self._train_cache.clear()
         except Exception as err:   # pragma: no cover - device failure
             for e in batch:
                 e.error = err
@@ -754,19 +771,29 @@ class Table:
         else applies inline under the dispatch lock."""
         opt = opt or AddOption()
         self._mark_mutated()
-        with monitor(f"table[{self.name}].add"):
-            if self._coalescible(delta, opt):
-                return self._enqueue_host_add(delta, opt)
-            with self._dispatch_lock:
-                if (self._wire != "none"
-                        and not isinstance(delta, jax.Array)):
-                    return self._add_async_wire(delta, opt)
-                delta_dev = self._host_delta(delta)
-                self._data, self._ustate, token = self._full_update_fn()(
-                    self._data, self._ustate, delta_dev, opt)
-                self._version_applied()
-                self._maybe_prefetch()
-        return self._track(token)
+        try:
+            with monitor(f"table[{self.name}].add"):
+                if self._coalescible(delta, opt):
+                    return self._enqueue_host_add(delta, opt)
+                with self._dispatch_lock:
+                    if (self._wire != "none"
+                            and not isinstance(delta, jax.Array)):
+                        return self._add_async_wire(delta, opt)
+                    delta_dev = self._host_delta(delta)
+                    self._data, self._ustate, token = \
+                        self._full_update_fn()(
+                            self._data, self._ustate, delta_dev, opt)
+                    self._version_applied()
+                    self._maybe_prefetch()
+            return self._track(token)
+        finally:
+            if self._train_cache is not None:
+                # whole-table delta: conservative wholesale drop, AFTER
+                # the delta is queued/applied (every return path above) —
+                # a clear logged before the mutation is visible lets a
+                # get racing into the window re-fill pre-add rows under
+                # a current fill token, permanently stale
+                self._train_cache.clear()
 
     def _add_async_wire(self, delta: ArrayLike, opt: AddOption) -> int:
         """Compressed upload: the host payload shrinks 2x (bf16) / ~29x
@@ -950,3 +977,6 @@ class Table:
         self._ustate = jax.tree.unflatten(
             treedef, [self._place_state(l) for l in leaves])
         self._version_applied()
+        if self._train_cache is not None:
+            self._train_cache.clear()   # after the load is visible (the
+            #  adopt()/add_async() clear-after-mutate ordering rule)

@@ -35,6 +35,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from multiverso_tpu import updaters as updaters_lib
+from multiverso_tpu.ops import row_assemble as _rowasm
+from multiverso_tpu.serving import hotcache as _hotcache
 from multiverso_tpu.table import ArrayLike, Table
 from multiverso_tpu.updaters import AddOption
 from multiverso_tpu.utils import config
@@ -49,10 +51,10 @@ from multiverso_tpu.utils.dashboard import monitor
 
 
 def _bucket_size(k: int, cap: int) -> int:
-    b = 8
-    while b < k:
-        b *= 2
-    return min(b, cap)
+    # one bucketing rule repo-wide (ops/row_assemble.bucket_rows is the
+    # shared home): the cache mirror's jit-trace buckets and the table
+    # layer's must never drift apart, or warm programs retrace
+    return min(_rowasm.bucket_rows(k), cap)
 
 
 class MatrixTable(Table):
@@ -64,6 +66,16 @@ class MatrixTable(Table):
         super().__init__((int(num_row), int(num_col)), dtype=dtype,
                          updater=updater, name=name, init=init, seed=seed,
                          init_scale=init_scale)
+        # hot-row training cache (flag train_cache_rows; ISSUE 11): a
+        # full-hit get serves host rows with no device gather/transfer.
+        # Write-through is exact here even multi-process: the collective
+        # row add hands every process the UNION delta the updater applies,
+        # so a plain-add table's cached copy tracks the device rows
+        # bit-for-bit
+        self._train_cache = _hotcache.make_train_cache(
+            name, int(num_col), self.dtype,
+            writethrough_ok=(getattr(self.updater, "name", "")
+                             == "default"))
 
     @property
     def num_row(self) -> int:
@@ -206,6 +218,11 @@ class MatrixTable(Table):
                 # sum the contributions. Still lockstep (every process must
                 # call) — the uncoordinated path is multiverso_tpu.ps.
                 ids, vals = self._union_across_processes(ids, vals)
+            if self._train_cache is not None:
+                # the UNION delta — exactly what the updater applies (pad
+                # slots point at scratch_row >= num_row: never cached, so
+                # their zero vals are ignored by the cache)
+                self._train_cache.on_push(ids, vals)
             fn = self._row_update_fn(ids.size)
             self._data, self._ustate, token = fn(
                 self._data, self._ustate,
@@ -229,14 +246,42 @@ class MatrixTable(Table):
         self._flush_host_adds()   # row reads see prior whole-table adds
         with monitor(f"table[{self.name}].get_rows"), self._dispatch_lock:
             ids, _, k, inv = self._prep_ids(row_ids)
+            tc = self._train_cache
+            uids = ids[:k]
+            token = 0
+            if tc is not None:
+                tc.on_get()
+                # serve_full: token + membership + gather in ONE cache
+                # lock hold (a wait()-thread fill_since cannot skew
+                # positions mid-serve); pushes order against the token
+                # via _dispatch_lock, which both paths hold. All-or-
+                # nothing: the partial path below refetches ALL k rows
+                # from the device, so a partial host gather is wasted
+                token, buf = tc.serve_full(uids.astype(np.int64))
+                if buf is not None:
+                    # full hit: serve the host copy — no device gather,
+                    # no device->host transfer (write-through keeps it
+                    # bit-identical to the device rows; invalidate
+                    # guarantees pushed rows can't be here)
+                    tc.count(k, 0)
+                    return self._track(buf, lambda b: b[inv])
+                tc.count(0, k)
             fn = self._row_get_fn()
             rows = fn(self._data, jax.device_put(ids, self._replicated))
             try:
                 rows.copy_to_host_async()
             except AttributeError:
                 pass
-            return self._track(
-                rows, lambda r: self._to_host(r)[:k][inv])  # re-expand dedup
+
+            def _fin(r):
+                host = self._to_host(r)[:k]
+                if tc is not None:
+                    # warm for the next block, reconciled against pushes
+                    # dispatched since the token (fill_since replay)
+                    tc.fill_since(uids.astype(np.int64), host, token)
+                return host[inv]
+
+            return self._track(rows, _fin)
 
     def get_rows(self, row_ids, out: Optional[np.ndarray] = None) -> np.ndarray:
         host = self.wait(self.get_rows_async(row_ids))
@@ -255,6 +300,23 @@ class MatrixTable(Table):
     def add_row(self, row_id: int, values,
                 opt: Optional[AddOption] = None) -> None:
         self.add_rows([row_id], np.asarray(values).reshape(1, -1), opt)
+
+    # ------------------------------------------------------------------ #
+    # hot-row training cache (serving/hotcache.TrainRowCache) — same
+    # surface as AsyncMatrixTable so the WE block driver is plane-blind
+    # ------------------------------------------------------------------ #
+    def train_cache_stats(self) -> Optional[Dict]:
+        tc = self._train_cache
+        return None if tc is None else tc.stats()
+
+    def train_cache_device_block(self, row_ids, bucket: int):
+        """Fused gather+pad device serve when EVERY id is cached (see
+        AsyncMatrixTable.train_cache_device_block); None = fall back to
+        get_rows_async, which counts its own hit/miss."""
+        tc = self._train_cache
+        if tc is None:
+            return None
+        return tc.device_block_counted(row_ids, bucket)
 
     # ------------------------------------------------------------------ #
     # functional plane for in-graph row traffic (used by word2vec)

@@ -296,7 +296,7 @@ class TestHotRowCache:
                                       t0.get_rows([7, 50]))
         # an uncached id misses the device path
         cold = int(np.setdiff1d(np.arange(64),
-                                np.asarray(rep._cache_ids))[0])
+                                np.asarray(rep._cache.ids()))[0])
         assert rep.cache_lookup([7, cold]) is None
         # hit/miss accounting over a mixed request
         h0, m0 = rep.stats()["cache_hits"], rep.stats()["cache_misses"]
@@ -335,15 +335,16 @@ class TestHotRowCache:
         rep = ReadReplica(t0, start=False, staleness_s=30.0,
                           cache_rows=4)
         rep.refresh()
-        assert rep._cache_dev is not None
+        assert rep._cache.memory_stats()["device_bytes"] > 0
         # unchanged epoch + no rebuild: keeping the cache is safe
         rep._hot_ids = None
         rep.refresh()
-        assert rep._cache_dev is not None
+        assert rep._cache.memory_stats()["device_bytes"] > 0
         # content moved + no rebuild: the old-epoch cache must go
         t0.add_rows([3], np.ones((1, 4), np.float32))
         rep.refresh()
-        assert rep._cache_dev is None and rep._cache_ids is None
+        assert (rep._cache.memory_stats()["device_bytes"] == 0
+                and len(rep._cache) == 0)
         assert rep.cache_lookup([3]) is None
         rep.close()
 

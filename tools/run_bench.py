@@ -107,6 +107,11 @@ _RETAINED_BASELINE_FLOOR_BYTES = 1 << 20
 # latency-growth flags above)
 _REGRESSION_KEYS_HIGHER = (
     (("serving", "served_qps"), "serving served QPS"),
+    # WE async-plane throughput (ISSUE 11): the ROADMAP item-2 scale
+    # metric — a >2x words/s drop is the pipeline silently falling back
+    # to serial prepare (or the training cache going cold), exactly the
+    # regression the pipelined path was built to close
+    (("we", "words_per_s"), "WE async words/s"),
 )
 
 
