@@ -547,6 +547,25 @@ def bench_dlrm_serving(seconds: float = 10.0):
     return _run_result_worker("bench_serving.py", [seconds], timeout=420)
 
 
+def bench_scale_curve(seconds: float = 3.0, shards: str = "1,2,4,8"):
+    """Mesh scale-curve harness (ISSUE 12, tools/bench_scale.py): the
+    async-PS workload at 1->2->4->8 server shards on the 8-virtual-
+    device host platform (process-per-point), plus a quiesced
+    model-average collective measurement per shard count.
+    Records T_n, E_n = T_n/(n*T_1) computed in-run, per-shard skew,
+    stall fraction, and the per-mesh-shape transfer/compile costs from
+    telemetry/devstats.py. The worker exits nonzero — failing this
+    sub-bench — if the SPMD compile-hygiene report is not clean for
+    every mesh shape (or a shape escaped the check). run_bench flags
+    run-over-run drops of extra.scale.efficiency_min / t1_rows_per_s.
+    The worker bounds each point's subprocess at 120 + 30*n s; this
+    outer budget exceeds the 1+2+4+8 sum (~1050 s) so a wedged point
+    surfaces as the worker's structured per-point error, never a
+    generic worker timeout that hides which shard count hung."""
+    return _run_result_worker("bench_scale.py", [seconds, shards],
+                              timeout=1200)
+
+
 def bench_chaos_failover(seconds: float = 16.0):
     """Elastic-failover chaos bench (ISSUE 7 acceptance): 2 server
     shards under sustained windowed add/get traffic, SIGKILL one, and
@@ -1123,6 +1142,10 @@ def main() -> None:
         serving_stats = bench_dlrm_serving()
     except Exception as e:
         serving_stats = {"error": f"{type(e).__name__}: {e}"[:200]}
+    try:
+        scale_stats = bench_scale_curve()
+    except Exception as e:
+        scale_stats = {"error": f"{type(e).__name__}: {e}"[:200]}
     # telemetry-plane record: latency HISTOGRAMS of every monitored op
     # this process ran (shutdown resets the dashboard, so snapshot now)
     try:
@@ -1198,6 +1221,10 @@ def main() -> None:
         "get_rows_plane": get_rows_stats,
         "chaos": chaos_stats,
         "serving": serving_stats,
+        # mesh scale curve (ISSUE 12): T_n / E_n per shard count, the
+        # SPMD hygiene verdict, and the device-plane cost attribution —
+        # run_bench flags efficiency_min / t1_rows_per_s drops
+        "scale": scale_stats,
         "dashboard_hist": dashboard_hist,
         "flightrec_dumps": flightrec_dumps,
         "memory": memory_stats_rec,

@@ -38,6 +38,7 @@ from multiverso_tpu.models import dlrm
 from multiverso_tpu.ps.tables import AsyncMatrixTable
 from multiverso_tpu.serving.admission import AdmissionController
 from multiverso_tpu.serving.replica import ReadReplica
+from multiverso_tpu.telemetry import devstats as _devstats
 from multiverso_tpu.telemetry import profiler as _prof
 from multiverso_tpu.updaters import AddOption
 
@@ -121,7 +122,10 @@ class DLRMServing:
             with _prof.phase("compute"):
                 if _prof.enabled():
                     _prof.watch_jit("dlrm.grad", self._grad)
-                    _prof.note_transfer(rows.nbytes)
+                # pulled rows ride to device through the devstats
+                # chokepoint (per-direction device-plane accounting +
+                # the profiler's per-step transfer delta)
+                _devstats.note_transfer(rows.nbytes, "h2d")
                 loss, g_mlp, g_rows = self._grad(
                     self.mlp, jnp.asarray(rows), jnp.asarray(dense),
                     jnp.asarray(labels))
@@ -131,6 +135,7 @@ class DLRMServing:
                         self.mlp, g_mlp)
                 g_host = np.asarray(g_rows).reshape(
                     b * f, self.cfg.embed_dim)
+                _devstats.note_transfer(g_host.nbytes, "d2h")
             t0 = time.perf_counter()
             # duplicate ids (same user twice in a batch) f64-accumulate
             # in the client's _dedupe_batch — scatter-add semantics,

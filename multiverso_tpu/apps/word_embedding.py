@@ -50,6 +50,7 @@ from multiverso_tpu.data.dictionary import Dictionary, build_huffman
 from multiverso_tpu.io.sample_reader import BlockPrepareQueue
 from multiverso_tpu.models import word2vec as w2v
 from multiverso_tpu.ops import row_assemble as _rowasm
+from multiverso_tpu.telemetry import devstats as _devstats
 from multiverso_tpu.telemetry import memstats as _memstats
 from multiverso_tpu.telemetry import profiler as _prof
 from multiverso_tpu.utils import config, log
@@ -768,9 +769,12 @@ class WordEmbedding:
                 if _prof.enabled():
                     _prof.watch_jit("we.local_train",
                                     self._local_train_fn())
-                    _prof.note_transfer(sum(
-                        int(np.asarray(a).nbytes)
-                        for a in prep["batch"]))
+                # batch upload through the devstats chokepoint (feeds
+                # the per-direction device-plane counters AND, when
+                # profiling, the step's transfer_bytes delta)
+                _devstats.note_transfer(sum(
+                    int(np.asarray(a).nbytes)
+                    for a in prep["batch"]), "h2d")
                 d_in, d_sec, loss = self._local_train_fn()(
                     win_l, wsec_l, jnp.asarray(prep["valid"]),
                     jax.device_put(prep["batch"]))
@@ -780,6 +784,7 @@ class WordEmbedding:
                 # async ps.add span via the table layer)
                 d_in = np.asarray(d_in)
                 d_sec = np.asarray(d_sec)
+                _devstats.note_transfer(d_in.nbytes + d_sec.nbytes, "d2h")
             with monitor("we.push"), _prof.phase("push"):
                 k = prep["vocab"].size
                 self.table_in.add_rows_async(

@@ -156,13 +156,31 @@ def make_train_step(cfg: DLRMConfig, emb_table, mlp_table, mlp_meta,
     n_mlp = int(mlp_table.shape[0])
     emb_opt = emb_opt or AddOption(learning_rate=0.05, rho=0.1)
     mlp_opt = mlp_opt or AddOption(learning_rate=0.05, rho=0.1)
+    # The MLP params sliced out of the mesh-sharded ArrayTable state must
+    # be pinned REPLICATED: on a multi-device mesh the SPMD partitioner
+    # otherwise propagates the state's row-sharding through the slice
+    # into the tiny parameter tensors and miscompiles the fused
+    # fwd+bwd+two-updates graph — wrong LOSS, wrong deltas (first seen
+    # when the 8-virtual-device conftest mesh became real; both updates
+    # must be live outputs to trigger it). Replicated is also simply the
+    # correct layout for a few-KB parameter vector every device reads.
+    try:
+        from jax.sharding import NamedSharding, PartitionSpec
+        from multiverso_tpu.zoo import Zoo
+        _replicated = NamedSharding(Zoo.get().mesh(), PartitionSpec())
+    except Exception:   # noqa: BLE001 — no Zoo/mesh: single-device use
+        _replicated = None
 
     def step(emb_state, mlp_state, cat_ids, dense, labels):
         ids = (cat_ids + offsets[None, :]).reshape(-1)        # [B*F] global
         rows = jnp.take(emb_state["data"], ids, axis=0)
         b, f = cat_ids.shape
         rows = rows.reshape(b, f, cfg.embed_dim)
-        mlp = unflatten_mlp(mlp_state["data"][:n_mlp], mlp_meta)
+        flat_params = mlp_state["data"][:n_mlp]
+        if _replicated is not None:
+            flat_params = jax.lax.with_sharding_constraint(
+                flat_params, _replicated)
+        mlp = unflatten_mlp(flat_params, mlp_meta)
         loss, (g_mlp, g_rows) = jax.value_and_grad(
             loss_fn, argnums=(0, 1))(mlp, rows, dense, labels, cfg)
         # PS push: duplicate-accumulating scatter of row grads into a dense

@@ -38,6 +38,14 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# cross-version Pallas API move (same class as jax.shard_map /
+# jax.lax.axis_size, see utils/platform.py): newer jax spells the
+# TPU compiler-params class CompilerParams, older releases
+# TPUCompilerParams — without the alias every flash-kernel path
+# import-errors on the older runtime
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
+
 _LANES = 128
 _RES_LANES = 8    # lse residual lane width (smallest legal TPU tile)
 _NEG_INF = -1e30
@@ -142,7 +150,7 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
             pltpu.VMEM((block_q, _LANES), jnp.float32),   # denominator
             pltpu.VMEM((block_q, d), jnp.float32),        # output acc
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(flat(q), flat(k), flat(v))
@@ -259,7 +267,7 @@ def _flash_backward(q, k, v, out, lse, do, causal: bool, block_q: int,
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qf, kf, vf, of, dof, lse)
@@ -279,7 +287,7 @@ def _flash_backward(q, k, v, out, lse, do, causal: bool, block_q: int,
                    jax.ShapeDtypeStruct((bh, s, d), v.dtype)],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qf, kf, vf, of, dof, lse)

@@ -31,7 +31,24 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from multiverso_tpu.telemetry import devstats as _devstats
+from multiverso_tpu.utils.platform import (
+    axis_size as _axis_size, shard_map as _shard_map)
 from multiverso_tpu.zoo import Zoo
+
+# jit-wrapped shard_map callable cache keyed on EVERY closed-over
+# parameter — the parallel/collectives.py discipline: rebuilding the
+# closure per call defeats every fn-identity cache, and eager legacy
+# shard_map re-lowers per call (the 25-calls-=-25-compiles pathology
+# the devstats compiles_by_mesh counter measured)
+_MAPPED = {}
+
+
+def _mapped(key, build):
+    fn = _MAPPED.get(key)
+    if fn is None:
+        fn = _MAPPED[key] = jax.jit(build())
+    return fn
 
 
 def sequence_shard(x, axis_name: Optional[str] = None, seq_dim: int = 2):
@@ -41,7 +58,11 @@ def sequence_shard(x, axis_name: Optional[str] = None, seq_dim: int = 2):
     ax = axis_name or zoo.shard_axis()
     spec = [None] * x.ndim
     spec[seq_dim] = ax
-    return jax.device_put(jnp.asarray(x), NamedSharding(mesh, P(*spec)))
+    x = jnp.asarray(x)
+    # host->device transfer through the devstats chokepoint (the sharded
+    # upload is exactly the device-plane cost the scale curve attributes)
+    _devstats.note_transfer(x.nbytes, "h2d")
+    return jax.device_put(x, NamedSharding(mesh, P(*spec)))
 
 
 def _online_update(qc, kc, vc, scale, allowed, m, l, o):
@@ -67,7 +88,7 @@ def _online_update(qc, kc, vc, scale, allowed, m, l, o):
 def _ring_attention_local(q, k, v, axis_name: str, scale: float,
                           causal: bool = False):
     """Per-shard body: local q [B,H,Sq,D] against rotating k/v blocks."""
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, h, sq, d = q.shape
     sk = k.shape[2]
@@ -127,14 +148,23 @@ def ring_attention(q, k, v, axis_name: Optional[str] = None,
     scale = 1.0 / (q.shape[-1] ** 0.5)
     spec = P(batch_axis, head_axis, ax, None)
 
-    fn = partial(_ring_attention_local, axis_name=ax, scale=scale,
-                 causal=causal)
-    mapped = jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                           out_specs=spec, check_vma=False)
-    if precision is not None:
-        with jax.default_matmul_precision(precision):
-            return mapped(q, k, v)
-    return mapped(q, k, v)
+    # every closed-over value is in the key: a head-dim change moves
+    # `scale`, and `precision` is trace-time (the context wraps the
+    # first call, which is when the cached fn traces)
+    mapped = _mapped(
+        ("ring", mesh, ax, scale, causal, batch_axis, head_axis,
+         precision),
+        lambda: _shard_map(
+            partial(_ring_attention_local, axis_name=ax, scale=scale,
+                    causal=causal),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False))
+    nbytes = q.nbytes + k.nbytes + v.nbytes
+    with _devstats.collective_span("ring_attention", nbytes, mesh=mesh):
+        if precision is not None:
+            with jax.default_matmul_precision(precision):
+                return mapped(q, k, v)
+        return mapped(q, k, v)
 
 
 def ulysses_attention(q, k, v, axis_name: Optional[str] = None,
@@ -173,8 +203,13 @@ def ulysses_attention(q, k, v, axis_name: Optional[str] = None,
         o = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
         return head2seq(o)
 
-    return jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec)(q, k, v)
+    nbytes = q.nbytes + k.nbytes + v.nbytes
+    mapped = _mapped(
+        ("ulysses", mesh, ax, scale, causal, batch_axis),
+        lambda: _shard_map(local, mesh=mesh,
+                           in_specs=(spec, spec, spec), out_specs=spec))
+    with _devstats.collective_span("ulysses_attention", nbytes, mesh=mesh):
+        return mapped(q, k, v)
 
 
 def zigzag_shard_ids(seq_len: int, n: int) -> "jnp.ndarray":
@@ -200,7 +235,7 @@ def _zigzag_ring_local(q, k, v, axis_name: str, scale: float):
     each (q-chunk, k-chunk) pair is decided per tick with ``lax.switch`` so
     dead pairs cost nothing and every shard computes exactly 2 of 4 pairs
     every tick — balanced, ~half the FLOPs of masked contiguous ring."""
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, h, s2, d = q.shape
     c = s2 // 2
@@ -279,13 +314,19 @@ def zigzag_ring_attention(q, k, v, axis_name: Optional[str] = None,
                          f"{mesh.shape[head_axis]} {head_axis!r} shards")
     scale = 1.0 / (q.shape[-1] ** 0.5)
     spec = P(batch_axis, head_axis, ax, None)
-    fn = partial(_zigzag_ring_local, axis_name=ax, scale=scale)
-    mapped = jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                           out_specs=spec, check_vma=False)
-    if precision is not None:
-        with jax.default_matmul_precision(precision):
-            return mapped(q, k, v)
-    return mapped(q, k, v)
+    mapped = _mapped(
+        ("zigzag", mesh, ax, scale, batch_axis, head_axis, precision),
+        lambda: _shard_map(
+            partial(_zigzag_ring_local, axis_name=ax, scale=scale),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False))
+    nbytes = q.nbytes + k.nbytes + v.nbytes
+    with _devstats.collective_span("zigzag_ring_attention", nbytes,
+                                   mesh=mesh):
+        if precision is not None:
+            with jax.default_matmul_precision(precision):
+                return mapped(q, k, v)
+        return mapped(q, k, v)
 
 
 def reference_attention(q, k, v, causal: bool = False):

@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from multiverso_tpu.telemetry import devstats as _devstats
+from multiverso_tpu.utils.platform import shard_map as _shard_map
 from multiverso_tpu.zoo import Zoo
 
 
@@ -88,6 +90,11 @@ def shard_params(params: Any, rules: Any,
                  mesh: Optional[Mesh] = None) -> Any:
     """device_put a param pytree according to a matching PartitionSpec tree."""
     mesh = mesh or Zoo.get().mesh()
+    # the whole-tree upload is a device-plane cost the scale curve
+    # attributes — count it once through the devstats chokepoint
+    _devstats.note_transfer(
+        sum(int(getattr(leaf, "nbytes", 0))
+            for leaf in jax.tree.leaves(params)), "h2d")
     # rules must mirror params' container structure with a PartitionSpec at
     # each array-leaf position (tree.map stops descending at params' leaves,
     # so the P tuples are picked up whole — but a P standing in for a whole
@@ -112,6 +119,20 @@ def _lead_spec(x, x_spec: Optional[P]) -> tuple:
     return lead + (None,) * (x.ndim - 1 - len(lead))
 
 
+# jit-wrapped shard_map callable cache keyed on every closed-over
+# parameter (the parallel/collectives.py discipline — a per-call
+# closure rebuild re-lowers/recompiles every call on the legacy
+# shard_map path; the devstats compiles_by_mesh counter measured it)
+_MAPPED = {}
+
+
+def _mapped(key, build):
+    fn = _MAPPED.get(key)
+    if fn is None:
+        fn = _MAPPED[key] = jax.jit(build())
+    return fn
+
+
 def column_parallel(x: jax.Array, w: jax.Array, axis: str = "tp",
                     mesh: Optional[Mesh] = None,
                     x_spec: Optional[P] = None) -> jax.Array:
@@ -126,10 +147,14 @@ def column_parallel(x: jax.Array, w: jax.Array, axis: str = "tp",
     def body(x, w):
         return x @ w
 
-    return jax.shard_map(
-        body, mesh=mesh,
-        in_specs=(P(*lead, None), P(None, axis)),
-        out_specs=P(*lead, axis), check_vma=False)(x, w)
+    with _devstats.collective_span("column_parallel",
+                                   x.nbytes + w.nbytes, mesh=mesh):
+        return _mapped(
+            ("col", mesh, axis, lead),
+            lambda: _shard_map(
+                body, mesh=mesh,
+                in_specs=(P(*lead, None), P(None, axis)),
+                out_specs=P(*lead, axis), check_vma=False))(x, w)
 
 
 def row_parallel(x: jax.Array, w: jax.Array, axis: str = "tp",
@@ -145,10 +170,14 @@ def row_parallel(x: jax.Array, w: jax.Array, axis: str = "tp",
     def body(x, w):
         return jax.lax.psum(x @ w, axis)
 
-    return jax.shard_map(
-        body, mesh=mesh,
-        in_specs=(P(*lead, axis), P(axis, None)),
-        out_specs=P(*lead, None), check_vma=False)(x, w)
+    with _devstats.collective_span("row_parallel",
+                                   x.nbytes + w.nbytes, mesh=mesh):
+        return _mapped(
+            ("row", mesh, axis, lead),
+            lambda: _shard_map(
+                body, mesh=mesh,
+                in_specs=(P(*lead, axis), P(axis, None)),
+                out_specs=P(*lead, None), check_vma=False))(x, w)
 
 
 def mlp_block(x: jax.Array, w1: jax.Array, w2: jax.Array,

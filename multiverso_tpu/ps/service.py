@@ -53,6 +53,7 @@ from multiverso_tpu.ps import failover as _failover
 # serving package never imports ps at module scope (no cycle).
 from multiverso_tpu.serving import replica as _serving_replica
 from multiverso_tpu.telemetry import aggregator as _aggregator
+from multiverso_tpu.telemetry import devstats as _devstats
 from multiverso_tpu.telemetry import exporter as _exporter
 from multiverso_tpu.telemetry import flightrec as _flight
 from multiverso_tpu.telemetry import memstats as _memstats
@@ -575,6 +576,7 @@ class PSService:
         _trace.configure(rank)
         _flight.configure(rank)
         _profiler.configure(rank)
+        _devstats.configure(rank)
         log.set_rank(rank)
         _watchdog.ensure_started()
         # memory sampler (flag memstats_interval_s; the byte LEDGER is
@@ -848,6 +850,17 @@ class PSService:
         try:
             payload["memory"] = _memstats.stats_snapshot()
         except Exception:   # noqa: BLE001 — telemetry never breaks stats
+            pass
+        # device plane (telemetry/devstats.py): transfer/collective/
+        # compile counters + the per-device live-buffer rollup. OMITTED
+        # when nothing ran on the device plane (and by older peers in a
+        # mixed-version cluster) — every consumer renders its absence
+        # as "-", never a KeyError.
+        try:
+            devices = _devstats.stats_snapshot()
+            if devices:
+                payload["devices"] = devices
+        except Exception:   # noqa: BLE001
             pass
         return payload
 

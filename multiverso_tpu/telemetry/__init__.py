@@ -9,7 +9,7 @@ the shutdown report (and any exporter) sees p50/p90/p99/max — tail
 regressions on the batched, compressed PS plane do not hide behind a
 stable mean.
 
-Eight cooperating pieces:
+Nine cooperating pieces:
 
 * :mod:`~multiverso_tpu.telemetry.histogram` — the lock-free (caller-
   synchronized) log2-bucket histogram every Monitor embeds.
@@ -40,6 +40,16 @@ Eight cooperating pieces:
   verdicts (epoch-hoard, retention-leak, rss-creep) ride the watchdog
   sweep, and every flight-recorder dump carries the ledger + sample
   history for OOM forensics (docs/OBSERVABILITY.md "Memory view").
+* :mod:`~multiverso_tpu.telemetry.devstats` — the DEVICE plane:
+  host<->device transfer byte counters (one chokepoint, per
+  direction), per-mesh-shape compile attribution off the
+  ``jax.monitoring`` hook, collective op spans (every
+  ``parallel/collectives.py`` entry lands Dashboard ``coll[op]``
+  monitors, flightrec ``coll.begin``/``coll.end`` events, and a
+  step-profiler async span), the per-device ``jax.live_arrays()``
+  rollup riding MSG_STATS as the ``"devices"`` block, and the SPMD
+  compile-hygiene capture ``tools/bench_scale.py`` asserts clean
+  (docs/OBSERVABILITY.md "Device view & scale curves").
 * :mod:`~multiverso_tpu.telemetry.aggregator` — the controller-side
   cluster plane: flag-gated (``stats_poll_interval_s``) polling of
   every rank's MSG_STATS + MSG_HEALTH over one-shot probe connections,

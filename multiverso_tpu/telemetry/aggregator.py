@@ -326,6 +326,59 @@ def merge_cluster(stats_by_rank: Dict[int, Any],
             "totals": {k: (round(v, 3) if k == "rss_mb" else int(v))
                        for k, v in sorted(mem_totals.items())},
         }
+    # device plane (telemetry/devstats.py): per-rank "devices" blocks
+    # passed through + cluster totals. PROCESS-global like the monitors
+    # (one DevStats per OS process), so totals dedupe by (host, pid).
+    # The block is ADDITIVE: a payload without it (an older peer in a
+    # mixed-version cluster, or a rank with no device activity) simply
+    # contributes nothing — no consumer may require it.
+    devices: Dict[str, Dict] = {}
+    dev_totals: Dict[str, float] = {}
+    seen_dev: set = set()
+    for r in sorted(stats_by_rank):
+        st = stats_by_rank[r]
+        if not isinstance(st, dict):
+            continue
+        d = st.get("devices")
+        if not isinstance(d, dict):
+            continue
+        devices[str(r)] = d
+        proc = _proc_key(st, r)
+        if proc in seen_dev:
+            continue
+        seen_dev.add(proc)
+        for direction, g in (d.get("transfers") or {}).items():
+            if isinstance(g, dict):
+                dev_totals[f"{direction}_bytes"] = (
+                    dev_totals.get(f"{direction}_bytes", 0)
+                    + int(g.get("bytes") or 0))
+        for c in (d.get("collectives") or {}).values():
+            if isinstance(c, dict):
+                dev_totals["coll_calls"] = (
+                    dev_totals.get("coll_calls", 0)
+                    + int(c.get("calls") or 0))
+                dev_totals["coll_bytes"] = (
+                    dev_totals.get("coll_bytes", 0)
+                    + int(c.get("bytes") or 0))
+        for c in (d.get("compiles_by_mesh") or {}).values():
+            if isinstance(c, dict):
+                dev_totals["compiles"] = (
+                    dev_totals.get("compiles", 0)
+                    + int(c.get("compiles") or 0))
+                dev_totals["compile_s"] = round(
+                    dev_totals.get("compile_s", 0.0)
+                    + float(c.get("compile_s") or 0.0), 3)
+        for g in (d.get("per_device") or {}).values():
+            if isinstance(g, dict):
+                dev_totals["device_bytes"] = (
+                    dev_totals.get("device_bytes", 0)
+                    + int(g.get("bytes") or 0))
+        if d.get("hygiene_findings"):
+            dev_totals["hygiene_findings"] = (
+                dev_totals.get("hygiene_findings", 0)
+                + int(d["hygiene_findings"]))
+    if devices:
+        rec["devices"] = {"ranks": devices, "totals": dev_totals}
     if hot:
         rec["hotkeys"] = {}
         for tname, sketches in hot.items():

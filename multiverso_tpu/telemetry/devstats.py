@@ -1,0 +1,585 @@
+"""Device-plane observability: transfers, collectives, mesh-keyed compiles.
+
+Every observability plane built so far (PRs 3/4/6/9/10) measures the
+HOST plane — wire latency, step phases, bytes in Python-owned buffers.
+The scale-out work (ROADMAP item 1: N-shard topologies on a device
+mesh; item 4: the PS-bypassing allreduce plane) is judged by
+DEVICE-plane costs this rank could not see: host<->device transfer
+bytes, which mesh configuration triggered a recompile, where the live
+device bytes sit, and what each collective moved. This module is that
+layer — four gauges sharing the flight-recorder's cost discipline
+(cheap increments at instrumented sites, everything else pull-only):
+
+* **Transfer chokepoint** — :func:`note_transfer` counts host<->device
+  bytes PER DIRECTION (``h2d``/``d2h``). It generalizes the PR-9
+  instrumented-site accounting into one funnel: the word-embedding and
+  DLRM pipelines, ``sequence_shard``/``shard_params`` device_puts, and
+  ``process_sum``'s round trip all report here, and the h2d side still
+  feeds the step profiler's per-step ``transfer_bytes`` delta.
+* **Mesh-keyed compile events** — a ``jax.monitoring`` duration
+  listener (the PR-9 hook, extended) attributes every backend compile
+  to the ACTIVE mesh shape: :func:`mesh_scope` (collective spans push
+  it automatically) or the Zoo's :func:`set_default_mesh`. A recompile
+  now names which mesh configuration triggered it — the signal the
+  1->2->4->8 scale harness keys its compile accounting on.
+* **Per-device census rollup** — :func:`device_rollup` groups the
+  PR-10 ``jax.live_arrays()`` census BY DEVICE (sharded arrays are
+  attributed per addressable shard), so "which chip holds the bytes"
+  is a stats pull, not a forensic dump.
+* **Collective spans** — :func:`collective_span` wraps every
+  ``parallel/`` collective entry point: op/bytes/duration land as
+  Dashboard monitors (``coll[op]`` timed + ``.calls``/``.bytes``
+  counters in the zoo shutdown report), flight-recorder
+  ``coll.begin``/``coll.end`` events, a step-profiler async span
+  (``attach="any"``), and this module's per-op tally. Durations are
+  HOST dispatch+compile wall time — jax dispatch is async, so a
+  non-blocking caller's span excludes device execution (same caveat
+  as every Dashboard monitor around jitted code).
+
+The rollup rides MSG_STATS as the ``"devices"`` block
+(:func:`stats_snapshot`): ``aggregator.merge_cluster`` merges it per
+rank with (host, pid)-deduped cluster totals, ``tools/mvtop.py`` grows
+a device panel, ``tools/dump_metrics.py`` renders it, and the exporter
+emits ``mv_dev_*`` Prometheus gauges. A payload WITHOUT the block (an
+older peer in a mixed-version cluster) renders as "-" everywhere — the
+block is additive, never required.
+
+**Compile hygiene** (the scale-out gate): :func:`capture_hygiene`
+scopes a structured ``warnings`` + jax-logger capture around dryrun
+compiles and classifies SPMD remat / sharding-fallback / donation
+warnings into a machine-readable report keyed (jitted fn, mesh shape).
+``tools/bench_scale.py`` asserts the report CLEAN in-run for the
+shipped workload at every mesh shape; :func:`dump_hygiene` writes
+``compile-hygiene-rank<r>.json`` for ``tools/mvprof.py --report``.
+
+Cost discipline: the ``devstats`` flag (default ON) gates every
+recording site behind one attribute read; counters are one int add
+under a lock at per-batch (not per-row) sites; the live-arrays walk
+runs only on a stats pull. ``tools/bench_small_add.py`` asserts the
+PR-2 0.03-0.06 ms small-add band in-run with the plane live.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+import warnings
+from typing import Any, Dict, List, Optional
+
+from multiverso_tpu.utils import config
+
+config.define_bool(
+    "devstats", True,
+    "device-plane observability (telemetry/devstats.py): host<->device "
+    "transfer byte counters, per-mesh-shape compile attribution, "
+    "collective op spans (Dashboard coll[op] monitors + flightrec "
+    "coll.begin/end + profiler async spans), and the per-device "
+    "live-arrays rollup in the MSG_STATS 'devices' block. On by "
+    "default: one attribute read gates every site; the live-arrays "
+    "walk runs only on a stats pull, never on a hot path")
+
+# directions the transfer chokepoint accepts — anything else raises at
+# the instrumented site (a typo'd direction must not open a third,
+# never-rendered counter)
+_DIRECTIONS = ("h2d", "d2h")
+
+# compile events with no mesh scope active (host-plane jits, warmup
+# before any mesh exists) key under this label
+_NO_MESH = "unmeshed"
+
+
+# ---------------------------------------------------------------------- #
+# mesh labels
+# ---------------------------------------------------------------------- #
+def mesh_label(mesh: Any) -> str:
+    """Canonical label for a mesh configuration: ``"{'mv': 4}"`` for a
+    ``jax.sharding.Mesh``; dicts/strings pass through (bench harnesses
+    and tests hand shapes around without building a Mesh)."""
+    if mesh is None:
+        return _NO_MESH
+    if isinstance(mesh, str):
+        return mesh
+    if isinstance(mesh, dict):
+        return str(dict(mesh))
+    names = getattr(mesh, "axis_names", None)
+    devs = getattr(mesh, "devices", None)
+    if names is not None and devs is not None:
+        return str(dict(zip(names, devs.shape)))
+    return str(mesh)
+
+
+# ---------------------------------------------------------------------- #
+# compile-hygiene classification (pure; oracle-tested)
+# ---------------------------------------------------------------------- #
+# category -> lowercase substrings; first hit wins, in order — remat and
+# sharding fallbacks are the SPMD warnings the scale harness gates on,
+# donation is the PR-9 signal lifted to the same report
+_HYGIENE_PATTERNS = (
+    ("remat", ("remat", "rematerial")),
+    ("sharding-fallback", ("could not infer sharding",
+                           "falling back to replicat",
+                           "fully replicated",
+                           "sharding propagation",
+                           "resharding",
+                           "spmd partition")),
+    ("donation", ("donated buffers were not usable",)),
+    ("spmd", ("spmd",)),
+)
+
+
+def classify_compile_warning(message: str) -> Optional[str]:
+    """SPMD-hygiene category for one warning/log message, or None for
+    noise (deprecations, user warnings) that is NOT a compile-hygiene
+    finding. Substring match, case-insensitive — the exact wordings
+    move across jax/XLA versions, the vocabulary does not."""
+    low = str(message).lower()
+    for cat, needles in _HYGIENE_PATTERNS:
+        for n in needles:
+            if n in low:
+                return cat
+    return None
+
+
+class _LogTap(logging.Handler):
+    """Captures jax-logger records during a hygiene scope (XLA routes
+    some SPMD diagnostics through logging, not warnings)."""
+
+    def __init__(self) -> None:
+        super().__init__(level=logging.WARNING)
+        self.messages: List[str] = []
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            self.messages.append(record.getMessage())
+        except Exception:   # noqa: BLE001 — a bad log record must not
+            pass            # fail the compile it decorates
+
+
+# ---------------------------------------------------------------------- #
+# device census rollup (pull-only; injectable for tests)
+# ---------------------------------------------------------------------- #
+def device_rollup(arrays: Optional[List[Any]] = None
+                  ) -> Optional[Dict[str, Dict[str, int]]]:
+    """Live JAX buffers grouped BY DEVICE: ``{device: {"bytes",
+    "arrays"}}``. Sharded arrays are attributed per addressable shard
+    (each device is charged exactly the bytes it holds); ``arrays``
+    injects a fixture list so the grouping is testable without a live
+    backend. None when JAX is unavailable; {} when nothing is live."""
+    if arrays is None:
+        try:
+            import jax
+            arrays = jax.live_arrays()
+        except Exception:   # noqa: BLE001 — census is best-effort
+            return None
+    per: Dict[str, List[int]] = {}
+    for a in arrays:
+        try:
+            shards = getattr(a, "addressable_shards", None)
+            if shards:
+                for s in shards:
+                    g = per.setdefault(str(s.device), [0, 0])
+                    g[0] += int(s.data.nbytes)
+                    g[1] += 1
+            else:
+                dev = ",".join(sorted(str(d) for d in a.devices()))
+                g = per.setdefault(dev, [0, 0])
+                g[0] += int(a.nbytes)
+                g[1] += 1
+        except Exception:   # noqa: BLE001 — a buffer donated/deleted
+            continue        # mid-walk must not fail the rollup
+    return {d: {"bytes": b, "arrays": n}
+            for d, (b, n) in sorted(per.items())}
+
+
+# ---------------------------------------------------------------------- #
+# the span / scope contexts
+# ---------------------------------------------------------------------- #
+class _NullCtx:
+    """Shared no-op context — the flag-off path allocates nothing."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullCtx()
+
+
+class _MeshScope:
+    __slots__ = ("_ds", "_label")
+
+    def __init__(self, ds: "DevStats", label: str):
+        self._ds = ds
+        self._label = label
+
+    def __enter__(self):
+        stack = getattr(self._ds._tls, "mesh_stack", None)
+        if stack is None:
+            stack = self._ds._tls.mesh_stack = []
+        stack.append(self._label)
+        return self._label
+
+    def __exit__(self, *exc):
+        try:
+            self._ds._tls.mesh_stack.pop()
+        except (AttributeError, IndexError):
+            pass
+        return False
+
+
+class _CollSpan:
+    """One collective op's span: Dashboard + flightrec + profiler +
+    the per-op tally, and a mesh scope so a compile triggered inside
+    is keyed to the op's mesh."""
+
+    __slots__ = ("_ds", "_op", "_nbytes", "_scope", "_t0")
+
+    def __init__(self, ds: "DevStats", op: str, nbytes: int,
+                 label: Optional[str]):
+        self._ds = ds
+        self._op = op
+        self._nbytes = int(nbytes)
+        self._scope = (_MeshScope(ds, label) if label is not None
+                       else None)
+
+    def __enter__(self):
+        from multiverso_tpu.telemetry import flightrec as _flight
+        if self._scope is not None:
+            self._scope.__enter__()
+        self._t0 = time.time()
+        _flight.record(_flight.EV_COLL_BEGIN, nbytes=self._nbytes,
+                       note=f"coll.{self._op}")
+        return self
+
+    def __exit__(self, *exc):
+        from multiverso_tpu.telemetry import flightrec as _flight
+        from multiverso_tpu.telemetry import profiler as _profiler
+        from multiverso_tpu.utils.dashboard import Dashboard
+        t1 = time.time()
+        if self._scope is not None:
+            self._scope.__exit__()
+        ms = (t1 - self._t0) * 1e3
+        with self._ds._lock:
+            d = self._ds._coll.setdefault(
+                self._op, {"calls": 0, "bytes": 0, "ms": 0.0})
+            d["calls"] += 1
+            d["bytes"] += self._nbytes
+            d["ms"] = round(d["ms"] + ms, 4)
+        Dashboard.get(f"coll[{self._op}]").observe_ms(ms)
+        Dashboard.get(f"coll[{self._op}].calls").incr()
+        Dashboard.get(f"coll[{self._op}].bytes").incr(self._nbytes)
+        _flight.record(_flight.EV_COLL_END, nbytes=self._nbytes,
+                       note=f"coll.{self._op}")
+        # the wire-hiding question for collectives is the same as for
+        # PS round-trips: attach to whatever step is open, any thread
+        _profiler.note_async(f"coll.{self._op}", self._t0, t1,
+                             attach="any")
+        return False
+
+
+# ---------------------------------------------------------------------- #
+# the process-global gauge set
+# ---------------------------------------------------------------------- #
+class DevStats:
+    """One per process (like the FlightRecorder/StepProfiler);
+    in-process multi-rank worlds share it — the same documented
+    collapse, deduped by (host, pid) in the cluster merge."""
+
+    def __init__(self) -> None:
+        self.enabled = True       # plain attribute: THE site gate
+        self.rank = 0
+        self._rank_pinned = False
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._default_mesh: Optional[str] = None
+        # direction -> [ops, bytes]
+        self._transfers: Dict[str, List[int]] = {
+            d: [0, 0] for d in _DIRECTIONS}
+        # op -> {"calls", "bytes", "ms"}
+        self._coll: Dict[str, Dict[str, Any]] = {}
+        # mesh label -> {"compiles", "compile_s"}
+        self._compiles: Dict[str, Dict[str, Any]] = {}
+        self._listener_installed = False
+        # hygiene report: entries + per-scope check log
+        self._hygiene: List[Dict[str, Any]] = []
+        self._hygiene_checked: List[Dict[str, Any]] = []
+
+    # ------------------------------------------------------------------ #
+    def configure(self, rank: Optional[int] = None) -> None:
+        """Adopt the ``devstats`` flag (PSService init / Zoo.start);
+        idempotent, first caller's rank sticks."""
+        if rank is not None and not self._rank_pinned:
+            self.rank = int(rank)
+            self._rank_pinned = True
+        self.enabled = bool(config.get_flag("devstats"))
+        if self.enabled:
+            self._install_listener()
+
+    def _install_listener(self) -> None:
+        with self._lock:
+            if self._listener_installed:
+                return
+            self._listener_installed = True
+        try:
+            import jax.monitoring as _jm
+            _jm.register_event_duration_secs_listener(self._on_duration)
+        except Exception:   # noqa: BLE001 — device telemetry must
+            pass            # degrade, not break, on exotic builds
+
+    def _on_duration(self, name: str, dur: float, **kw) -> None:
+        # same event the PR-9 profiler counts globally; here each
+        # compile is ADDITIONALLY keyed to the active mesh shape
+        if not name.endswith("backend_compile_duration") \
+                or not self.enabled:
+            return
+        label = self._mesh_label()
+        with self._lock:
+            d = self._compiles.setdefault(
+                label, {"compiles": 0, "compile_s": 0.0})
+            d["compiles"] += 1
+            d["compile_s"] = round(d["compile_s"] + float(dur), 6)
+
+    # ------------------------------------------------------------------ #
+    # mesh context
+    # ------------------------------------------------------------------ #
+    def _mesh_label(self) -> str:
+        stack = getattr(self._tls, "mesh_stack", None)
+        if stack:
+            return stack[-1]
+        return self._default_mesh or _NO_MESH
+
+    def mesh_scope(self, mesh: Any):
+        """Key compiles fired inside this scope (on this thread) to
+        ``mesh``'s shape. Collective spans push one automatically."""
+        if not self.enabled:
+            return _NULL
+        return _MeshScope(self, mesh_label(mesh))
+
+    def set_default_mesh(self, mesh: Any) -> None:
+        """Process-default mesh label (Zoo.start's adopted mesh) for
+        compiles with no explicit scope on their thread."""
+        self._default_mesh = mesh_label(mesh) if mesh is not None else None
+
+    # ------------------------------------------------------------------ #
+    # recording sites
+    # ------------------------------------------------------------------ #
+    def note_transfer(self, nbytes: int, direction: str = "h2d") -> None:
+        """THE host<->device transfer chokepoint. ``h2d`` additionally
+        feeds the step profiler's per-step transfer delta (the PR-9
+        counter this generalizes)."""
+        if direction not in _DIRECTIONS:
+            raise ValueError(f"direction {direction!r}: expected one of "
+                             f"{_DIRECTIONS}")
+        if self.enabled:
+            with self._lock:
+                g = self._transfers[direction]
+                g[0] += 1
+                g[1] += int(nbytes)
+        if direction == "h2d":
+            from multiverso_tpu.telemetry import profiler as _profiler
+            _profiler.note_transfer(int(nbytes))
+
+    def collective_span(self, op: str, nbytes: int, mesh: Any = None):
+        """Span context for one collective call — see module
+        docstring. No-op (shared context, no allocation) when the
+        ``devstats`` flag is off."""
+        if not self.enabled:
+            return _NULL
+        return _CollSpan(self, op, nbytes,
+                         mesh_label(mesh) if mesh is not None else None)
+
+    # ------------------------------------------------------------------ #
+    # compile hygiene
+    # ------------------------------------------------------------------ #
+    def capture_hygiene(self, fn: str, mesh: Any = None):
+        """Scope a dryrun compile: captured ``warnings`` + jax-logger
+        messages are classified (:func:`classify_compile_warning`) and
+        classified hits land in the report keyed (``fn``, mesh shape).
+        Returns the context manager; the report accumulates across
+        scopes until :meth:`reset`."""
+        return _HygieneScope(self, fn,
+                             mesh_label(mesh) if mesh is not None
+                             else self._mesh_label())
+
+    def _hygiene_commit(self, fn: str, label: str,
+                        messages: List[str]) -> List[Dict[str, Any]]:
+        entries = []
+        for m in messages:
+            cat = classify_compile_warning(m)
+            if cat:
+                entries.append({"fn": fn, "mesh": label,
+                                "category": cat,
+                                "message": str(m)[:240]})
+        with self._lock:
+            self._hygiene_checked.append(
+                {"fn": fn, "mesh": label, "captured": len(messages),
+                 "findings": len(entries)})
+            self._hygiene.extend(entries)
+        return entries
+
+    def hygiene_report(self) -> Dict[str, Any]:
+        """The machine-readable compile-hygiene report: every scoped
+        dryrun checked, every classified finding, and the headline
+        ``clean`` verdict ``bench_scale`` asserts in-run."""
+        with self._lock:
+            return {"clean": not self._hygiene,
+                    "checked": list(self._hygiene_checked),
+                    "findings": list(self._hygiene)}
+
+    def dump_hygiene(self, directory: str,
+                     rank: Optional[int] = None) -> str:
+        """Write ``compile-hygiene-rank<r>.json`` (atomic replace) for
+        ``tools/mvprof.py --report``; returns the path."""
+        r = self.rank if rank is None else rank
+        rep = self.hygiene_report()
+        rep["rank"] = r
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, f"compile-hygiene-rank{r}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(rep, f, indent=1)
+        os.replace(tmp, path)
+        return path
+
+    # ------------------------------------------------------------------ #
+    # reads
+    # ------------------------------------------------------------------ #
+    def stats_snapshot(self) -> Optional[Dict[str, Any]]:
+        """The MSG_STATS ``"devices"`` block: per-direction transfer
+        counters, per-op collective tallies, per-mesh-shape compile
+        events, and the per-device live-buffer rollup. None when the
+        flag is off AND when there is nothing to report (no activity,
+        no live buffers) — older-peer payloads simply lack the block,
+        and every renderer degrades to "-"."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            transfers = {d: {"ops": g[0], "bytes": g[1]}
+                         for d, g in self._transfers.items() if g[0]}
+            colls = {op: dict(d) for op, d in self._coll.items()}
+            compiles = {k: dict(v) for k, v in self._compiles.items()}
+            findings = len(self._hygiene)
+        per_device = device_rollup()
+        # findings count as activity: a rank whose compiles all hit the
+        # persistent cache can still carry a DIRTY hygiene report, and
+        # omitting the block would keep mvtop's HYGIENE FINDINGS header
+        # and mv_dev_hygiene_findings dark exactly when they matter
+        if not (transfers or colls or compiles or per_device
+                or findings):
+            return None
+        out: Dict[str, Any] = {"transfers": transfers,
+                               "collectives": colls,
+                               "compiles_by_mesh": compiles}
+        if per_device:
+            out["per_device"] = per_device
+        if findings:
+            out["hygiene_findings"] = findings
+        return out
+
+    def reset(self) -> None:
+        """Test isolation: drop counters/report and unpin; the jax
+        listener stays installed (idempotent, costs one substring
+        check per compile) and re-reads ``self.enabled``."""
+        with self._lock:
+            self._transfers = {d: [0, 0] for d in _DIRECTIONS}
+            self._coll.clear()
+            self._compiles.clear()
+            self._hygiene.clear()
+            self._hygiene_checked.clear()
+            self._rank_pinned = False
+            self.rank = 0
+            self._default_mesh = None
+        self._tls = threading.local()
+        self.enabled = True
+
+
+class _HygieneScope:
+    __slots__ = ("_ds", "_fn", "_label", "_wctx", "_caught", "_tap",
+                 "_loggers", "_mesh_scope", "entries")
+
+    def __init__(self, ds: DevStats, fn: str, label: str):
+        self._ds = ds
+        self._fn = fn
+        self._label = label
+        self.entries: List[Dict[str, Any]] = []
+
+    def __enter__(self):
+        self._wctx = warnings.catch_warnings(record=True)
+        self._caught = self._wctx.__enter__()
+        warnings.simplefilter("always")
+        self._tap = _LogTap()
+        # ONE tap on the root "jax" logger: every jax._src.* record
+        # reaches it via logger propagation, and a second handler on
+        # "jax._src" double-counted each SPMD diagnostic in the report
+        self._loggers = [logging.getLogger("jax")]
+        for lg in self._loggers:
+            lg.addHandler(self._tap)
+        self._mesh_scope = _MeshScope(self._ds, self._label)
+        self._mesh_scope.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._mesh_scope.__exit__()
+        for lg in self._loggers:
+            lg.removeHandler(self._tap)
+        messages = [str(w.message) for w in self._caught]
+        self._wctx.__exit__(*exc)
+        messages += self._tap.messages
+        self.entries = self._ds._hygiene_commit(
+            self._fn, self._label, messages)
+        return False
+
+
+DEVSTATS = DevStats()
+
+
+# module-level wrappers (the call-site idiom, like telemetry.profiler)
+def enabled() -> bool:
+    return DEVSTATS.enabled
+
+
+def configure(rank: Optional[int] = None) -> None:
+    DEVSTATS.configure(rank)
+
+
+def note_transfer(nbytes: int, direction: str = "h2d") -> None:
+    DEVSTATS.note_transfer(nbytes, direction)
+
+
+def collective_span(op: str, nbytes: int, mesh: Any = None):
+    return DEVSTATS.collective_span(op, nbytes, mesh=mesh)
+
+
+def mesh_scope(mesh: Any):
+    return DEVSTATS.mesh_scope(mesh)
+
+
+def set_default_mesh(mesh: Any) -> None:
+    DEVSTATS.set_default_mesh(mesh)
+
+
+def capture_hygiene(fn: str, mesh: Any = None):
+    return DEVSTATS.capture_hygiene(fn, mesh=mesh)
+
+
+def hygiene_report() -> Dict[str, Any]:
+    return DEVSTATS.hygiene_report()
+
+
+def dump_hygiene(directory: str, rank: Optional[int] = None) -> str:
+    return DEVSTATS.dump_hygiene(directory, rank=rank)
+
+
+def stats_snapshot() -> Optional[Dict[str, Any]]:
+    return DEVSTATS.stats_snapshot()
+
+
+def reset() -> None:
+    DEVSTATS.reset()

@@ -120,6 +120,51 @@ def prometheus_text(payload: Dict) -> str:
                         f'mv_mem_component{{component='
                         f'"{_prom_name(comp)}",field="{_prom_name(k)}",'
                         f'rank="{rank}"}} {v}')
+    # device plane (telemetry/devstats.py): transfer/collective/compile
+    # counters + the per-device live-buffer rollup off the MSG_STATS
+    # "devices" block. Absent block (older peer, no device activity) =
+    # no lines — the scrape simply lacks the series, never errors.
+    dev = payload.get("devices")
+    if isinstance(dev, dict):
+        lines.append("# TYPE mv_dev_transfer_bytes counter")
+        lines.append("# TYPE mv_dev_collective_calls counter")
+        lines.append("# TYPE mv_dev_collective_bytes counter")
+        lines.append("# TYPE mv_dev_compiles counter")
+        lines.append("# TYPE mv_dev_live_bytes gauge")
+        for direction, g in sorted((dev.get("transfers") or {}).items()):
+            if not isinstance(g, dict):
+                continue
+            lbl = (f'{{direction="{_prom_name(direction)}",'
+                   f'rank="{rank}"}}')
+            lines.append(f"mv_dev_transfer_bytes{lbl} "
+                         f"{g.get('bytes', 0)}")
+            lines.append(f"mv_dev_transfer_ops{lbl} {g.get('ops', 0)}")
+        for op, c in sorted((dev.get("collectives") or {}).items()):
+            if not isinstance(c, dict):
+                continue
+            lbl = f'{{op="{_prom_name(op)}",rank="{rank}"}}'
+            lines.append(f"mv_dev_collective_calls{lbl} "
+                         f"{c.get('calls', 0)}")
+            lines.append(f"mv_dev_collective_bytes{lbl} "
+                         f"{c.get('bytes', 0)}")
+            lines.append(f"mv_dev_collective_ms{lbl} {c.get('ms', 0.0)}")
+        for label, c in sorted(
+                (dev.get("compiles_by_mesh") or {}).items()):
+            if not isinstance(c, dict):
+                continue
+            lbl = f'{{mesh="{_prom_name(label)}",rank="{rank}"}}'
+            lines.append(f"mv_dev_compiles{lbl} {c.get('compiles', 0)}")
+            lines.append(f"mv_dev_compile_seconds{lbl} "
+                         f"{c.get('compile_s', 0.0)}")
+        for device, g in sorted((dev.get("per_device") or {}).items()):
+            if not isinstance(g, dict):
+                continue
+            lbl = f'{{device="{_prom_name(device)}",rank="{rank}"}}'
+            lines.append(f"mv_dev_live_bytes{lbl} {g.get('bytes', 0)}")
+            lines.append(f"mv_dev_live_arrays{lbl} {g.get('arrays', 0)}")
+        if dev.get("hygiene_findings"):
+            lines.append(f'mv_dev_hygiene_findings{{rank="{rank}"}} '
+                         f"{dev['hygiene_findings']}")
     return "\n".join(lines) + "\n"
 
 
@@ -214,13 +259,23 @@ def default_stats_fn() -> Dict:
     monitors once, not once per rank (aggregator.merge_cluster keys on
     the addr host + pid)."""
     from multiverso_tpu.utils.dashboard import Dashboard
-    return {
+    out = {
         "monitors": {name: snap.hist_dict()
                      for name, snap in Dashboard.snapshot().items()},
         "notes": Dashboard.notes(),
         "shards": {},
         "pid": os.getpid(),
     }
+    # device plane: same additive "devices" block PSService.stats_payload
+    # carries, so a Zoo-only process (no PS) still exports mv_dev_*
+    try:
+        from multiverso_tpu.telemetry import devstats as _devstats
+        devices = _devstats.stats_snapshot()
+        if devices:
+            out["devices"] = devices
+    except Exception:   # noqa: BLE001 — telemetry never breaks export
+        pass
+    return out
 
 
 def ensure_started(rank: int,

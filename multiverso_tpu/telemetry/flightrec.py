@@ -118,6 +118,12 @@ EV_MEM_HOARD = 34         # epoch-hoard: aged pin holding retired buffers
 EV_MEM_LEAK = 35          # retention-leak: replay tail growing, live owner
 EV_MEM_RSS = 36           # rss-creep / rss soft-limit trip
 EV_MEM_DUMP = 37          # OOM forensics dump fired (MemoryError/limit)
+# device plane (telemetry/devstats.py): collective op begin/end — every
+# parallel/collectives.py entry point marks both edges (note carries
+# "coll.<op>", nbytes the payload), so a hang inside a mesh collective
+# is visible on the tape like a wedged wire op
+EV_COLL_BEGIN = 38        # collective op dispatched (host side)
+EV_COLL_END = 39          # collective op returned to the caller
 
 EV_NAMES = {
     EV_SEND: "send", EV_ACK: "ack", EV_ERR: "err", EV_RECV: "recv",
@@ -143,6 +149,8 @@ EV_NAMES = {
     EV_MEM_LEAK: "mem.retention_leak",
     EV_MEM_RSS: "mem.rss",
     EV_MEM_DUMP: "mem.oom_dump",
+    EV_COLL_BEGIN: "coll.begin",
+    EV_COLL_END: "coll.end",
 }
 
 # ---------------------------------------------------------------------- #

@@ -1,4 +1,5 @@
-"""Make JAX platform env vars effective under a pre-registered plugin.
+"""Make JAX platform env vars effective under a pre-registered plugin,
+and paper over cross-version JAX API moves the mesh plane depends on.
 
 In some deployments a site hook imports jax at interpreter startup and
 force-registers an accelerator plugin, which wins over ``JAX_PLATFORMS`` /
@@ -7,6 +8,14 @@ effect as long as no backend has been initialized, so subprocess entry
 points (the tier-2 battery, spawned cluster processes) call this first to
 restore the env vars' intent.  No-op when the env vars are unset — a bench
 run on real TPU hardware is untouched.
+
+:func:`shard_map` is the version-portable entry every ``parallel/`` and
+model module routes through: newer jax exposes ``jax.shard_map`` with a
+``check_vma`` kwarg, older releases only
+``jax.experimental.shard_map.shard_map`` with the same knob spelled
+``check_rep``. Without the shim every mesh collective (and the whole
+1->2->4->8 scale harness judging them) import-errors on the older
+runtime this box ships.
 """
 
 from __future__ import annotations
@@ -15,20 +24,89 @@ import os
 import re
 
 
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+    """``jax.shard_map`` where it exists, else the ``jax.experimental``
+    spelling with ``check_vma`` translated to ``check_rep``. Positional
+    ``f`` first, everything else keyword — the exact call shape every
+    in-repo site (and ``functools.partial`` decorator use) relies on."""
+    import jax
+
+    native = getattr(jax, "shard_map", None)
+    if native is not None:
+        if check_vma is not None:
+            kw["check_vma"] = check_vma
+        return native(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as legacy
+    if check_vma is not None:
+        kw["check_rep"] = check_vma
+    return legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  **kw)
+
+
+def axis_size(name) -> int:
+    """Size of a named mesh axis from inside ``shard_map`` —
+    ``jax.lax.axis_size`` where it exists; on older releases
+    ``jax.core.axis_frame(name)`` already resolves to the bound size.
+    Always a Python int (static), so shard-local chunk math stays
+    shape-stable."""
+    import jax
+
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(name)
+    frame = jax.core.axis_frame(name)
+    return int(getattr(frame, "size", frame))
+
+
 def force_cpu_mesh(n_devices: int = 8) -> bool:
     """Point JAX at an n-device virtual CPU mesh (the test/dryrun fixture:
     SURVEY §4's "mpirun -np N on one host" analogue). Returns False (instead
     of raising) if a backend is already live — callers honoring an explicit
-    user request should surface that."""
+    user request should surface that.
+
+    Two spellings: the ``jax_num_cpu_devices`` config option where it
+    exists, else ``XLA_FLAGS --xla_force_host_platform_device_count``
+    (the one every jax release honors — skipping it silently left a
+    1-device mesh under every 8-shard test). The XLA_FLAGS spelling is
+    applied to ``os.environ`` only long enough to initialize THIS
+    process's backend, then restored: a leaked export turned every
+    test-spawned bench worker into an unasked-for 8-virtual-device
+    process, silently flipping their big shards into multi-device
+    local sharding (whose concurrent collective applies can wedge the
+    XLA-CPU rendezvous — see tools/bench_scale.py)."""
     import jax
 
     try:
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", n_devices)
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        return True
     except (RuntimeError, AttributeError):
         return False
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+        return True
+    except (RuntimeError, AttributeError):
+        pass   # old jax: the XLA_FLAGS spelling below carries the intent
+    prior = os.environ.get("XLA_FLAGS")
+    flags = prior or ""
+    want = f"--xla_force_host_platform_device_count={n_devices}"
+    if "xla_force_host_platform_device_count" in flags:
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+",
+                       want, flags)
+    else:
+        flags = (flags + " " + want).strip()
+    os.environ["XLA_FLAGS"] = flags
+    try:
+        # touching the device list initializes the backend NOW, while
+        # the flag is visible; after this the env can be restored
+        return len(jax.devices()) >= n_devices
+    except RuntimeError:
+        return False
+    finally:
+        if prior is None:
+            os.environ.pop("XLA_FLAGS", None)
+        else:
+            os.environ["XLA_FLAGS"] = prior
 
 
 def apply_platform_env() -> None:

@@ -70,12 +70,18 @@ class Zoo:
         # flag-gated metrics exporter (both no-ops unless configured; a
         # PSService starting later upgrades the exporter's payload with
         # its shard registry)
+        from multiverso_tpu.telemetry import devstats as _devstats
         from multiverso_tpu.telemetry import exporter as _exporter
         from multiverso_tpu.telemetry import flightrec as _flightrec
         from multiverso_tpu.telemetry import profiler as _profiler
         from multiverso_tpu.telemetry import trace as _trace
         _trace.configure(self.rank())
         _profiler.configure(self.rank())
+        # device plane: adopt the devstats flag and key compiles with
+        # no explicit scope to THIS mesh's shape (the default label a
+        # recompile is attributed to when nothing narrower is active)
+        _devstats.configure(self.rank())
+        _devstats.set_default_mesh(self._mesh)
         _exporter.ensure_started(self.rank())
         # flight-recorder plane: pin the rank, give the structured log
         # sink the same rank, and dump the black box if a fault signal

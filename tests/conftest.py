@@ -106,6 +106,12 @@ def _fresh_runtime():
     # import-time module gauges (checkpoint.py) register only once.
     from multiverso_tpu.telemetry import memstats as _memstats
     _memstats.reset()
+    # device plane: drop transfer/collective/compile counters and the
+    # hygiene report (a test's synthetic SPMD warning must not dirty a
+    # neighbor's clean-report assertion); the jax listener stays (it
+    # re-reads enabled) and reset() restores the default-on gate
+    from multiverso_tpu.telemetry import devstats as _devstats
+    _devstats.reset()
     # flight-recorder plane: drop the ring/in-flight table and stop the
     # watchdog so one test's wedged ops can't trip a neighbor's verdict;
     # unpin the logger's rank stamp too (first-caller-wins, like the

@@ -47,6 +47,18 @@ def main():
     # assertion below then proves the whole cluster-observability plane
     # is free at the PR-2 latency floor
     config.set_flag("stats_poll_interval_s", 1.0)
+    # ISSUE 12 acceptance config: the device-plane gauge set (transfer
+    # chokepoint, collective spans, mesh-keyed compile listener) is
+    # default-ON like the flight recorder — assert it is actually live
+    # while the band below is measured, so the devstats plane is proven
+    # free at the PR-2 latency floor (every aggregator poll also pulls
+    # its MSG_STATS "devices" snapshot through stats_payload)
+    from multiverso_tpu.telemetry import devstats
+    devstats.configure(0)
+    if not devstats.enabled():
+        raise AssertionError(
+            "devstats default-on gate is off: the band below would be "
+            "measured without the device-observability plane")
     # ISSUE 10 acceptance config: the byte LEDGER is always on, and the
     # memstats sampler (host RSS + jax.live_arrays device census +
     # verdict sweep) runs live at 1 Hz while the timed loops measure —
@@ -185,6 +197,7 @@ def main():
         latency_hist=hist, parity_bit_for_bit=parity,
         flightrec_band_ms=list(flightrec_band),
         memstats_samples=mem_samples, memory=mem,
+        devstats_live=devstats.enabled(),
         cluster=cluster)), flush=True)
 
 

@@ -175,6 +175,132 @@ def stats_surface_findings(
     return findings
 
 
+# ---------------------------------------------------------------------- #
+# collective-coverage rule (lint 4): device-plane ops must record
+# ---------------------------------------------------------------------- #
+# (file, mode): "all" = every public top-level function is a collective
+# entry point and must record; "shard_map" = only public functions that
+# dispatch through the mesh (call _shard_map/shard_map) must. moe.py /
+# pipeline.py / worker_map.py join this table when they grow spans.
+_COLLECTIVE_SOURCES = (
+    ("multiverso_tpu/parallel/collectives.py", "all"),
+    ("multiverso_tpu/parallel/ring.py", "shard_map"),
+    ("multiverso_tpu/parallel/tp.py", "shard_map"),
+)
+# a function "records" when its body calls one of these devstats sites
+_RECORDING_CALLS = frozenset({"collective_span", "note_transfer"})
+
+
+def _called_names(node: ast.AST) -> set:
+    out = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            fn = sub.func
+            if isinstance(fn, ast.Attribute):
+                out.add(fn.attr)
+            elif isinstance(fn, ast.Name):
+                out.add(fn.id)
+    return out
+
+
+def collective_coverage_findings(
+        sources=_COLLECTIVE_SOURCES,
+        source_text: Dict[str, str] = None) -> List[str]:
+    """Lint 4: every collective entry point in ``parallel/`` must wrap
+    its dispatch in ``devstats.collective_span`` (or count through
+    ``note_transfer``) — the exact MSG_SNAPSHOT crack for the device
+    plane: a new collective op shipping with no span is invisible to
+    mvtop/flightrec/the scale harness. ``source_text`` injects
+    {rel_path: source} so tests can prove the rule catches a dark op."""
+    findings = []
+    for rel, mode in sources:
+        if source_text is not None and rel in source_text:
+            src = source_text[rel]
+        else:
+            with open(os.path.join(_REPO, rel)) as f:
+                src = f.read()
+        for node in ast.parse(src).body:
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) \
+                    or node.name.startswith("_"):
+                continue
+            calls = _called_names(node)
+            if mode == "shard_map" \
+                    and not calls & {"_shard_map", "shard_map"}:
+                continue   # host-side helper, not a mesh dispatch
+            if not calls & _RECORDING_CALLS:
+                findings.append(
+                    f"collective {rel}:{node.name}(): dispatches on the "
+                    "mesh with no devstats recording site — wrap the "
+                    "dispatch in devstats.collective_span (or count it "
+                    "via note_transfer) so the op cannot ship dark")
+    return findings
+
+
+# ---------------------------------------------------------------------- #
+# regression-key rule (lint 5): every tracked bench key has a producer
+# ---------------------------------------------------------------------- #
+def regression_paths(repo: str = _REPO) -> List[tuple]:
+    """The extra.* paths ``tools/run_bench.py`` compares run-over-run,
+    read from its ``_REGRESSION_KEYS`` / ``_REGRESSION_KEYS_HIGHER``
+    tables by ast (no import: run_bench pulls in bench.py, which this
+    jax-free lint must not)."""
+    with open(os.path.join(repo, "tools", "run_bench.py")) as f:
+        tree = ast.parse(f.read())
+    paths: List[tuple] = []
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        names = {t.id for t in node.targets
+                 if isinstance(t, ast.Name)}
+        if not names & {"_REGRESSION_KEYS", "_REGRESSION_KEYS_HIGHER"}:
+            continue
+        for path, _label in ast.literal_eval(node.value):
+            paths.append(tuple(path))
+    return paths
+
+
+def regression_key_findings(paths=None,
+                            producer_text: str = None) -> List[str]:
+    """Lint 5: every component of every run_bench regression path must
+    appear QUOTED in a producer source (bench.py or a tools/bench_*.py)
+    — a bench key renamed without updating run_bench leaves the old
+    path in the comparison tables matching nothing, silently disarming
+    its regression flag forever. Injectable for the catches-a-disarmed-
+    key test."""
+    if paths is None:
+        paths = regression_paths()
+    if producer_text is None:
+        producer_text = ""
+        # bench.py + every tools/bench_*.py worker, plus the library
+        # modules bench.py delegates whole extra blocks to (memstats.
+        # bench_extra writes extra.memory's keys)
+        with open(os.path.join(_REPO, "bench.py")) as f:
+            producer_text += f.read()
+        with open(os.path.join(
+                _REPO, "multiverso_tpu", "telemetry",
+                "memstats.py")) as f:
+            producer_text += f.read()
+        tdir = os.path.join(_REPO, "tools")
+        for fn in sorted(os.listdir(tdir)):
+            if fn.startswith("bench_") and fn.endswith(".py"):
+                with open(os.path.join(tdir, fn)) as f:
+                    producer_text += f.read()
+    findings = []
+    for path in paths:
+        missing = [k for k in path
+                   if f'"{k}"' not in producer_text
+                   and f"'{k}'" not in producer_text]
+        if missing:
+            findings.append(
+                f"regression key extra.{'.'.join(path)} "
+                f"(tools/run_bench.py): component(s) {missing} never "
+                "produced by bench.py or any tools/bench_*.py — the "
+                "run-over-run flag is disarmed; rename the table entry "
+                "or restore the producer")
+    return findings
+
+
 def check() -> List[str]:
     """All findings as human-readable strings ([] = clean)."""
     findings: List[str] = []
@@ -209,6 +335,8 @@ def check() -> List[str]:
                 f"flag {flag!r}: not mentioned in docs/TUNING.md — add "
                 "a knob row (or a wiring-flags table entry)")
     findings.extend(stats_surface_findings())
+    findings.extend(collective_coverage_findings())
+    findings.extend(regression_key_findings())
     return findings
 
 

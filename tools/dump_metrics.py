@@ -126,6 +126,48 @@ def _memory_lines(mem: Dict) -> List[str]:
     return lines
 
 
+def _devices_lines(dev: Dict) -> List[str]:
+    """Per-rank MSG_STATS ``devices`` block (telemetry/devstats.py) ->
+    transfer/collective/compile tables. Shared by per-rank and cluster
+    shows; every field is optional — an older peer's payload without
+    the block never reaches here, and a partial block renders what it
+    has."""
+    lines = []
+    tr = dev.get("transfers") or {}
+    if tr:
+        lines.append("devices.transfers: " + "  ".join(
+            f"{d}={_mb((g or {}).get('bytes'))} MB"
+            f"/{(g or {}).get('ops', 0)} ops"
+            for d, g in sorted(tr.items())))
+    colls = dev.get("collectives") or {}
+    if colls:
+        lines.append(f"  {'collective':<24} {'calls':>7} {'mb':>9} "
+                     f"{'ms':>9}")
+        for op in sorted(colls):
+            c = colls[op]
+            if not isinstance(c, dict):
+                continue
+            lines.append(f"  {op:<24} {c.get('calls', 0):>7} "
+                         f"{_mb(c.get('bytes')):>9} "
+                         f"{c.get('ms', 0):>9}")
+    comp = dev.get("compiles_by_mesh") or {}
+    if comp:
+        lines.append("  compiles by mesh: " + "  ".join(
+            f"{label}={c.get('compiles', 0)}"
+            f"/{c.get('compile_s', 0)}s"
+            for label, c in sorted(comp.items())
+            if isinstance(c, dict)))
+    per = dev.get("per_device") or {}
+    if per:
+        lines.append("  live buffers: " + "  ".join(
+            f"{d}={_mb(g.get('bytes'))} MB/{g.get('arrays', 0)}"
+            for d, g in sorted(per.items()) if isinstance(g, dict)))
+    if dev.get("hygiene_findings"):
+        lines.append(f"  HYGIENE FINDINGS: {dev['hygiene_findings']} "
+                     "(see compile-hygiene-rank*.json / mvprof)")
+    return lines
+
+
 def format_record(rec: Dict) -> str:
     """One record -> the human table (pure function; tested directly).
     Cluster records (``kind: "cluster"``) dispatch to
@@ -167,6 +209,9 @@ def format_record(rec: Dict) -> str:
     mem = rec.get("memory")
     if isinstance(mem, dict):
         lines.extend(_memory_lines(mem))
+    dev = rec.get("devices")
+    if isinstance(dev, dict):
+        lines.extend(_devices_lines(dev))
     for name in sorted(rec.get("notes", {})):
         lines.append(f"note[{name}] {rec['notes'][name]}")
     return "\n".join(lines)
@@ -286,6 +331,16 @@ def format_cluster_record(rec: Dict) -> str:
             lines.append(f"  memory@rank{r}: " + ", ".join(
                 f"{k}={v}" for k, v in sorted(e.items())
                 if v not in (None, [])))
+    dev = rec.get("devices")
+    if isinstance(dev, dict):
+        t = dev.get("totals", {})
+        if t:
+            lines.append("devices(cluster): " + ", ".join(
+                f"{k}={v}" for k, v in sorted(t.items())))
+        for r in sorted(dev.get("ranks", {}), key=str):
+            d = dev["ranks"][r]
+            if isinstance(d, dict):
+                lines.extend("  " + ln for ln in _devices_lines(d))
     for tname in sorted(rec.get("hotkeys", {})):
         h = rec["hotkeys"][tname]
         head = "  ".join(f"{k}:{c}" for k, c, _ in h.get("top", [])[:8])
@@ -398,6 +453,61 @@ def diff_memory(ma: Optional[Dict], mb: Optional[Dict]) -> List[str]:
     return lines if len(lines) > 1 else []
 
 
+def is_history_record(rec: Dict) -> bool:
+    """BENCH_HISTORY.jsonl entries (tools/run_bench.py history_entry):
+    the trajectory index a run appends one line to per recorded run."""
+    return isinstance(rec, dict) and "record" in rec \
+        and "metrics" in rec and "regressions" in rec
+
+
+def format_history_records(records: List[Dict],
+                           last: int = 20) -> str:
+    """The bench trajectory as one table: per run the headline value,
+    completeness, flag count, and every run_bench-tracked metric that
+    moved — the arc BENCH_r*.json mtime-globbing used to be the only
+    way to reconstruct."""
+    rows = records[-last:]
+    lines = [f"{'#':>3} {'record':<20} {'complete':>8} {'value':>10} "
+             f"{'vs_base':>8} {'flags':>5}  tracked metrics"]
+    base = len(records) - len(rows)
+    for i, r in enumerate(rows):
+        mets = r.get("metrics") or {}
+        brief = "  ".join(f"{k}={v}" for k, v in sorted(mets.items())[:4])
+        if len(mets) > 4:
+            brief += f"  (+{len(mets) - 4} more)"
+        lines.append(
+            f"{base + i:>3} {str(r.get('record'))[:20]:<20} "
+            f"{'yes' if r.get('complete') else ('TRUNC' if r.get('truncated') else 'no'):>8} "
+            f"{r.get('value') if r.get('value') is not None else '-':>10} "
+            f"{r.get('vs_baseline') if r.get('vs_baseline') is not None else '-':>8} "
+            f"{len(r.get('regressions') or []):>5}  {brief}")
+        for flag in (r.get("regressions") or [])[:3]:
+            lines.append(f"      FLAG: {flag}")
+    return "\n".join(lines)
+
+
+def diff_history_records(a: Dict, b: Dict) -> str:
+    """Two trajectory entries (default: the last two) -> every tracked
+    metric's movement, b relative to a."""
+    ma, mb = a.get("metrics") or {}, b.get("metrics") or {}
+    lines = [f"{a.get('record')} -> {b.get('record')}",
+             f"{'metric':<40} {'a':>12} {'b':>12} {'b/a':>7}"]
+    for k in sorted(set(ma) | set(mb)):
+        va, vb = ma.get(k), mb.get(k)
+        if va is None or vb is None:
+            lines.append(f"{k:<40} "
+                         f"{'-' if va is None else va:>12} "
+                         f"{'-' if vb is None else vb:>12} "
+                         f"{'only ' + ('b' if va is None else 'a'):>7}")
+            continue
+        ratio = f"{vb / va:>7.2f}" if va else f"{'-':>7}"
+        lines.append(f"{k:<40} {va:>12} {vb:>12} {ratio}")
+    for side, r in (("a", a), ("b", b)):
+        for flag in (r.get("regressions") or []):
+            lines.append(f"  {side} FLAG: {flag}")
+    return "\n".join(lines)
+
+
 def to_perfetto(trace_jsonl: str, out_path: str) -> int:
     """JSONL trace events -> Perfetto/chrome JSON envelope; returns the
     event count."""
@@ -426,6 +536,11 @@ def main(argv: List[str]) -> int:
                 records = [records[idx]]
             print(format_profile_records(records))
             return 0
+        if is_history_record(records[-1]):
+            # BENCH_HISTORY.jsonl: the whole trajectory IS the show
+            print(format_history_records(
+                records if idx is None else records[: idx + 1]))
+            return 0
         print(format_record(pick_record(records, idx)))
         return 0
     if cmd == "diff":
@@ -433,6 +548,15 @@ def main(argv: List[str]) -> int:
         if (ra[-1].get("kind") == "step"
                 and rb[-1].get("kind") == "step"):
             print(diff_profile_records(ra, rb))
+            return 0
+        if is_history_record(ra[-1]) and is_history_record(rb[-1]):
+            # diffing a history file against itself compares the last
+            # two runs of the trajectory; two files compare their tails
+            if rest[0] == rest[1] and len(ra) >= 2:
+                print(diff_history_records(ra[-2], ra[-1]))
+            else:
+                print(diff_history_records(pick_record(ra),
+                                           pick_record(rb)))
             return 0
         print(diff_records(pick_record(ra), pick_record(rb)))
         return 0

@@ -85,6 +85,52 @@ def collect(paths: List[str]) -> Tuple[List[Dict], List[Dict]]:
     return steps, spans
 
 
+def collect_hygiene(paths: List[str]) -> List[Dict]:
+    """SPMD compile-hygiene reports (``compile-hygiene-rank<r>.json``,
+    written by ``devstats.dump_hygiene`` — tools/bench_scale.py dumps
+    one per run) from directories and/or explicit files."""
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files += sorted(glob.glob(
+                os.path.join(p, "compile-hygiene-rank*.json")))
+        elif "compile-hygiene" in os.path.basename(p):
+            files.append(p)
+    out: List[Dict] = []
+    for f in files:
+        try:
+            with open(f) as fh:
+                rep = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if isinstance(rep, dict) and "findings" in rep:
+            rep.setdefault("_file", os.path.basename(f))
+            out.append(rep)
+    return out
+
+
+def render_hygiene(reports: List[Dict]) -> str:
+    """Compile-hygiene section: per rank the checked-scope log and
+    every classified SPMD finding (clean reports say so explicitly —
+    a silent section reads as 'not checked', which is the opposite)."""
+    lines = []
+    for rep in reports:
+        head = (f"compile hygiene rank {rep.get('rank', '?')}: "
+                + ("CLEAN" if rep.get("clean") else
+                   f"{len(rep.get('findings') or [])} FINDING(S)")
+                + f"  ({len(rep.get('checked') or [])} scoped compiles)")
+        lines.append(head)
+        for c in rep.get("checked") or []:
+            lines.append(f"  checked {c.get('fn')} @ {c.get('mesh')}: "
+                         f"{c.get('captured', 0)} captured, "
+                         f"{c.get('findings', 0)} classified")
+        for e in rep.get("findings") or []:
+            lines.append(f"  FINDING [{e.get('category')}] "
+                         f"{e.get('fn')} @ {e.get('mesh')}: "
+                         f"{e.get('message')}")
+    return "\n".join(lines)
+
+
 # ---------------------------------------------------------------------- #
 # report
 # ---------------------------------------------------------------------- #
@@ -248,20 +294,38 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     steps, spans = collect(args.paths)
-    if not steps:
+    hygiene = collect_hygiene(args.paths)
+    if not steps and not hygiene:
         print("mvprof: no step records found (is step_profile on and "
               "metrics_dir set?)", file=sys.stderr)
         return 1
     did = False
     if args.to_perfetto:
+        if not steps:
+            # an explicitly requested export must fail loudly, not
+            # exit 0 with the output file silently never written
+            print("mvprof: --to-perfetto needs step records; the "
+                  "given paths hold only compile-hygiene reports",
+                  file=sys.stderr)
+            return 1
         env = to_perfetto(steps, spans, args.to_perfetto)
         print(f"wrote {len(env['traceEvents'])} events "
               f"({len(steps)} steps, {len(spans)} trace spans) to "
               f"{args.to_perfetto}")
         did = True
     if args.report or args.json or not did:
-        print(json.dumps(report_data(steps)) if args.json
-              else render_report(steps, args.steps))
+        if args.json:
+            data = report_data(steps) if steps else {}
+            if hygiene:
+                data["hygiene"] = hygiene
+            print(json.dumps(data))
+        else:
+            parts = []
+            if steps:
+                parts.append(render_report(steps, args.steps))
+            if hygiene:
+                parts.append(render_hygiene(hygiene))
+            print("\n\n".join(parts))
     return 0
 
 

@@ -173,6 +173,52 @@ def render(rec: Dict, prev: Optional[Dict] = None,
                 f"{_mmb(e.get('table_bytes')):>9} "
                 f"{_mmb(e.get('retained_bytes')):>12} "
                 f"{_fmt(e.get('pinned_epochs')):>5} {vd:<20}")
+    # device panel (telemetry/devstats.py, MSG_STATS "devices" block):
+    # per-rank host<->device transfer bytes, collective calls/bytes,
+    # mesh-keyed compiles, per-device live bytes, and SPMD hygiene
+    # findings. The block is ADDITIVE — a rank whose payload lacks it
+    # (an older peer in a mixed-version cluster, or no device activity)
+    # renders "-", never a KeyError.
+    dev = rec.get("devices")
+    if dev:
+
+        def _dmb(v):
+            return "-" if not isinstance(v, (int, float)) \
+                else f"{v / 1e6:.2f}"
+
+        t = dev.get("totals", {})
+        lines.append("")
+        lines.append(
+            f"devices: h2d {_dmb(t.get('h2d_bytes'))} MB"
+            f"  d2h {_dmb(t.get('d2h_bytes'))} MB"
+            f"  coll {t.get('coll_calls', 0)} calls"
+            f"/{_dmb(t.get('coll_bytes'))} MB"
+            f"  compiles {t.get('compiles', 0)}"
+            f" ({_fmt(t.get('compile_s'), 2)} s)"
+            f"  live {_dmb(t.get('device_bytes'))} MB"
+            + (f"  HYGIENE FINDINGS {t['hygiene_findings']}"
+               if t.get("hygiene_findings") else ""))
+        lines.append(f"  {'rank':<5} {'h2d_mb':>8} {'d2h_mb':>8} "
+                     f"{'coll':>6} {'coll_mb':>8} {'compiles':>8} "
+                     f"{'mesh shapes':<28}")
+        for r in sorted(dev.get("ranks", {}), key=str):
+            d = dev["ranks"][r]
+            tr = d.get("transfers") or {}
+            colls = d.get("collectives") or {}
+            comp = d.get("compiles_by_mesh") or {}
+            lines.append(
+                f"  {r:<5} "
+                f"{_dmb((tr.get('h2d') or {}).get('bytes')):>8} "
+                f"{_dmb((tr.get('d2h') or {}).get('bytes')):>8} "
+                f"{sum(int(c.get('calls') or 0) for c in colls.values() if isinstance(c, dict)):>6} "
+                f"{_dmb(sum(int(c.get('bytes') or 0) for c in colls.values() if isinstance(c, dict))):>8} "
+                f"{sum(int(c.get('compiles') or 0) for c in comp.values() if isinstance(c, dict)):>8} "
+                f"{','.join(sorted(comp)) or '-':<28}")
+            ops = {op: c.get("calls") for op, c in sorted(colls.items())
+                   if isinstance(c, dict)}
+            if ops:
+                lines.append("        coll ops: " + "  ".join(
+                    f"{op}:{n}" for op, n in ops.items()))
     mons = rec.get("monitors", {})
     rates = rec.get("rates", {})
     serving = rec.get("serving", {})

@@ -22,6 +22,7 @@ import os
 import subprocess
 import sys
 import time
+from typing import Optional
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
@@ -112,6 +113,13 @@ _REGRESSION_KEYS_HIGHER = (
     # to serial prepare (or the training cache going cold), exactly the
     # regression the pipelined path was built to close
     (("we", "words_per_s"), "WE async words/s"),
+    # mesh scale curve (ISSUE 12, tools/bench_scale.py): the weakest
+    # E_n = T_n/(n*T_1) point of the 1->2->4->8 shard curve, and the
+    # single-shard baseline itself. A drop in efficiency_min with t1
+    # holding is a SCALING regression — per-shard cost growing with the
+    # shard count — invisible to every single-rank latency key above
+    (("scale", "efficiency_min"), "mesh scaling efficiency (min E_n)"),
+    (("scale", "t1_rows_per_s"), "mesh scale single-shard baseline"),
 )
 
 
@@ -220,6 +228,40 @@ def flag_regressions(prev_headline, new_headline, factor: float = 2.0):
     return out
 
 
+def history_entry(rec, out_path: str, ts: Optional[float] = None):
+    """One compact BENCH_HISTORY.jsonl line from a recorded run (pure;
+    tested without spawning the bench). The trajectory index exists
+    because the bench trajectory was otherwise unreconstructable
+    without globbing BENCH_r*.json by mtime: each run appends its
+    headline value, verdicts, and every run_bench-tracked metric that
+    was present, so `dump_metrics show BENCH_HISTORY.jsonl` renders the
+    whole arc in one table."""
+    headline = rec.get("headline") or {}
+    metrics = {}
+    for path, _label in (*_REGRESSION_KEYS, *_REGRESSION_KEYS_HIGHER):
+        v = _extra_value(headline, path)
+        if v is not None:
+            metrics[".".join(path)] = v
+    return {
+        "ts": round(time.time() if ts is None else ts, 3),
+        "record": os.path.basename(out_path),
+        "complete": bool(rec.get("complete")),
+        "truncated": bool(rec.get("truncated")),
+        "value": headline.get("value"),
+        "unit": headline.get("unit"),
+        "vs_baseline": headline.get("vs_baseline"),
+        "regressions": list(rec.get("regressions") or []),
+        "metrics": metrics,
+    }
+
+
+def append_history(entry, history_path: str) -> None:
+    """Append one entry to the trajectory index (one JSON object per
+    line; the file is append-only — history is never rewritten)."""
+    with open(history_path, "a") as f:
+        f.write(json.dumps(entry) + "\n")
+
+
 def collect_flightrec_dumps(directory: str, since: float = 0.0):
     """Dump files under a run's flight-recorder directory (basenames;
     [] when the directory never materialized — no dump was written).
@@ -293,6 +335,15 @@ def main(argv) -> int:
         sys.stderr.write(f"REGRESSION FLAG: {r}\n")
     with open(out_path, "w") as f:
         json.dump(rec, f, indent=1)
+    # trajectory index: one append-only line per run beside the record,
+    # so the bench arc is reconstructable without globbing BENCH_r*.json
+    # by mtime (dump_metrics show/diff render it)
+    try:
+        append_history(history_entry(rec, out_path),
+                       os.path.join(os.path.dirname(out_path) or ".",
+                                    "BENCH_HISTORY.jsonl"))
+    except OSError as e:
+        sys.stderr.write(f"BENCH_HISTORY append failed: {e}\n")
     print(json.dumps({"recorded": os.path.relpath(out_path, _REPO),
                       "truncated": rec["truncated"],
                       "complete": rec["complete"],
