@@ -567,13 +567,15 @@ def bench_scale_curve(seconds: float = 3.0, shards: str = "1,2,4,8"):
 
 
 def bench_chaos_failover(seconds: float = 16.0):
-    """Elastic-failover chaos bench (ISSUE 7 acceptance): 2 server
-    shards under sustained windowed add/get traffic, SIGKILL one, and
-    record recovery-time-to-90%-throughput plus the exactly-once
-    ledger (ops lost / double-applied, final state bit-for-bit vs the
-    acked-op oracle). The tool exits nonzero — failing this sub-bench
-    — if any acked op was lost or double-applied."""
-    return _run_result_worker("bench_chaos.py", [seconds], timeout=420)
+    """Chaos scenario matrix (ISSUE 7 → ISSUE 14): partition-heal,
+    dup+reorder under replay, slow-shard shed, replica kill, and the
+    combined shard-SIGKILL + replica-kill storm — each with in-run
+    gates (exactly-once ledger vs the acked-op oracle, staleness
+    bound never exceeded on a served read, recovery-to-90%) and a
+    per-scenario ``recovery_s`` under ``extra.chaos.scenarios`` that
+    run_bench trend-tracks. The tool exits nonzero — failing this
+    sub-bench — when any scenario's gate fails."""
+    return _run_result_worker("bench_chaos.py", [seconds], timeout=900)
 
 
 def bench_array_table_nontunnel(size: int = 1_000_000, iters: int = 10):

@@ -467,8 +467,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     if procid >= 0:  # child of _spawn_cluster
         import jax
 
-        from multiverso_tpu.utils.platform import force_cpu_mesh
+        from multiverso_tpu.utils.platform import (enable_cpu_collectives,
+                                                   force_cpu_mesh)
         force_cpu_mesh(config.get_flag("local_devices"))
+        enable_cpu_collectives()   # gloo: cross-process CPU computations
         try:
             jax.distributed.initialize(
                 coordinator_address=config.get_flag("coordinator"),

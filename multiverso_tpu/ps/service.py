@@ -47,6 +47,13 @@ from multiverso_tpu.ps import wire
 # their stats_poll_interval_s / failover_* flags are registered before
 # any Zoo.start/argv parse reads them
 from multiverso_tpu.ps import failover as _failover
+# fault-injection wire plane (ISSUE 14): module-level so faults_spec /
+# faults_seed register before argv parse AND so the plane is compiled
+# into every build — the acceptance criterion is zero measurable
+# hot-path cost with it present but disarmed (hook sites guard on
+# one `_faults.PLANE.armed` attribute read; faults.py never imports
+# this module at module scope, so no cycle)
+from multiverso_tpu.ps import faults as _faults
 # serving plane (read replicas + admission): module-level for the same
 # reason — its serving_* flags must exist before an argv parse, and its
 # replica registry feeds the MSG_STATS "serving" block below. The
@@ -60,7 +67,7 @@ from multiverso_tpu.telemetry import memstats as _memstats
 from multiverso_tpu.telemetry import profiler as _profiler
 from multiverso_tpu.telemetry import trace as _trace
 from multiverso_tpu.telemetry import watchdog as _watchdog
-from multiverso_tpu.utils import config, log
+from multiverso_tpu.utils import config, log, retry as _retry
 from multiverso_tpu.utils.dashboard import monitor
 
 # message types (request side; replies reuse the id space below 0x100)
@@ -178,6 +185,14 @@ config.define_float("ps_health_timeout", 5.0,
                     "'alive but wedged' in seconds — blocking a "
                     "supervisor's poll loop for 5 minutes against the "
                     "exact rank it is triaging would defeat the probe")
+config.define_int("ps_probe_attempts", 1,
+                  "one-shot probe (MSG_HEALTH/MSG_STATS) attempts per "
+                  "pull, all within ONE ps_health_timeout budget "
+                  "(deadline propagation, utils/retry.py): > 1 rides "
+                  "out a transient connect refusal against a "
+                  "restarting rank instead of classifying it down on "
+                  "the first RST. Default 1 keeps the supervisor's "
+                  "'unreachable IS the answer' fail-fast semantics")
 config.define_float("ps_shutdown_grace", 60.0,
                     "seconds a rank keeps its shards served at shutdown "
                     "while waiting for peers to ALSO reach shutdown (the "
@@ -313,29 +328,40 @@ class _Peer:
     def __init__(self, rank: int, addr: str, connect_timeout: float,
                  io_timeout: float,
                  on_death: Optional[Callable[["_Peer", Exception],
-                                             None]] = None):
+                                             None]] = None,
+                 src: int = -1):
         self.rank = rank
+        self.src = src     # the LOCAL rank (fault-plane src identity;
+        #                    -1 = unknown, plane falls back to its own)
         self.addr = addr   # the resolved incarnation address (native
                            # client conns to the same rank reuse it)
         self._on_death = on_death
         host, port = addr.rsplit(":", 1)
-        deadline = time.monotonic() + connect_timeout
-        last: Optional[Exception] = None
+        # connect retries ride the shared capped-exponential policy
+        # (utils/retry.py) with the connect timeout as the DEADLINE —
+        # the flat 50 ms loop this replaces synchronized every client's
+        # reconnect storm against a respawning rank
+        deadline = _retry.deadline_in(connect_timeout)
+        backoff = _retry.Backoff(base_s=0.05, cap_s=1.0)
+        attempt = 0
         while True:
             try:
                 self._sock = socket.create_connection(
                     (host, int(port)), timeout=connect_timeout)
                 break
             except OSError as e:
-                last = e
-                if time.monotonic() >= deadline:
+                if not backoff.sleep(attempt, deadline):
                     raise PSPeerError(
                         f"cannot connect to rank {rank} at {addr}: {e}"
                     ) from e
-                time.sleep(0.05)
+                attempt += 1
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock.settimeout(io_timeout)
         self._send_lock = threading.Lock()
+        # reorder-injection holdback (chaos plane, ps/faults.py): held
+        # encoded frames, released after a LATER frame ships. Only ever
+        # touched under _send_lock, and only when the plane is armed.
+        self._held: List[bytes] = []
         self._pending: Dict[int, cf.Future] = {}
         self._pending_lock = threading.Lock()
         # msg ids start at a per-INCARNATION base (generation << 32):
@@ -454,7 +480,10 @@ class _Peer:
                              sum(getattr(a, "nbytes", 0) for a in arrays),
                              record=msg_type not in (MSG_PING, MSG_STATS))
             try:
-                wire.send(self._sock, msg_type, msg_id, meta, arrays)
+                if _faults.PLANE.armed:   # chaos plane (off: one load)
+                    self._send_faulted(msg_type, msg_id, meta, arrays)
+                else:
+                    wire.send(self._sock, msg_type, msg_id, meta, arrays)
             except OSError as e:
                 err = PSPeerError(f"rank {self.rank} send failed: {e}")
                 self._dead = err
@@ -493,6 +522,79 @@ class _Peer:
             if still is not None and not fut.done():
                 fut.set_exception(self._dead)
         return fut
+
+    # chaos plane (ps/faults.py; reached ONLY when a scenario is armed
+    # — the hot path's single `PLANE.armed` load guards it)
+    _HELD_CAP = 8   # safety ceiling on the rule's reorder depth
+
+    def _send_faulted(self, msg_type: int, msg_id: int, meta,
+                      arrays) -> None:
+        """One outbound frame through the armed fault plane. Runs under
+        ``_send_lock`` (the caller holds it), so the holdback list and
+        the socket are single-writer here. Injected partitions/resets
+        raise :class:`faults.InjectedFault` (a ConnectionResetError) —
+        the caller's OSError handling then takes the organic peer-death
+        path, which is the point."""
+        plan = _faults.PLANE.plan_send(self.rank, msg_type, msg_id,
+                                       src=self.src)
+        if plan is None:
+            wire.send(self._sock, msg_type, msg_id, meta, arrays)
+            self._release_held()
+            return
+        if plan.delay_s:
+            # a slow wire backpressures senders to this peer exactly
+            # like a real one: the sleep holds the send lock
+            time.sleep(plan.delay_s)
+        if plan.reset:
+            # injected partition/reset: kill the conn FIRST so the recv
+            # loop observes the death (fails in-flight futures, replay
+            # re-arms), then fail this send like the kernel would
+            try:
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            raise _faults.InjectedFault(
+                f"injected {'/'.join(plan.kinds)} to rank {self.rank}")
+        if plan.drop:
+            return   # silently eaten; the caller's timeout is the signal
+        buf = wire.encode(msg_type, msg_id, meta, arrays)
+        if plan.reorder and len(self._held) < min(plan.depth,
+                                                 self._HELD_CAP):
+            self._held.append(buf)   # ships AFTER the next frame...
+            timer = threading.Timer(plan.hold_s, self._release_held,
+                                    kwargs={"locked": False})
+            timer.daemon = True      # ...or after hold_s: a blocking
+            timer.start()            # caller awaiting THIS frame's ack
+            return                   # is its own only traffic source
+        self._sock.sendall(buf)
+        # a reorder-claimed frame never duplicates — even when the
+        # holdback was full and it shipped immediately — so the
+        # plane's injected counts/log match what hit the wire
+        if plan.duplicate and not plan.reorder:
+            self._sock.sendall(buf)
+        self._release_held()
+
+    def _release_held(self, locked: bool = True) -> None:
+        """Flush reorder-held frames (oldest first) now that a later
+        frame has shipped (``locked=True``: caller holds the send
+        lock) or the hold timer fired (``locked=False``). Socket
+        errors are swallowed on BOTH paths — a held frame dying with
+        the conn is just an injected drop, the recv loop owns the
+        death signal, and the CURRENT frame's future (its own send
+        already succeeded) must not be failed by a sibling's
+        corpse."""
+        if not self._held:
+            return
+        if not locked:
+            with self._send_lock:
+                self._release_held()
+            return
+        held, self._held = self._held, []
+        try:
+            for buf in held:
+                self._sock.sendall(buf)
+        except OSError:
+            pass
 
     def close(self) -> None:
         try:
@@ -577,6 +679,10 @@ class PSService:
         _flight.configure(rank)
         _profiler.configure(rank)
         _devstats.configure(rank)
+        # fault plane: adopt the rank; arms from faults_spec /
+        # $MV_FAULTS_SPEC when set (chaos bench workers), else stays
+        # the null object — zero injection codepaths reachable
+        _faults.configure(rank)
         log.set_rank(rank)
         _watchdog.ensure_started()
         # memory sampler (flag memstats_interval_s; the byte LEDGER is
@@ -993,9 +1099,22 @@ class PSService:
                     else self.stats_payload())
         timeout = timeout or config.get_flag("ps_health_timeout")
         addr = self._probe_addr(rank, timeout)
+        # probe retries ride the shared policy (utils/retry.py) inside
+        # ONE overall timeout — deadline propagation: each attempt's
+        # socket budget is the REMAINING triage time, so attempts > 1
+        # rides out a restarting rank's transient RST without ever
+        # holding a supervisor poll past ps_health_timeout
+        deadline = _retry.deadline_in(timeout)
         try:
-            return oneshot_probe(addr, msg_type, timeout,
-                                 config.get_flag("ps_connect_timeout"))
+            return _retry.call_with_retries(
+                lambda: oneshot_probe(
+                    addr, msg_type,
+                    max(_retry.remaining_s(deadline, timeout), 0.05),
+                    config.get_flag("ps_connect_timeout")),
+                attempts=config.get_flag("ps_probe_attempts"),
+                deadline=deadline,
+                retry_on=(OSError, wire.WireError, TimeoutError),
+                backoff=_retry.Backoff(base_s=0.05, cap_s=0.5))
         except (OSError, wire.WireError, TimeoutError) as e:
             raise PSPeerError(
                 f"probe (type 0x{msg_type:X}) to rank {rank} at {addr} "
@@ -1069,6 +1188,17 @@ class PSService:
                         wire.send(conn, MSG_REPLY_OK, msg_id, payload)
                     continue
                 try:
+                    # chaos plane (ps/faults.py): slow-serve sleeps
+                    # before the handler (a slow RANK, not a slow
+                    # wire); drop_reply serves the request but never
+                    # answers — an ack lost after the apply, which the
+                    # client's replay plane must dedupe on retry
+                    drop_reply = False
+                    if _faults.PLANE.armed:
+                        _slow_s, drop_reply = _faults.PLANE.plan_serve(
+                            msg_type, msg_id, rank=self.rank)
+                        if _slow_s:
+                            time.sleep(_slow_s)
                     handler = self._wait_handler(meta["table"])
                     tr = (meta.get(wire.TRACE_META_KEY)
                           if _trace.enabled() else None)
@@ -1088,17 +1218,21 @@ class PSService:
                         # chunk k+1 overlaps chunk k draining into the
                         # socket), closed by the ordinary OK
                         for cmeta, carrays in rarrays.chunks:
-                            with send_lock:
+                            if drop_reply:
+                                continue   # drain the generator, send
+                            with send_lock:  # nothing (injected loss)
                                 wire.send(conn, MSG_REPLY_CHUNK, msg_id,
                                           cmeta, carrays)
                             _flight.record(_flight.EV_GET_CHUNK,
                                            msg_type=msg_type,
                                            msg_id=msg_id)
                         rmeta, rarrays = rarrays.meta, ()
-                    with send_lock:
-                        wire.send(conn, MSG_REPLY_OK, msg_id, rmeta, rarrays)
-                    _flight.record(_flight.EV_REPLY, msg_type=msg_type,
-                                   msg_id=msg_id)
+                    if not drop_reply:
+                        with send_lock:
+                            wire.send(conn, MSG_REPLY_OK, msg_id, rmeta,
+                                      rarrays)
+                        _flight.record(_flight.EV_REPLY,
+                                       msg_type=msg_type, msg_id=msg_id)
                 except Exception as e:  # reply errors, don't kill the conn
                     log.debug("ps handler error: %s", e)
                     if isinstance(e, MemoryError):
@@ -1209,7 +1343,8 @@ class PSService:
                              config.get_flag("ps_connect_timeout"),
                              config.get_flag("ps_timeout"),
                              on_death=lambda p, e, r=rank:
-                                 self._note_death(r, peer=p))
+                                 self._note_death(r, peer=p),
+                             src=self.rank)
             except PSError:
                 # lookup/connect failure: backoff yes, death hooks no —
                 # the rank may simply not be up yet

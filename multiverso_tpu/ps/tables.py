@@ -41,6 +41,7 @@ from multiverso_tpu.telemetry import profiler as _profiler
 from multiverso_tpu.telemetry import trace as ttrace
 from multiverso_tpu.updaters import AddOption
 from multiverso_tpu.utils import config, log
+from multiverso_tpu.utils import retry as _retry
 from multiverso_tpu.utils.dashboard import Dashboard, monitor
 
 
@@ -370,7 +371,7 @@ class _RetainedFrame:
 
     __slots__ = ("owner", "seq", "msg_type", "meta", "arrays", "gfuts",
                  "acked", "needs_send", "created", "attempts",
-                 "retry_since")
+                 "retry_since", "episode_attempts")
 
     def __init__(self, owner: int, seq: int, msg_type: int, meta: Dict,
                  arrays, gfuts):
@@ -387,6 +388,26 @@ class _RetainedFrame:
         # here — a frame acked long ago and re-armed by a late owner
         # death must get the full retry budget, not zero of it
         self.retry_since: Optional[float] = None
+        # failed attempts within the CURRENT episode: the exponent of
+        # the shared capped-exponential backoff (utils/retry.py) —
+        # lifetime `attempts` would punish a frame whose earlier
+        # episode resolved cleanly
+        self.episode_attempts = 0
+
+
+def _replay_backoff() -> "_retry.Backoff":
+    """The replay plane's instance of the shared retry policy: base =
+    ``ps_replay_backoff``, capped at ``ps_replay_backoff_cap`` — early
+    retries against a briefly-unreachable owner stay quick, a long
+    respawn decays to a bounded poll instead of a flat hammer, and the
+    jitter de-synchronizes a fleet of clients re-arming off the same
+    death event. Built per scheduling decision (off the hot path; flag
+    reads stay test-overridable)."""
+    base = config.get_flag("ps_replay_backoff")
+    return _retry.Backoff(
+        base_s=base,
+        cap_s=max(config.get_flag("ps_replay_backoff_cap"), base),
+        jitter=0.25)
 
 
 class _ReplayBuffer:
@@ -907,6 +928,7 @@ class _SendWindow:
             now = time.monotonic()
             if fr.retry_since is None:
                 fr.retry_since = now
+                fr.episode_attempts = 0
             if (now - fr.retry_since
                     <= config.get_flag("ps_replay_timeout")):
                 with rp.lock:
@@ -914,7 +936,14 @@ class _SendWindow:
                         fr.needs_send = True
                         rp.pending_send[fr.owner] = (
                             rp.pending_send.get(fr.owner, 0) + 1)
-                    due = now + config.get_flag("ps_replay_backoff")
+                    # shared capped-exponential policy with deadline
+                    # propagation: the delay never schedules past the
+                    # episode's ps_replay_timeout budget
+                    due = now + _replay_backoff().delay_s(
+                        fr.episode_attempts,
+                        deadline=fr.retry_since
+                        + config.get_flag("ps_replay_timeout"))
+                    fr.episode_attempts += 1
                     cur = rp.next_due.get(fr.owner)
                     if cur is None or due < cur:
                         rp.next_due[fr.owner] = due
@@ -929,6 +958,7 @@ class _SendWindow:
             if exc is None:
                 fr.acked = True
                 fr.retry_since = None
+                fr.episode_attempts = 0
                 if q is not None:
                     self._prune_owner_locked(
                         fr.owner,
@@ -1015,14 +1045,18 @@ class _SendWindow:
                 fr.acked = False
                 if fr.retry_since is None:
                     fr.retry_since = now
+                    fr.episode_attempts = 0
                 if not fr.needs_send:
                     fr.needs_send = True
                     armed += 1
             if armed:
                 rp.pending_send[rank] = (rp.pending_send.get(rank, 0)
                                          + armed)
+            # episode start: the FIRST re-flush is quick (attempt 0 of
+            # the shared policy); subsequent failures grow the delay
+            # per frame in _frame_done
             rp.next_due[rank] = (time.monotonic()
-                                 + config.get_flag("ps_replay_backoff"))
+                                 + _replay_backoff().delay_s(0))
             n = len(q)
         _flight.record(_flight.EV_FAILOVER_REPLAY, peer=rank,
                        note=f"owner died: {n} frames re-armed")

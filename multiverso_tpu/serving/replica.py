@@ -66,6 +66,7 @@ from multiverso_tpu.serving.hotcache import HotRowCache, match_positions
 from multiverso_tpu.telemetry import hotkeys as _hotkeys
 from multiverso_tpu.telemetry import memstats as _memstats
 from multiverso_tpu.utils import config, log
+from multiverso_tpu.utils import retry as _retry
 from multiverso_tpu.utils.dashboard import Dashboard
 
 config.define_float(
@@ -92,10 +93,39 @@ config.define_int(
     "rows per MSG_REPLY_CHUNK sub-frame of a replica snapshot pull; "
     "shards bigger than this stream chunked (decode overlaps the "
     "receive, PR-5 machinery). 0 = never chunk")
+config.define_int(
+    "serving_pull_retries", 2,
+    "attempts per owning shard within one replica snapshot pull "
+    "(utils/retry.py shared backoff, deadline = the pull's own "
+    "ps_timeout budget): a transient shard blip — an injected reset, "
+    "a mid-failover reconnect — retries inside the refresh instead of "
+    "failing the whole cycle and burning a staleness epoch. 1 = the "
+    "pre-ISSUE-14 fail-fast behavior")
+
+
+class BoundUnsatisfiableError(RuntimeError):
+    """The replica's staleness bound cannot be met: repeated fresh
+    pulls each aged past the bound before a read could be served (the
+    pull is slower than the advertised staleness, or the owners are
+    mid-outage). Typed so a :class:`~multiverso_tpu.serving.pool.
+    ReplicaPool` can fail over to a healthy sibling and only surface
+    it when the WHOLE pool is over bound."""
 
 # replica registry for the MSG_STATS "serving" block (weak: a replica's
 # lifetime belongs to its owner, not to telemetry)
 _REPLICAS: "weakref.WeakSet" = weakref.WeakSet()
+# pool snapshot providers (serving/pool.py registers one per pool):
+# zero-arg callables returning {table: merged-pool entry}. A pool's
+# entry REPLACES its member replicas' individual entries — N replicas
+# of one table in one process would otherwise last-write-wins each
+# other in the block. Registered here (not imported from pool.py) so
+# this module never imports pool at module scope.
+_POOL_PROVIDERS: List = []
+
+
+def register_pool_provider(fn) -> None:
+    if fn not in _POOL_PROVIDERS:
+        _POOL_PROVIDERS.append(fn)
 
 # cache reseed cadence, in refresh epochs: pulling the shards' sketch is
 # an extra stats RPC per owner, so it rides every Nth refresh (traffic
@@ -107,12 +137,22 @@ def stats_snapshot() -> Dict[str, Dict]:
     """{table: replica stats} across this process's live replicas —
     the MSG_STATS ``serving`` block (ps/service.stats_payload). Pure
     JSON-safe data; one replica per table expected (the last
-    constructed wins a name collision)."""
+    constructed wins a name collision). Tables served by a
+    :class:`~multiverso_tpu.serving.pool.ReplicaPool` report the
+    pool's MERGED entry instead (summed counters + a ``"pool"``
+    detail block — per-member route share, lag, degraded flag — the
+    aggregator and mvtop's pool panel consume it)."""
     out: Dict[str, Dict] = {}
     for rep in list(_REPLICAS):
         try:
             s = rep.stats()
             out[s["table"]] = s
+        except Exception:   # noqa: BLE001 — telemetry never raises
+            pass
+    for prov in list(_POOL_PROVIDERS):
+        try:
+            for tname, ent in (prov() or {}).items():
+                out[tname] = ent
         except Exception:   # noqa: BLE001 — telemetry never raises
             pass
     return out
@@ -206,6 +246,11 @@ class ReadReplica:
         self._deferred = 0
         self._hits = 0
         self._misses = 0
+        # pull-health counters (the pool's demotion signal): total
+        # failed refresh cycles + the CONSECUTIVE failure streak
+        # (reset by any successful pull)
+        self._pull_failures = 0
+        self._consec_pull_failures = 0
         base = f"table[{self.name}].get"
         self._mon_replica = Dashboard.get(base + ".replica")
         self._mon_shed = Dashboard.get(base + ".shed")
@@ -291,13 +336,32 @@ class ReadReplica:
         blocked on one stale snapshot into K serialized full-table
         pulls against an already-degraded owner. Returns True when
         THIS call pulled."""
+        if self._closed:
+            # a killed/closed replica must not quietly resurrect
+            # itself through a health probe's refresh — the pool's
+            # demotion of it is permanent until a NEW replica exists
+            raise RuntimeError(f"replica[{self.name}] is closed")
         if need_from is None:
             need_from = time.monotonic()
         with self._refresh_lock:
             if self._pulled_at >= need_from:
                 return False   # a satisfying concurrent refresh landed
-            self._pull_once()
+            try:
+                self._pull_once()
+            except Exception:
+                # pull-health bookkeeping for the pool's demotion
+                # logic: a replica whose pulls keep failing is routed
+                # around, not retried into
+                self._pull_failures += 1
+                self._consec_pull_failures += 1
+                raise
+            self._consec_pull_failures = 0
             return True
+
+    def pull_health(self) -> Dict[str, Any]:
+        """(pool surface) total + consecutive failed refresh cycles."""
+        return {"failures": self._pull_failures,
+                "consecutive": self._consec_pull_failures}
 
     def _make_sink(self, buf: np.ndarray):
         """Chunk sink scattering MSG_REPLY_CHUNK sub-frames of one
@@ -327,8 +391,8 @@ class ReadReplica:
         t_wall0 = time.time() if tr is not None else 0.0
         service = self.ctx.service
         chunk = int(config.get_flag("serving_snapshot_chunk_rows"))
-        reqs = []
-        for rank, lo, hi in self._ranges:
+
+        def dispatch(rank, lo, hi):
             meta: Dict[str, Any] = wire_mod.with_trace({
                 "table": self.name,
                 "since": int(self._versions.get(rank, -1)),
@@ -340,15 +404,44 @@ class ReadReplica:
                 sink = self._make_sink(buf)
             fut = service.request(rank, svc.MSG_SNAPSHOT, meta, (),
                                   chunk_sink=sink)
+            return fut, buf
+
+        reqs = []
+        for rank, lo, hi in self._ranges:
+            fut, buf = dispatch(rank, lo, hi)
             reqs.append((rank, lo, hi, fut, buf))
         timeout = config.get_flag("ps_timeout")
+        # shared retry policy (utils/retry.py) with deadline
+        # propagation: the whole pull — every shard's attempts AND the
+        # backoff sleeps between them — fits one ps_timeout budget, so
+        # a transient shard blip (injected reset, mid-failover
+        # reconnect) retries inside the refresh instead of burning a
+        # staleness epoch, while a real outage still fails in bounded
+        # time for _grab_fresh to judge
+        attempts = max(int(config.get_flag("serving_pull_retries")), 1)
+        deadline = _retry.deadline_in(timeout)
+        backoff = _retry.Backoff(base_s=0.05, cap_s=1.0)
         changed: Dict[Tuple[int, int], np.ndarray] = {}
         versions = dict(self._versions)
         gens = dict(self._gens)
         for rank, lo, hi, fut, buf in reqs:
-            rmeta, arrays = svc.await_reply(
-                fut, timeout,
-                f"replica[{self.name}] snapshot from rank {rank}")
+            rmeta = arrays = None
+            for k in range(attempts):
+                try:
+                    rmeta, arrays = svc.await_reply(
+                        fut, max(_retry.remaining_s(deadline, timeout),
+                                 0.05),
+                        f"replica[{self.name}] snapshot from rank "
+                        f"{rank}")
+                    break
+                except svc.PSError:
+                    if k + 1 >= attempts or not backoff.sleep(
+                            k, deadline):
+                        raise
+                    log.debug("replica[%s] snapshot pull from rank %d "
+                              "failed (attempt %d); retrying",
+                              self.name, rank, k + 1)
+                    fut, buf = dispatch(rank, lo, hi)   # fresh request
             versions[rank] = int(rmeta.get("version", -1))
             gens[rank] = int(rmeta.get("gen", 0))
             if rmeta.get("unchanged"):
@@ -525,8 +618,10 @@ class ReadReplica:
         # three fresh pulls each aged past the bound before serving:
         # the pull itself is slower than the advertised staleness, so
         # the bound is unsatisfiable as configured — refuse loudly
-        # rather than quietly violate the contract
-        raise RuntimeError(
+        # rather than quietly violate the contract. Typed: a
+        # ReplicaPool catches this, fails over to a healthy sibling,
+        # and re-raises only when the WHOLE pool is over bound
+        raise BoundUnsatisfiableError(
             f"replica[{self.name}]: staleness bound {self.staleness_s}s "
             f"is below the snapshot pull time "
             f"({self._last_refresh_ms:.1f} ms) — raise "
@@ -545,6 +640,10 @@ class ReadReplica:
         served snapshot measured atomically with the buffer grab — the
         bench's staleness evidence."""
         t0 = time.perf_counter()
+        if self._closed:
+            # serving off a dead member's last snapshot would mask a
+            # replica kill exactly where the pool needs to observe it
+            raise RuntimeError(f"replica[{self.name}] is closed")
         ids = np.asarray(row_ids, np.int64).reshape(-1)
         if ids.size == 0:
             raise ValueError("empty row_ids")
@@ -601,6 +700,8 @@ class ReadReplica:
             "unchanged_pulls": self._unchanged_pulls,
             "served": self._served, "shed": self._shed,
             "deferred": self._deferred,
+            "pull_failures": self._pull_failures,
+            "pull_failures_consecutive": self._consec_pull_failures,
             "cache_rows": cache_rows,
             "cache_hits": self._hits, "cache_misses": self._misses,
             "cache_hit_rate": (round(self._hits / total, 4)

@@ -246,6 +246,12 @@ def merge_cluster(stats_by_rank: Dict[int, Any],
                 k: rep.get(k) for k in
                 ("epoch", "age_s", "bound_s", "refresh_ms",
                  "cache_rows", "cache_hit_rate")}
+            # ReplicaPool detail (serving/pool.py): passed through per
+            # reporting process — per-member route share / staleness
+            # lag / degraded flag feed mvtop's pool panel
+            if isinstance(rep.get("pool"), dict):
+                ent["replicas"][str(r)]["pool"] = rep["pool"]
+                ent.setdefault("pools", {})[str(r)] = rep["pool"]
             for k in ("served", "shed", "deferred", "cache_hits",
                       "cache_misses"):
                 ent[k] += int(rep.get(k) or 0)

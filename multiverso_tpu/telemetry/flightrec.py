@@ -124,6 +124,14 @@ EV_MEM_DUMP = 37          # OOM forensics dump fired (MemoryError/limit)
 # is visible on the tape like a wedged wire op
 EV_COLL_BEGIN = 38        # collective op dispatched (host side)
 EV_COLL_END = 39          # collective op returned to the caller
+# fault-injection wire plane (ps/faults.py, docs/FAILOVER.md "Chaos
+# scenarios"): every INJECTED fault lands its own event (note carries
+# the kind — drop/delay/duplicate/reorder/partition/reset/slow_serve/
+# drop_reply), so injected and organic faults are distinguishable in
+# tools/postmortem.py timelines; plane arm/disarm/phase transitions
+# mark the scenario's envelope on the same tape
+EV_FAULT_INJECT = 40      # one fault injected into the wire plane
+EV_FAULT_PLANE = 41       # fault plane armed / disarmed / phase flip
 
 EV_NAMES = {
     EV_SEND: "send", EV_ACK: "ack", EV_ERR: "err", EV_RECV: "recv",
@@ -151,6 +159,8 @@ EV_NAMES = {
     EV_MEM_DUMP: "mem.oom_dump",
     EV_COLL_BEGIN: "coll.begin",
     EV_COLL_END: "coll.end",
+    EV_FAULT_INJECT: "fault.inject",
+    EV_FAULT_PLANE: "fault.plane",
 }
 
 # ---------------------------------------------------------------------- #
@@ -168,9 +178,13 @@ MSG_EV_COVERAGE = {
     "MSG_REPLY_ERR": (EV_ERR, EV_REPLY),
     "MSG_REPLY_CHUNK": (EV_GET_CHUNK,),
     "MSG_PING": (),          # probe: excluded from the tape (PR 4)
+    # data opcodes also carry EV_FAULT_INJECT where the chaos plane
+    # (ps/faults.py) can touch them — an injected drop/dup/reorder on
+    # an add frame is part of that opcode's lifecycle on the tape
     "MSG_ADD_ROWS": (EV_SEND, EV_RECV, EV_APPLY, EV_WIN_ENQ,
-                     EV_WIN_FLUSH, EV_WIN_ACK),
-    "MSG_GET_ROWS": (EV_SEND, EV_RECV, EV_GET_SERVE, EV_GET_WIN),
+                     EV_WIN_FLUSH, EV_WIN_ACK, EV_FAULT_INJECT),
+    "MSG_GET_ROWS": (EV_SEND, EV_RECV, EV_GET_SERVE, EV_GET_WIN,
+                     EV_FAULT_INJECT),
     "MSG_SET_ROWS": (EV_SEND, EV_RECV, EV_APPLY),
     "MSG_ADD_FULL": (EV_SEND, EV_RECV, EV_APPLY),
     "MSG_GET_FULL": (EV_SEND, EV_RECV, EV_GET_SERVE),
@@ -178,10 +192,12 @@ MSG_EV_COVERAGE = {
     "MSG_KV_GET": (EV_SEND, EV_RECV, EV_GET_SERVE),
     "MSG_GET_STATE": (EV_SEND, EV_RECV),
     "MSG_SET_STATE": (EV_SEND, EV_RECV),
-    "MSG_BATCH": (EV_SEND, EV_RECV, EV_WAVE, EV_WIN_FLUSH, EV_WIN_ACK),
+    "MSG_BATCH": (EV_SEND, EV_RECV, EV_WAVE, EV_WIN_FLUSH, EV_WIN_ACK,
+                  EV_FAULT_INJECT),
     "MSG_STATS": (),         # probe: excluded from the tape (PR 4)
     "MSG_HEALTH": (),        # probe: excluded from the tape (PR 4)
-    "MSG_SNAPSHOT": (EV_SNAPSHOT_SERVE, EV_REPLICA_PULL),
+    "MSG_SNAPSHOT": (EV_SNAPSHOT_SERVE, EV_REPLICA_PULL,
+                     EV_FAULT_INJECT),
 }
 
 

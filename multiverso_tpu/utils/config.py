@@ -241,8 +241,15 @@ define_float("ps_replay_timeout", 120.0,
              "owner before its futures fail with PSPeerError (bounds "
              "how long a failover may take before clients give up)")
 define_float("ps_replay_backoff", 0.5,
-             "seconds between replay attempts against an owner that is "
-             "still unreachable")
+             "BASE seconds between replay attempts against an owner "
+             "that is still unreachable; each failed attempt within an "
+             "episode doubles the delay (jittered) up to "
+             "ps_replay_backoff_cap — the shared capped-exponential "
+             "retry policy (utils/retry.py)")
+define_float("ps_replay_backoff_cap", 4.0,
+             "cap seconds for the replay plane's exponential backoff: "
+             "a long owner respawn decays to this poll rate instead "
+             "of hammering the restarting rank at the base rate")
 define_int("ps_replay_max_frames", 4096,
            "retained-frame cap per owner: past it the oldest ACKED "
            "frames are dropped (with a warning) — durability degrades "

@@ -109,6 +109,35 @@ def force_cpu_mesh(n_devices: int = 8) -> bool:
             os.environ["XLA_FLAGS"] = prior
 
 
+def enable_cpu_collectives() -> bool:
+    """Make cross-process computations work on the CPU backend.
+
+    jaxlib's XLA:CPU client ships a cross-host collectives
+    implementation (Gloo) but does NOT select it by default: with N
+    coordinated CPU processes, ``jax.distributed.initialize`` succeeds
+    (the coordination service is separate) and then EVERY cross-process
+    computation — ``process_allgather``, ``psum``, the process_sum
+    reducer — fails with ``INVALID_ARGUMENT: Multiprocess computations
+    aren't implemented on the CPU backend``. This was the seed's last
+    standing tier-1 failure (``bench_aggregate`` at np=2; the other 15
+    mesh-env failures fell to the shard_map/axis_size shims in PR 12).
+
+    Selecting gloo via ``jax_cpu_collectives_implementation`` BEFORE
+    the backend initializes fixes it for real. Call this before
+    ``jax.distributed.initialize`` in any multi-process CPU entry
+    point. Returns False (never raises) when the option does not exist
+    (older jax) or the backend is already live — and is a no-op by
+    construction on TPU/GPU paths, where the option is irrelevant.
+    """
+    import jax
+
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        return True
+    except Exception:   # noqa: BLE001 — option missing / backend live
+        return False
+
+
 def apply_platform_env() -> None:
     """Re-apply JAX_PLATFORMS / host-device-count env intent via jax.config."""
     platforms = os.environ.get("JAX_PLATFORMS")

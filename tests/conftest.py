@@ -112,6 +112,10 @@ def _fresh_runtime():
     # re-reads enabled) and reset() restores the default-on gate
     from multiverso_tpu.telemetry import devstats as _devstats
     _devstats.reset()
+    # fault-injection plane (ISSUE 14): disarm — one test's chaos
+    # scenario must not inject into its neighbors' wires
+    from multiverso_tpu.ps import faults as _faults
+    _faults.disarm()
     # flight-recorder plane: drop the ring/in-flight table and stop the
     # watchdog so one test's wedged ops can't trip a neighbor's verdict;
     # unpin the logger's rank stamp too (first-caller-wins, like the

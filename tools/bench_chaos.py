@@ -1,40 +1,51 @@
 #!/usr/bin/env python
-"""Chaos bench: SIGKILL a server shard under sustained windowed traffic
-and measure recovery-time-to-full-throughput + exactly-once parity.
+"""Chaos scenario matrix: prove the robustness planes against the
+fault shapes they claim to survive (ISSUE 14; docs/FAILOVER.md "Chaos
+scenarios").
 
-The PR-4 2-OS-process fault test, promoted to a first-class bench
-(ROADMAP open item 5; docs/FAILOVER.md). Topology:
+PR 7's bench proved ONE fault (SIGKILL a shard). This matrix drives
+the fault-injection wire plane (ps/faults.py) and the replica pool
+(serving/pool.py) through five scenarios, each with its in-run gates:
 
-* rank 0 — server shard + the traffic plane: N client threads issue
-  blocking windowed 1-row adds (integer deltas, so float sums are
-  order-independent and EXACT) round-robin over their own disjoint row
-  sets, half the threads per shard, stamping each completion; periodic
-  gets ride along. Runs its own heartbeat and feeds PS-plane deaths
-  into the tombstone view (``elastic.bind_ps``).
-* rank 1 — server shard only: heartbeat + flag-gated per-shard
-  checkpointer (``failover_dir`` / ``failover_ckpt_interval_s``). This
-  is the victim.
-* parent (this script) — runs the :class:`FailoverSupervisor` with
-  spawn/kill callbacks over the worker argv, SIGKILLs rank 1 mid-run,
-  and shapes the result: ``recovery_s`` (kill → sustained ≥90% of the
-  pre-fault completion rate), ``ops_lost`` / ``ops_double_applied``
-  (final table vs the exact acked-op oracle — a fault-free run of the
-  same acked ops produces exactly this state, so equality IS the
-  bit-for-bit oracle check), replay/dup counters, and the supervisor's
-  detect→rejoin spans.
+* ``partition_heal`` — one-way client→shard partition for several
+  seconds, then heal: every add issued before/during the cut lands
+  exactly once after it (replay plane), and add throughput recovers
+  to ≥90% of pre-fault within the recovery budget.
+* ``dup_reorder`` — duplicate + bounded-reorder injection on the
+  windowed add frames: the shard's sequence channels dedupe every
+  duplicate and apply every frame exactly once (ledger vs the
+  acked-op oracle, bit-for-bit), with injected counts asserted
+  nonzero so a silently-disarmed plane cannot pass.
+* ``slow_shard_shed`` — slow-serve injection on one shard while a
+  ReplicaPool serves a read storm: served reads NEVER exceed the
+  staleness bound (over-bound reads defer or refuse instead), and
+  served QPS recovers after the heal.
+* ``replica_kill`` — kill one pool member mid-storm: the pool demotes
+  it, routes around, activates the warm spare, and served QPS
+  recovers to ≥90%.
+* ``combined`` — the PR-7 OS-process SIGKILL of a server shard PLUS a
+  replica kill at the same instant, under training writes and an
+  inference storm: exactly-once ledger holds (ops_lost = 0,
+  ops_double_applied = 0, parity bit-for-bit vs the acked oracle),
+  no served read over bound, and served QPS recovers to ≥90% of
+  steady — ``recovery_s`` recorded per scenario in
+  ``extra.chaos.scenarios`` for run_bench trend tracking.
 
     python tools/bench_chaos.py [seconds] [rows] [dim] [threads]
+    python tools/bench_chaos.py --scenario partition_heal   # one only
 
 Prints ``RESULT <json>`` (the bench.py worker contract); exits nonzero
-on lost or double-applied ops — a chaos bench that silently drops
-acked writes must fail loudly, not record a latency number.
+when any scenario's gate fails — a chaos bench that loses acked writes
+or serves over-bound reads must fail loudly, not record a latency
+number. All four in-process scenarios run the python wire plane
+(``ps_native`` off): the fault plane hooks the python peer/serve
+boundaries by design.
 """
 
 from __future__ import annotations
 
 import json
 import os
-import signal
 import subprocess
 import sys
 import threading
@@ -51,10 +62,469 @@ TABLE = "chaos"
 
 
 # ---------------------------------------------------------------------- #
-# worker body (both ranks): python tools/bench_chaos.py worker \
-#     <rdv> <hb> <ck> <world> <rank> <rows> <dim> <threads>
+# shared math
+# ---------------------------------------------------------------------- #
+def rate_buckets(stamps, t0: float, t_end: float):
+    """Completion stamps -> per-BUCKET_S counts from t0 to t_end."""
+    nb = max(int((t_end - t0) / BUCKET_S) + 1, 1)
+    if not len(stamps):
+        return np.zeros(nb, np.int64)
+    s = np.sort(np.asarray(stamps, np.float64))
+    return np.bincount(((s - t0) / BUCKET_S).astype(np.int64),
+                       minlength=nb)[:nb]
+
+
+def _recovery_core(rates, t0: float, bucket_s: float,
+                   fault_wall: float, recover_from: float):
+    """The ONE recovery detector every scenario uses (in-process and
+    the OS-process combined alike — a tuning of the 90% bar or the
+    floor must move them together): pre = mean rate over the 3 s
+    before the fault (skipping warmup bucket 0); recovery_s = first
+    1 s ROLLING-window mean at/after ``recover_from`` sustaining
+    ≥90% of pre ("sustained throughput" is a rate statement — gating
+    each 0.25 s bucket individually would measure scheduler noise),
+    measured from ``recover_from`` and floored at 0 (a rate that
+    never dropped below the bar — the bound covered the outage — is
+    an instant recovery, not a negative one)."""
+    rates = np.asarray(rates, np.float64)
+    kb = int((fault_wall - t0) / bucket_s)
+    rb = max(int((recover_from - t0) / bucket_s), 0)
+    pre_lo = max(kb - int(3.0 / bucket_s), 1)
+    pre = float(np.mean(rates[pre_lo:kb])) if kb > pre_lo else 0.0
+    post = float(np.mean(rates[-max(int(2.0 / bucket_s), 1):]))
+    win = max(int(1.0 / bucket_s), 1)
+    if pre <= 0.0:
+        # no pre-fault rate ⇒ nothing to recover TO: `mean >= 0.9*0`
+        # would pass on the first window and a completely dead plane
+        # would read as an instant recovery — the exact outcome the
+        # gates exist to catch. None fails the recovery gate loudly.
+        return pre, post, None
+    recovery_s = None
+    for i in range(rb, len(rates) - win + 1):
+        if np.mean(rates[i:i + win]) >= 0.9 * pre:
+            recovery_s = round(
+                max((t0 + i * bucket_s) - recover_from, 0.0), 3)
+            break
+    return pre, post, recovery_s
+
+
+def recovery_from_stamps(stamps, t0: float, t_end: float,
+                         fault_wall: float,
+                         recover_from: float | None = None):
+    """Completion stamps → (pre_rate, post_rate, recovery_s). For
+    heal-style scenarios recovery counts from the HEAL
+    (``recover_from``), for kill-style from the kill (default)."""
+    rates = rate_buckets(stamps, t0, t_end) / BUCKET_S
+    return _recovery_core(rates, t0, BUCKET_S, fault_wall,
+                          fault_wall if recover_from is None
+                          else recover_from)
+
+
+# ---------------------------------------------------------------------- #
+# in-process world: 2 ranks, python wire plane, replay armed
+# ---------------------------------------------------------------------- #
+class World:
+    """2 in-process PSServices + one replay-armed windowed table; the
+    unit the four in-process scenarios run against. Rows split across
+    both shards; rank 0 hosts the client plane (its shard-0 traffic is
+    the local short-circuit, shard-1 traffic rides the real socket —
+    where the fault plane hooks)."""
+
+    def __init__(self, tmp: str, rows: int = 32, dim: int = 8,
+                 staleness_s: float = 2.0):
+        import tempfile
+
+        from multiverso_tpu.ps.service import (FileRendezvous, PSContext,
+                                               PSService)
+        from multiverso_tpu.ps.tables import AsyncMatrixTable
+        from multiverso_tpu.utils import config
+        config.set_flag("ps_native", False)
+        config.set_flag("ps_replay", True)
+        config.set_flag("ps_timeout", 60.0)
+        config.set_flag("ps_connect_timeout", 5.0)
+        config.set_flag("ps_reconnect_backoff", 0.2)
+        config.set_flag("ps_replay_backoff", 0.1)
+        config.set_flag("ps_replay_backoff_cap", 0.5)
+        self.rows, self.dim = rows, dim
+        self.staleness_s = staleness_s
+        self.tmp = tmp or tempfile.mkdtemp(prefix="mv_chaos_")
+        # the failover checkpointer advances the shards' durable replay
+        # floor — without it the clients' retained-frame tails grow for
+        # the whole run and per-ack pruning decays throughput (exactly
+        # the hoard the PR-10 ledger flags)
+        config.set_flag("failover_dir", os.path.join(self.tmp, "ck"))
+        config.set_flag("failover_ckpt_interval_s", 0.5)
+        rdv = FileRendezvous(os.path.join(self.tmp, "rdv"))
+        self.ctx0 = PSContext(0, 2, PSService(0, 2, rdv))
+        self.ctx1 = PSContext(1, 2, PSService(1, 2, rdv))
+        self.t0 = AsyncMatrixTable(rows, dim, name=TABLE,
+                                   send_window_ms=1.0, ctx=self.ctx0)
+        self.t1 = AsyncMatrixTable(rows, dim, name=TABLE,
+                                   send_window_ms=1.0, ctx=self.ctx1)
+        self.pool = None
+
+    def make_pool(self, replicas=2, spares=0, refresh_s=0.15,
+                  admission=None):
+        from multiverso_tpu.serving.pool import ReplicaPool
+        self.pool = ReplicaPool(
+            self.t0, replicas=replicas, spares=spares,
+            refresh_s=refresh_s, staleness_s=self.staleness_s,
+            admission=admission, probe_s=0.2, start=True)
+        return self.pool
+
+    def close(self):
+        from multiverso_tpu.ps import faults
+        faults.disarm()
+        if self.pool is not None:
+            self.pool.close()
+        self.ctx0.close()
+        self.ctx1.close()
+
+
+class Traffic:
+    """N blocking-windowed-add threads over disjoint rows spanning both
+    shards, stamping each acked completion — the exactly-once oracle's
+    acked side AND the recovery detector's completion series."""
+
+    def __init__(self, world: World, n_threads: int = 3):
+        self.w = world
+        self.n = n_threads
+        self.counts = [np.zeros(world.rows, np.int64)
+                       for _ in range(n_threads)]
+        self.stamps = [[] for _ in range(n_threads)]
+        self.errors = [0] * n_threads
+        self._stop = threading.Event()
+        self._threads = []
+        half = world.rows // 2
+
+        def run(j):
+            # thread j's disjoint rows: one on each shard
+            mine = [j % half, half + (j % half)]
+            ones = np.ones((1, world.dim), np.float32)
+            i = 0
+            while not self._stop.is_set():
+                row = mine[i % len(mine)]
+                try:
+                    self.w.t0.add_rows([row], ones)   # blocking = acked
+                except Exception:   # noqa: BLE001 — replay exhausted
+                    self.errors[j] += 1
+                    time.sleep(0.05)
+                    continue
+                self.counts[j][row] += 1
+                self.stamps[j].append(time.time())
+                i += 1
+
+        self._threads = [threading.Thread(target=run, args=(j,),
+                                          daemon=True)
+                         for j in range(n_threads)]
+
+    def start(self):
+        self.t_start = time.time()
+        for t in self._threads:
+            t.start()
+        return self
+
+    def stop(self, timeout: float = 90.0):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=timeout)
+        self.t_end = time.time()
+
+    def ledger(self):
+        """Drain the window, read the final table, settle the
+        exactly-once ledger vs the acked oracle."""
+        self.w.t0.flush()
+        final = self.w.t0.get_rows(np.arange(self.w.rows))
+        acked = np.zeros(self.w.rows, np.int64)
+        for c in self.counts:
+            acked += c
+        oracle = np.repeat(acked[:, None], self.w.dim,
+                           axis=1).astype(np.float32)
+        per_row = final[:, 0].astype(np.int64)
+        return {
+            "acked_ops": int(acked.sum()),
+            "ops_lost": int(np.maximum(acked - per_row, 0).sum()),
+            "ops_double_applied": int(
+                np.maximum(per_row - acked, 0).sum()),
+            "parity_bit_for_bit": bool(np.array_equal(final, oracle)),
+            "add_errors": int(sum(self.errors)),
+        }
+
+    def all_stamps(self):
+        return np.concatenate(
+            [np.asarray(s) for s in self.stamps if s]
+            or [np.zeros(0)])
+
+
+class InferStorm:
+    """M reader threads against the pool: zipf-ish hot-set reads with
+    ``with_age=True`` — every SERVED read's age is evidence for the
+    staleness gate, every refusal (shed / over-bound / outage) counts
+    but never violates it."""
+
+    def __init__(self, pool, rows: int, n_threads: int = 2,
+                 pace_s: float = 0.002):
+        self.pool = pool
+        self._stop = threading.Event()
+        self.stamps = [[] for _ in range(n_threads)]
+        self.max_age = [0.0] * n_threads
+        self.over_bound = [0] * n_threads
+        self.refused = [0] * n_threads
+        self.shed = [0] * n_threads
+        hot = np.arange(min(8, rows))
+
+        def run(j):
+            from multiverso_tpu.serving.admission import SheddingError
+            rng = np.random.default_rng(j)
+            while not self._stop.is_set():
+                ids = (hot[rng.integers(0, len(hot), 3)]
+                       if rng.random() < 0.8
+                       else rng.integers(0, rows, 3))
+                try:
+                    _rows, age = self.pool.get_rows(
+                        np.unique(ids), with_age=True)
+                except SheddingError:
+                    self.shed[j] += 1
+                    time.sleep(0.005)
+                    continue
+                except Exception:   # noqa: BLE001 — outage / over
+                    self.refused[j] += 1     # bound: refused, not stale
+                    time.sleep(0.02)
+                    continue
+                self.max_age[j] = max(self.max_age[j], age)
+                if age > self.pool.staleness_s + 1e-9:
+                    self.over_bound[j] += 1
+                self.stamps[j].append(time.time())
+                if pace_s:
+                    time.sleep(pace_s)
+
+        self._threads = [threading.Thread(target=run, args=(j,),
+                                          daemon=True)
+                         for j in range(n_threads)]
+
+    def start(self):
+        for t in self._threads:
+            t.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=30)
+
+    def report(self):
+        return {
+            "served": int(sum(len(s) for s in self.stamps)),
+            "refused": int(sum(self.refused)),
+            "shed": int(sum(self.shed)),
+            "max_served_age_s": round(max(self.max_age), 3),
+            "over_bound_serves": int(sum(self.over_bound)),
+        }
+
+    def all_stamps(self):
+        return np.concatenate(
+            [np.asarray(s) for s in self.stamps if s]
+            or [np.zeros(0)])
+
+
+# ---------------------------------------------------------------------- #
+# in-process scenarios
+# ---------------------------------------------------------------------- #
+def scenario_partition_heal(seconds: float = 10.0,
+                            tmp: str = "") -> dict:
+    """One-way 0→1 partition under windowed-add traffic, then heal."""
+    from multiverso_tpu.ps import faults
+    w = World(tmp, rows=32, dim=8)
+    try:
+        plane = faults.arm({"seed": 11, "rules": [
+            {"kind": "partition", "src": 0, "dst": 1,
+             "phase": "cut"}]}, rank=0)
+        tr = Traffic(w, n_threads=3).start()
+        pre_s = min(max(seconds * 0.3, 2.5), 4.0)
+        cut_s = min(max(seconds * 0.2, 1.5), 3.0)
+        time.sleep(pre_s)
+        fault_wall = time.time()
+        plane.set_phase("cut")
+        time.sleep(cut_s)
+        heal_wall = time.time()
+        plane.set_phase(None)
+        time.sleep(max(seconds - pre_s - cut_s, 4.0))
+        tr.stop()
+        led = tr.ledger()
+        pre, post, rec = recovery_from_stamps(
+            tr.all_stamps(), tr.t_start, tr.t_end, fault_wall,
+            recover_from=heal_wall)
+        return {
+            "recovery_s": rec, "recovered_to_90pct": rec is not None,
+            "pre_fault_ops_per_s": round(pre, 1),
+            "post_fault_ops_per_s": round(post, 1),
+            "partition_s": round(heal_wall - fault_wall, 2),
+            "injected": plane.stats()["injected"],
+            **led,
+            "gates": {
+                "exactly_once": led["ops_lost"] == 0
+                and led["ops_double_applied"] == 0
+                and led["parity_bit_for_bit"],
+                "recovery": rec is not None,
+                "injected_nonzero":
+                    plane.stats()["injected"].get("partition", 0) > 0,
+            },
+        }
+    finally:
+        w.close()
+
+
+def scenario_dup_reorder(seconds: float = 8.0, tmp: str = "") -> dict:
+    """Duplicate + bounded-reorder injection on the replay-stamped add
+    frames: the shard's sequence channels must hold exactly-once."""
+    from multiverso_tpu.ps import faults
+    w = World(tmp, rows=32, dim=8)
+    try:
+        plane = faults.arm({"seed": 7, "rules": [
+            {"kind": "duplicate", "src": 0, "dst": 1, "p": 0.35,
+             "msg_types": ["MSG_ADD_ROWS", "MSG_BATCH"]},
+            {"kind": "reorder", "src": 0, "dst": 1, "p": 0.25,
+             "depth": 2, "msg_types": ["MSG_ADD_ROWS", "MSG_BATCH"]},
+        ]}, rank=0)
+        tr = Traffic(w, n_threads=3).start()
+        time.sleep(max(seconds, 4.0))
+        tr.stop()
+        faults.disarm()   # the settle flush runs clean
+        led = tr.ledger()
+        dup_frames = 0
+        try:
+            dup_frames = int(w.t0.server_stats(1)["shards"][TABLE]
+                             .get("dup_frames") or 0)
+        except Exception:   # noqa: BLE001 — stats are best-effort
+            pass
+        inj = plane.stats()["injected"]
+        return {
+            "recovery_s": None,   # no heal phase in this scenario
+            "injected": inj, "dup_frames_deduped": dup_frames,
+            **led,
+            "gates": {
+                "exactly_once": led["ops_lost"] == 0
+                and led["ops_double_applied"] == 0
+                and led["parity_bit_for_bit"],
+                "injected_nonzero": inj.get("duplicate", 0) > 0
+                and inj.get("reorder", 0) > 0,
+                "dups_reached_shard": dup_frames > 0,
+            },
+        }
+    finally:
+        w.close()
+
+
+def scenario_slow_shard_shed(seconds: float = 12.0,
+                             tmp: str = "") -> dict:
+    """Slow-serve injection on shard 1 under a pooled read storm +
+    training writes: the staleness bound must hold on every served
+    read while the slow phase sheds/defers, and QPS recovers after
+    the heal."""
+    from multiverso_tpu.ps import faults
+    from multiverso_tpu.serving.admission import AdmissionController
+    w = World(tmp, rows=32, dim=8, staleness_s=2.0)
+    try:
+        adm = AdmissionController()
+        adm.set_limit(TABLE, "infer", 400.0)   # sheds the burst after
+        plane = faults.arm({"seed": 13, "rules": [  # a slow unblock
+            {"kind": "slow_serve", "rank": 1, "delay_ms": 350,
+             "jitter_ms": 100, "phase": "slow"}]}, rank=0)
+        pool = w.make_pool(replicas=2, refresh_s=0.15, admission=adm)
+        tr = Traffic(w, n_threads=2).start()
+        storm = InferStorm(pool, w.rows, n_threads=2).start()
+        pre_s = min(max(seconds * 0.25, 2.5), 4.0)
+        slow_s = min(max(seconds * 0.3, 2.5), 4.0)
+        time.sleep(pre_s)
+        fault_wall = time.time()
+        plane.set_phase("slow")
+        time.sleep(slow_s)
+        heal_wall = time.time()
+        plane.set_phase(None)
+        time.sleep(max(seconds - pre_s - slow_s, 4.0))
+        storm.stop()
+        tr.stop()
+        led = tr.ledger()
+        srv = storm.report()
+        pre, post, rec = recovery_from_stamps(
+            storm.all_stamps(), tr.t_start, time.time(), fault_wall,
+            recover_from=heal_wall)
+        return {
+            "recovery_s": rec, "recovered_to_90pct": rec is not None,
+            "pre_fault_qps": round(pre, 1),
+            "post_fault_qps": round(post, 1),
+            "slow_s": round(heal_wall - fault_wall, 2),
+            "injected": plane.stats()["injected"],
+            "serving": srv, "pool": pool.stats_entry()["pool"],
+            **led,
+            "gates": {
+                "exactly_once": led["ops_lost"] == 0
+                and led["ops_double_applied"] == 0
+                and led["parity_bit_for_bit"],
+                "served_nonzero": srv["served"] > 0,
+                "staleness": srv["over_bound_serves"] == 0,
+                "recovery": rec is not None,
+                "injected_nonzero":
+                    plane.stats()["injected"].get("slow_serve", 0) > 0,
+            },
+        }
+    finally:
+        w.close()
+
+
+def scenario_replica_kill(seconds: float = 10.0,
+                          tmp: str = "") -> dict:
+    """Kill one pool member mid-storm: demotion + warm-spare
+    activation keep served QPS up; the bound holds throughout."""
+    w = World(tmp, rows=32, dim=8, staleness_s=2.0)
+    try:
+        pool = w.make_pool(replicas=2, spares=1, refresh_s=0.15)
+        tr = Traffic(w, n_threads=2).start()
+        storm = InferStorm(pool, w.rows, n_threads=2).start()
+        pre_s = min(max(seconds * 0.3, 2.5), 4.0)
+        time.sleep(pre_s)
+        kill_wall = time.time()
+        pool.kill_replica(0)
+        time.sleep(max(seconds - pre_s, 5.0))
+        storm.stop()
+        tr.stop()
+        led = tr.ledger()
+        srv = storm.report()
+        pre, post, rec = recovery_from_stamps(
+            storm.all_stamps(), tr.t_start, time.time(), kill_wall)
+        pstats = pool.stats_entry()["pool"]
+        return {
+            "recovery_s": rec, "recovered_to_90pct": rec is not None,
+            "pre_fault_qps": round(pre, 1),
+            "post_fault_qps": round(post, 1),
+            "serving": srv, "pool": pstats,
+            "pool_events": [{"ts": ts, "phase": p, "member": m}
+                            for ts, p, m in pool.events],
+            **led,
+            "gates": {
+                "exactly_once": led["ops_lost"] == 0
+                and led["ops_double_applied"] == 0
+                and led["parity_bit_for_bit"],
+                "served_nonzero": srv["served"] > 0,
+                "staleness": srv["over_bound_serves"] == 0,
+                "recovery": rec is not None,
+                "spare_activated": any(
+                    p == "spare_activated"
+                    for _, p, _ in pool.events),
+            },
+        }
+    finally:
+        w.close()
+
+
+# ---------------------------------------------------------------------- #
+# combined scenario: OS-process SIGKILL of a shard + replica kill,
+# under training writes + an inference storm (the PR-7 flow, extended
+# with the serving plane)
 # ---------------------------------------------------------------------- #
 def worker(argv) -> None:
+    """Worker body (both ranks): python tools/bench_chaos.py worker
+    <rdv> <hb> <ck> <world> <rank> <rows> <dim> <threads>"""
     rdv_dir, hb_dir, ck_dir = argv[0], argv[1], argv[2]
     world, rank = int(argv[3]), int(argv[4])
     rows, dim, n_threads = int(argv[5]), int(argv[6]), int(argv[7])
@@ -66,6 +536,7 @@ def worker(argv) -> None:
     from multiverso_tpu.ps.service import (FileRendezvous, PSContext,
                                            PSService)
     from multiverso_tpu.ps.tables import AsyncMatrixTable
+    from multiverso_tpu.serving.pool import ReplicaPool
     from multiverso_tpu.utils import config
     from multiverso_tpu.utils.dashboard import Dashboard
 
@@ -75,6 +546,7 @@ def worker(argv) -> None:
     config.set_flag("ps_reconnect_backoff", 0.3)
     config.set_flag("ps_replay", True)
     config.set_flag("ps_replay_backoff", 0.2)
+    config.set_flag("ps_replay_backoff_cap", 1.0)
     config.set_flag("ps_generation",
                     int(os.environ.get("MV_PS_GENERATION", "0")))
     config.set_flag("failover_dir", ck_dir)
@@ -139,23 +611,37 @@ def worker(argv) -> None:
                 continue
             counts[row] += 1
             stamps.append(time.time())
-            if i % 32 == 31:
-                try:
-                    t.get_rows([mine[0]])
-                except Exception:   # noqa: BLE001 — owner mid-failover
-                    pass
             i += 1
+
+    # ------------------------- serving plane -------------------------- #
+    # the replica pool + inference storm (ISSUE 14): 2 actives + 1
+    # warm spare; the parent's kill_replica marker fells member 0 at
+    # the same instant it SIGKILLs the rank-1 shard
+    pool = ReplicaPool(t, replicas=2, spares=1, refresh_s=0.2,
+                       staleness_s=2.5, probe_s=0.3, start=True)
+    storm = InferStorm(pool, rows, n_threads=2, pace_s=0.004).start()
+    kill_marker = os.path.join(rdv_dir, "kill_replica")
+
+    def watch_kill():
+        while not stop.is_set():
+            if os.path.exists(kill_marker):
+                pool.kill_replica(0)
+                return
+            time.sleep(0.05)
 
     threads = [threading.Thread(target=run_traffic, args=(j,),
                                 daemon=True) for j in range(n_threads)]
+    killer = threading.Thread(target=watch_kill, daemon=True)
     t0 = time.time()
     for th in threads:
         th.start()
+    killer.start()
     open(os.path.join(rdv_dir, "traffic_started"), "w").close()
     stop_marker = os.path.join(rdv_dir, "stop_traffic")
     while not os.path.exists(stop_marker):
         time.sleep(0.05)
     stop.set()
+    storm.stop()
     for th in threads:
         th.join(timeout=90)
     # drain every retained/replayed frame before the parity read
@@ -170,13 +656,12 @@ def worker(argv) -> None:
     double = int(np.maximum(per_row - acked, 0).sum())
     parity = bool(np.array_equal(final, oracle))
     # bucketized completion-rate series for the parent's recovery math
-    stamps = np.sort(np.concatenate(
-        [np.asarray(s) for s in per_thread_stamps if s] or
-        [np.zeros(0)]))
     t_end = time.time()
-    nb = max(int((t_end - t0) / BUCKET_S) + 1, 1)
-    buckets = np.bincount(((stamps - t0) / BUCKET_S).astype(np.int64),
-                          minlength=nb)
+    stamps = np.concatenate(
+        [np.asarray(s) for s in per_thread_stamps if s] or
+        [np.zeros(0)])
+    buckets = rate_buckets(stamps, t0, t_end)
+    serve_buckets = rate_buckets(storm.all_stamps(), t0, t_end)
     # replay-plane counters + the restored victim's dedupe stats
     rep = {k: Dashboard.get(f"table[{TABLE}].replay.{k}").count
            for k in ("frames", "dups", "dropped")}
@@ -191,6 +676,11 @@ def worker(argv) -> None:
     out = {
         "rank": 0, "t0": t0, "bucket_s": BUCKET_S,
         "buckets": buckets.tolist(),
+        "serve_buckets": serve_buckets.tolist(),
+        "serving": storm.report(),
+        "pool": pool.stats_entry()["pool"],
+        "pool_events": [{"ts": ts, "phase": p, "member": m}
+                        for ts, p, m in pool.events],
         "acked_ops": int(acked.sum()), "ops_lost": lost,
         "ops_double_applied": double,
         "parity_bit_for_bit": parity,
@@ -198,14 +688,12 @@ def worker(argv) -> None:
         "replay": rep, "victim_shard": victim_stats,
     }
     open(os.path.join(rdv_dir, "done"), "w").close()
+    pool.close()
     hb.stop()
     ctx.close()
     print("RESULT " + json.dumps(out), flush=True)
 
 
-# ---------------------------------------------------------------------- #
-# parent: orchestrate, SIGKILL, supervise, shape the record
-# ---------------------------------------------------------------------- #
 def _spawn_worker(rdv, hb, ck, world, rank, rows, dim, threads,
                   gen: int = 0, restarted: bool = False):
     env = dict(os.environ)
@@ -221,33 +709,20 @@ def _spawn_worker(rdv, hb, ck, world, rank, rows, dim, threads,
         stdout=subprocess.PIPE, text=True, env=env)
 
 
-def _recovery_from_buckets(res: dict, kill_wall: float):
-    """(pre_rate, post_rate, recovery_s) out of the driver's completion
-    series: pre = mean rate over the 3 s before the kill; recovery =
-    first second-long window after the kill sustaining >= 90% of it."""
-    t0, bs = res["t0"], res["bucket_s"]
-    buckets = np.asarray(res["buckets"], np.float64) / bs
-    kb = int((kill_wall - t0) / bs)
-    pre_lo = max(kb - int(3.0 / bs), 1)   # skip the warmup bucket 0
-    pre = float(np.mean(buckets[pre_lo:kb])) if kb > pre_lo else 0.0
-    post = float(np.mean(buckets[-max(int(2.0 / bs), 1):]))
-    win = max(int(1.0 / bs), 1)
-    recovery_s = None
-    for i in range(max(kb, 0), len(buckets) - win + 1):
-        # rolling-window MEAN: "sustained throughput ≥ 90%" is a rate
-        # statement — requiring every 0.25 s bucket individually over
-        # the bar would gate on scheduler noise, not recovery
-        if np.mean(buckets[i:i + win]) >= 0.9 * pre:
-            recovery_s = round((t0 + i * bs) - kill_wall, 3)
-            break
-    return pre, post, recovery_s
+def _recovery_from_buckets(res: dict, kill_wall: float,
+                           key: str = "buckets"):
+    """The combined worker's RESULT bucket series → the shared
+    recovery detector (recovery measured from the kill)."""
+    bs = res["bucket_s"]
+    return _recovery_core(np.asarray(res[key], np.float64) / bs,
+                          res["t0"], bs, kill_wall, kill_wall)
 
 
-def main(argv) -> int:
-    seconds = float(argv[0]) if argv else 18.0
-    rows = int(argv[1]) if len(argv) > 1 else 64
-    dim = int(argv[2]) if len(argv) > 2 else 8
-    threads = int(argv[3]) if len(argv) > 3 else 4
+def scenario_combined(seconds: float = 18.0, rows: int = 64,
+                      dim: int = 8, threads: int = 4) -> dict:
+    """SIGKILL the rank-1 shard (real OS process) AND kill a pool
+    replica at the same instant, mid-storm; the FailoverSupervisor
+    respawns the shard, the pool activates its spare."""
     import tempfile
 
     from multiverso_tpu.ps import failover
@@ -287,9 +762,11 @@ def main(argv) -> int:
         sup.start()
         pre_s = min(max(seconds * 0.3, 3.0), 8.0)
         time.sleep(pre_s)
-        # chaos: SIGKILL the victim server shard mid-traffic
+        # chaos: SIGKILL the victim server shard AND fell a pool
+        # replica in the driver, mid-traffic, same instant
         kill_wall = time.time()
         kill_rank(1)
+        open(os.path.join(rdv, "kill_replica"), "w").close()
         # recovery time varies run to run (the respawn is dominated by
         # a JAX import: 2-8 s under load) — anchor the end of the run
         # to the OBSERVED rejoin, so the sustained-90% detector always
@@ -323,17 +800,29 @@ def main(argv) -> int:
                     p.kill()
                     p.wait()
 
-    pre, post, recovery_s = _recovery_from_buckets(res, kill_wall)
-    result = {
-        "recovery_s": recovery_s,
+    pre, post, train_rec = _recovery_from_buckets(res, kill_wall)
+    srv_pre, srv_post, srv_rec = _recovery_from_buckets(
+        res, kill_wall, key="serve_buckets")
+    srv = res.get("serving", {})
+    return {
+        # the combined scenario's headline: served-QPS recovery (the
+        # acceptance gate); train-add recovery rides beside it (the
+        # PR-7 legacy trend, still the top-level extra.chaos key)
+        "recovery_s": srv_rec,
+        "recovered_to_90pct": srv_rec is not None,
+        "train_recovery_s": train_rec,
+        "train_recovered_to_90pct": train_rec is not None,
+        "pre_fault_qps": round(srv_pre, 1),
+        "post_fault_qps": round(srv_post, 1),
         "pre_fault_ops_per_s": round(pre, 1),
         "post_fault_ops_per_s": round(post, 1),
-        "recovered_to_90pct": recovery_s is not None,
         "acked_ops": res["acked_ops"],
         "ops_lost": res["ops_lost"],
         "ops_double_applied": res["ops_double_applied"],
         "parity_bit_for_bit": res["parity_bit_for_bit"],
         "add_errors": res["add_errors"],
+        "serving": srv, "pool": res.get("pool"),
+        "pool_events": res.get("pool_events"),
         "replay": res["replay"],
         "victim_shard": res["victim_shard"],
         "supervisor": {
@@ -342,14 +831,106 @@ def main(argv) -> int:
             "spans": sup.recovery_spans(),
         },
         "world": world, "rows": rows, "dim": dim, "threads": threads,
+        "gates": {
+            "exactly_once": res["ops_lost"] == 0
+            and res["ops_double_applied"] == 0
+            and res["parity_bit_for_bit"],
+            "served_nonzero": srv.get("served", 0) > 0,
+            "staleness": srv.get("over_bound_serves", 0) == 0,
+            "recovery": srv_rec is not None and train_rec is not None,
+            "spare_activated": any(
+                e.get("phase") == "spare_activated"
+                for e in res.get("pool_events") or []),
+        },
     }
+
+
+# ---------------------------------------------------------------------- #
+SCENARIOS = {
+    "partition_heal": scenario_partition_heal,
+    "dup_reorder": scenario_dup_reorder,
+    "slow_shard_shed": scenario_slow_shard_shed,
+    "replica_kill": scenario_replica_kill,
+}
+
+
+def main(argv) -> int:
+    args, only = [], None
+    it = iter(argv)
+    for a in it:
+        if a.startswith("--scenario"):
+            # both spellings: --scenario=name and --scenario name
+            only = (a.split("=", 1)[1] if "=" in a
+                    else next(it, None))
+        elif not a.startswith("--"):
+            args.append(a)
+    if only is not None and only != "combined" \
+            and only not in SCENARIOS:
+        print(f"unknown scenario {only!r} (one of "
+              f"{sorted(SCENARIOS) + ['combined']})", file=sys.stderr)
+        return 2
+    seconds = float(args[0]) if args else 18.0
+    rows = int(args[1]) if len(args) > 1 else 64
+    dim = int(args[2]) if len(args) > 2 else 8
+    threads = int(args[3]) if len(args) > 3 else 4
+
+    scenarios = {}
+    failed = []
+    run_list = ([only] if only and only != "combined"
+                else list(SCENARIOS) if only is None else [])
+    for name in run_list:
+        fn = SCENARIOS[name]
+        t0 = time.time()
+        try:
+            rec = fn(seconds=max(seconds * 0.6, 8.0))
+        except Exception as e:   # noqa: BLE001 — one scenario's crash
+            rec = {"error": f"{type(e).__name__}: {e}"[:300],
+                   "gates": {"ran": False}}
+        rec["wall_s"] = round(time.time() - t0, 1)
+        scenarios[name] = rec
+        bad = [g for g, ok in rec.get("gates", {}).items() if not ok]
+        if bad:
+            failed.append(f"{name}: {','.join(bad)}")
+        print(f"# scenario {name}: "
+              + ("FAILED " + ",".join(bad) if bad else "ok")
+              + f" ({rec['wall_s']}s)", file=sys.stderr, flush=True)
+    combined = None
+    if only in (None, "combined"):
+        t0 = time.time()
+        try:
+            combined = scenario_combined(seconds=seconds, rows=rows,
+                                         dim=dim, threads=threads)
+        except Exception as e:   # noqa: BLE001
+            combined = {"error": f"{type(e).__name__}: {e}"[:300],
+                        "gates": {"ran": False}}
+        combined["wall_s"] = round(time.time() - t0, 1)
+        scenarios["combined"] = combined
+        bad = [g for g, ok in combined.get("gates", {}).items()
+               if not ok]
+        if bad:
+            failed.append(f"combined: {','.join(bad)}")
+        print("# scenario combined: "
+              + ("FAILED " + ",".join(bad) if bad else "ok")
+              + f" ({combined['wall_s']}s)", file=sys.stderr,
+              flush=True)
+
+    result = {"scenarios": scenarios,
+              "gates_failed": failed}
+    if combined is not None and "error" not in combined:
+        # legacy PR-7 trend keys at the top level (run_bench's
+        # chaos.recovery_s baseline was train-add recovery)
+        result.update({
+            "recovery_s": combined.get("train_recovery_s"),
+            "recovered_to_90pct":
+                combined.get("train_recovered_to_90pct"),
+            "serve_recovery_s": combined.get("recovery_s"),
+            "acked_ops": combined.get("acked_ops"),
+            "ops_lost": combined.get("ops_lost"),
+            "ops_double_applied": combined.get("ops_double_applied"),
+            "parity_bit_for_bit": combined.get("parity_bit_for_bit"),
+        })
     print("RESULT " + json.dumps(result), flush=True)
-    # a chaos bench that lost or double-applied acked ops must FAIL —
-    # the latency story is meaningless without the exactly-once one
-    if res["ops_lost"] or res["ops_double_applied"] \
-            or not res["parity_bit_for_bit"]:
-        return 3
-    return 0
+    return 3 if failed else 0
 
 
 if __name__ == "__main__":

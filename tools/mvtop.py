@@ -252,6 +252,27 @@ def render(rec: Dict, prev: Optional[Dict] = None,
                 f"  cache {_fmt(e.get('cache_rows'))} rows"
                 + (f" ({e['cache_hit_rate'] * 100:.1f}% hit)"
                    if e.get("cache_hit_rate") is not None else ""))
+        # pool panel (serving/pool.py via the aggregator's serving
+        # merge): per-member route share, staleness lag, degraded flag
+        for r in sorted(s.get("pools", {}), key=str):
+            p = s["pools"][r]
+            out.append(
+                f"    pool@rank{r}: active {_fmt(p.get('active'))}"
+                f"  degraded {_fmt(p.get('degraded'))}"
+                f"  spares {_fmt(p.get('spares_left'))}"
+                f"  failovers {_fmt(p.get('failovers'))}"
+                f"  demotions {_fmt(p.get('demotions'))}")
+            for m in p.get("members", []):
+                share = m.get("share")
+                state = ("DEGRADED" if m.get("degraded")
+                         else "active" if m.get("active") else "spare")
+                out.append(
+                    f"      member {m.get('idx')}: {state}"
+                    + ("  share -" if share is None
+                       else f"  share {share * 100:.1f}%")
+                    + f"  lag {_fmt(m.get('age_s'))}s"
+                    + f"  routed {_fmt(m.get('routed'))}"
+                    + f"  pull_fail {_fmt(m.get('pull_failures'))}")
         return out
 
     for tname in sorted(rec.get("tables", {})):

@@ -270,6 +270,25 @@ def recovery_timeline(dumps: List[Dict], log_lines: List[Dict] = ()
     return out
 
 
+def injected_faults(dumps: List[Dict]) -> Dict:
+    """Chaos-plane evidence (ps/faults.py): every ``fault.inject`` /
+    ``fault.plane`` event across the merged dumps, plus per-kind
+    counts — the view that separates INJECTED faults from organic
+    ones, so a chaos run's peer deaths and timeouts read as scenario,
+    not incident. The kind is the note's first token
+    ("drop"/"delay:…"/"duplicate"/…)."""
+    events = [r for r in timeline(dumps)
+              if r.get("ev") in ("fault.inject", "fault.plane")]
+    counts: Dict[str, int] = {}
+    for r in events:
+        if r["ev"] != "fault.inject":
+            continue
+        kind = str(r.get("note") or "?").split()[0].split(":")[0]
+        counts[kind] = counts.get(kind, 0) + 1
+    return {"injected": sum(counts.values()), "by_kind": counts,
+            "events": events}
+
+
 def render_report(dumps: List[Dict], log_lines: List[Dict] = (),
                   tail: int = 40) -> str:
     names = _msg_names()
@@ -293,6 +312,21 @@ def render_report(dumps: List[Dict], log_lines: List[Dict] = (),
             lines.append(f"  rank {s['rank']}:")
             for ev in s["evidence"]:
                 lines.append(f"    - {ev}")
+    inj = injected_faults(dumps)
+    if inj["injected"] or inj["events"]:
+        # chaos plane armed: say so FIRST — every organic-looking
+        # fault below (peer deaths, timeouts, stuck ops) must be read
+        # against the scenario that provoked it
+        lines.append(
+            "INJECTED faults (chaos plane, ps/faults.py): "
+            + (", ".join(f"{k}={n}" for k, n
+                         in sorted(inj["by_kind"].items()))
+               or "plane armed, none fired"))
+        for e in inj["events"][-8:]:
+            lines.append(
+                f"  {e.get('ts', 0.0):.6f} rank{e.get('rank', -1)} "
+                f"{e['ev']} peer={e.get('peer', -1)} "
+                f"{e.get('note') or ''}")
     rec = recovery_timeline(dumps, log_lines)
     if rec:
         lines.append("recovery timeline (failover plane):")
@@ -397,6 +431,7 @@ def main(argv=None) -> int:
             "suspects": dead_suspects(dumps),
             "stuck_pairs": stuck_pairs(dumps),
             "recovery": recovery_timeline(dumps, log_lines),
+            "injected_faults": injected_faults(dumps),
             "memory": memory_report(dumps),
             "timeline": timeline(dumps, log_lines)[-args.tail:],
         }, indent=1))

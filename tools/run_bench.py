@@ -170,6 +170,35 @@ def flag_regressions(prev_headline, new_headline, factor: float = 2.0):
         if new > factor * old:
             out.append(f"{label}: {new} vs {old} previously "
                        f"({new / old:.1f}x, flag threshold {factor}x)")
+    # chaos scenario matrix (ISSUE 14, tools/bench_chaos.py): per-
+    # scenario recovery_s growth, keyed by scenario name so a new
+    # scenario joining the matrix starts its own trend — never fails,
+    # like every flag; scenarios missing on either side are skipped
+    def _scenarios(headline):
+        node = ((headline or {}).get("extra", {}) or {}).get("chaos")
+        sc = node.get("scenarios") if isinstance(node, dict) else None
+        return sc if isinstance(sc, dict) else {}
+
+    old_sc, new_sc = _scenarios(prev_headline), _scenarios(new_headline)
+    if old_sc and new_sc:
+        for name in sorted(set(old_sc) & set(new_sc)):
+            o, n = old_sc[name], new_sc[name]
+            if not (isinstance(o, dict) and isinstance(n, dict)):
+                continue
+            old_r, new_r = o.get("recovery_s"), n.get("recovery_s")
+            if not isinstance(old_r, (int, float)) \
+                    or not isinstance(new_r, (int, float)) \
+                    or isinstance(old_r, bool) or isinstance(new_r, bool):
+                continue
+            # floored baseline (0.25 s = one rate bucket): a healthy
+            # instant-recovery prior must not suppress the flag the
+            # first time a scenario starts taking seconds
+            base = max(old_r, 0.25)
+            if new_r > factor * base:
+                out.append(
+                    f"chaos scenario '{name}' recovery: {new_r}s vs "
+                    f"{old_r}s previously (flag threshold {factor}x "
+                    "over max(prev, 0.25))")
     # higher-is-better keys (served QPS): a >factor DROP is the flag
     for path, label in _REGRESSION_KEYS_HIGHER:
         old = _extra_value(prev_headline, path)
