@@ -548,16 +548,22 @@ def bench_dlrm_serving(seconds: float = 10.0):
 
 
 def bench_scale_curve(seconds: float = 3.0, shards: str = "1,2,4,8"):
-    """Mesh scale-curve harness (ISSUE 12, tools/bench_scale.py): the
-    async-PS workload at 1->2->4->8 server shards on the 8-virtual-
-    device host platform (process-per-point), plus a quiesced
-    model-average collective measurement per shard count.
-    Records T_n, E_n = T_n/(n*T_1) computed in-run, per-shard skew,
-    stall fraction, and the per-mesh-shape transfer/compile costs from
-    telemetry/devstats.py. The worker exits nonzero — failing this
-    sub-bench — if the SPMD compile-hygiene report is not clean for
-    every mesh shape (or a shape escaped the check). run_bench flags
-    run-over-run drops of extra.scale.efficiency_min / t1_rows_per_s.
+    """Mesh scale-curve harness (ISSUE 12 instrument, ISSUE 15 plane +
+    methodology — tools/bench_scale.py): the async-PS workload at
+    1->2->4->8 server shards on the 8-virtual-device host platform
+    (process-per-point, CONSTANT offered load at every point), with
+    the ISSUE-15 mesh data plane armed (ps_fanout routing +
+    super-frames, ps_spmd_stack grouped SPMD apply/gather), plus a
+    quiesced model-average collective measurement per shard count.
+    Records T_n, E_n = T_n/(n*T_1) computed in-run (plus the e2/e4/e8
+    per-point scalars), per-shard skew, stall fraction, and the
+    per-mesh-shape transfer/compile costs from telemetry/devstats.py.
+    The worker exits nonzero — failing this sub-bench — if the SPMD
+    compile-hygiene report is not clean for every mesh shape, if any
+    point's mesh-plane result diverges bit-for-bit from its 1-shard
+    classic oracle, or if the warmed measured loop recompiled in
+    steady state. run_bench flags run-over-run drops of
+    extra.scale.efficiency_min / e2 / e4 / t1_rows_per_s.
     The worker bounds each point's subprocess at 120 + 30*n s; this
     outer budget exceeds the 1+2+4+8 sum (~1050 s) so a wedged point
     surfaces as the worker's structured per-point error, never a

@@ -54,6 +54,12 @@ from multiverso_tpu.ps import failover as _failover
 # one `_faults.PLANE.armed` attribute read; faults.py never imports
 # this module at module scope, so no cycle)
 from multiverso_tpu.ps import faults as _faults
+# mesh data plane (ISSUE 15): process-colocation registry + stacked
+# shard groups. Module-level so the ps_fanout/ps_spmd_stack flags
+# register before argv parse and the plane is compiled into every
+# build, disarmed by default (the fault-plane discipline); spmd.py
+# never imports this module at module scope, so no cycle.
+from multiverso_tpu.ps import spmd as _spmd
 # serving plane (read replicas + admission): module-level for the same
 # reason — its serving_* flags must exist before an argv parse, and its
 # replica registry feeds the MSG_STATS "serving" block below. The
@@ -123,6 +129,19 @@ MSG_HEALTH = 0x1C
 # (shard.export_snapshot); the native C++ server punts it to Python
 # like MSG_STATS (and its meta whitelist rejects "since" regardless).
 MSG_SNAPSHOT = 0x1D
+# multi-owner super-frame (mesh data plane, ps/spmd.py; flag
+# ps_fanout): N complete inner frames — each a full wire.encode output
+# whose meta names its OWNING rank under "ow" (wire.OWNER_META_KEY) —
+# delivered, dispatched across ALL the colocated shards of the
+# destination process, and acked as ONE request. The reply is the
+# inner REPLY frames packed the same way (one per sub-op, OK or ERR,
+# in order). This is the reference's worker-side Partition fan-out
+# collapsed to one round trip per destination process instead of one
+# per shard; colocated plain row adds/gathers additionally collapse
+# server-side into ONE SPMD dispatch over the mesh-stacked shard
+# group (_handle_multi). Unknown to the native C++ server by design:
+# it punts, like MSG_BATCH.
+MSG_MULTI = 0x1E
 
 config.define_string("ps_rendezvous", "",
                      "directory for async-PS rank rendezvous (empty = use "
@@ -208,6 +227,18 @@ class PSError(RuntimeError):
 
 class PSPeerError(PSError):
     """A specific peer is unreachable/dead; traffic to others is unaffected."""
+
+
+def _sub_err(e: BaseException) -> Dict:
+    """A super-frame sub-op's error as reply meta: the message plus a
+    ``"peer"`` marker for peer-death errors, so the client-side fan-out
+    can rethrow the TYPED PSPeerError (callers branch on it — a dead
+    owner must not collapse into a generic request error just because
+    the op rode a super-frame)."""
+    out = {"error": f"{type(e).__name__}: {e}"}
+    if isinstance(e, PSPeerError):
+        out["peer"] = True
+    return out
 
 
 def await_reply(fut: cf.Future, timeout: float, what: str):
@@ -666,6 +697,14 @@ class PSService:
         if host is None:
             host = config.get_flag("ps_host") or "127.0.0.1"
         self._rendezvous = rendezvous
+        # process-colocation identity (ps/spmd.py): services sharing a
+        # process AND a rendezvous may route to each other in-process
+        # when ps_fanout is armed. The routing registry entry appears
+        # with the rendezvous publish (deferred-publish services stay
+        # invisible until their restore, same rule as the address).
+        self._proc_key = _spmd.proc_key(rendezvous)
+        self._routed_seen: set = set()
+        self._routed_dead: set = set()
         self._handlers: Dict[str, Callable] = {}
         # table -> shard object for MSG_STATS (handlers alone are opaque
         # closures; the stats RPC needs the shard's stats() surface)
@@ -763,10 +802,14 @@ class PSService:
         """Publish (or re-publish) this incarnation's address through
         the rendezvous — the moment peers may discover it. Deferred-
         publish services (restarted shards) call this AFTER their
-        checkpoint restore; idempotent."""
+        checkpoint restore; idempotent. The in-process routing registry
+        entry (ps/spmd.py) appears at the same moment and for the same
+        reason: a survivor routing a replay onto the still-empty shard
+        would commit, ack, and then be wiped by the restore."""
         if self._rendezvous is not None:
             self._rendezvous.publish(self.rank, self.addr)
             self._published = True
+        _spmd.register_service(self)
 
     def register_handler(self, table: str, handler: Callable,
                          shard=None) -> None:
@@ -787,6 +830,12 @@ class PSService:
             if shard is not None:
                 self._shards[table] = shard
             self._handlers_cv.notify_all()
+        if shard is not None:
+            # mesh-stacked grouping (flag ps_spmd_stack, ps/spmd.py):
+            # colocated same-table device shards pool into ONE
+            # mesh-sharded stacked array with single-dispatch SPMD
+            # apply/gather. No-op unless armed and the shard qualifies.
+            _spmd.try_join(self, table, shard)
 
     def _try_register_native(self, table: str, handler: Callable,
                              shard) -> Optional[Callable]:
@@ -796,6 +845,15 @@ class PSService:
         # EXACT RowShard only: HashShard grows/remaps its buffer, which
         # would leave C++ writing through a stale pointer
         if type(shard) is not RowShard or not shard._np_mode:
+            return None
+        if config.get_flag("ps_fanout"):
+            # process-coalesced routing (ps/spmd.py): a fanout world's
+            # traffic arrives in-process, where a native registration
+            # only costs — every routed op would cross the FFI to take
+            # the C++ shard mutex around its whole python handler, and
+            # the sampled 2-worker profile showed exactly that mutex
+            # eating 60%+ of the wall. The C++ fast path exists for
+            # SOCKET clients, which a fanout-armed world does not use.
             return None
         sign = STATELESS_LINEAR.get(type(shard.updater))
         if sign is None:
@@ -868,15 +926,23 @@ class PSService:
                 reply = wire.encode(MSG_REPLY_OK, msg_id,
                                     self.health_payload())
             else:
-                handler = self._wait_handler(meta["table"])
                 tr = (meta.get(wire.TRACE_META_KEY)
                       if _trace.enabled() else None)
                 t0 = time.time() if tr is not None else 0.0
-                with monitor(f"ps[{meta['table']}].serve"):
-                    rmeta, rarrays = handler(msg_type, meta, arrays)
+                if msg_type == MSG_MULTI:
+                    # multi-owner super-frame punted by the native
+                    # server (unknown type, like MSG_BATCH): dispatch
+                    # across this process's colocated shards
+                    with monitor("ps[multi].serve"):
+                        rmeta, rarrays = self._handle_multi(meta, arrays)
+                else:
+                    handler = self._wait_handler(meta["table"])
+                    with monitor(f"ps[{meta['table']}].serve"):
+                        rmeta, rarrays = handler(msg_type, meta, arrays)
                 if tr is not None:
                     _trace.add_span("ps.serve", t0, time.time(), trace=tr,
-                                    args={"table": meta["table"],
+                                    args={"table": meta.get("table",
+                                                            "multi"),
                                           "type": msg_type})
                 if isinstance(rarrays, wire.ChunkedReply):
                     # streamed reply over the native conn: each chunk
@@ -1120,6 +1186,313 @@ class PSService:
                 f"probe (type 0x{msg_type:X}) to rank {rank} at {addr} "
                 f"failed: {e}") from e
 
+    # ------------------------- multi-owner super-frames --------------- #
+    def _owner_service(self, owner: int) -> "PSService":
+        """Resolve a super-frame sub-op's owning service: this rank, or
+        a colocated sibling through the process registry (ps/spmd.py).
+        A previously-routed owner observed gone raises the typed peer
+        error AND fires the death hooks — a super-framed sub-op must
+        signal a dead shard exactly like a dying socket would (the
+        send-window replay plane re-arms off that hook). An owner that
+        was NEVER colocated is a routing error."""
+        if owner == self.rank:
+            return self
+        svc = _spmd.colocated_service(self._proc_key, owner)
+        if svc is not None:
+            self._routed_seen.add(owner)
+            if owner in self._routed_dead:
+                # fresh incarnation registered (respawn): clear the
+                # tombstone — same rule as _route, or a SECOND death of
+                # this rank would never re-fire the hooks
+                self._routed_dead.discard(owner)
+                with self._peers_lock:
+                    self._dead_ranks.pop(owner, None)
+            return svc
+        if owner in self._routed_seen:
+            if owner not in self._routed_dead:
+                self._routed_dead.add(owner)
+                self._note_death(owner)
+            raise PSPeerError(
+                f"rank {owner} (in-process route) is down")
+        raise PSError(
+            f"super-frame sub-op for rank {owner}, which is not "
+            f"colocated with rank {self.rank}")
+
+    def multi_local(self, subs: Sequence[Tuple[int, Dict, Sequence]]
+                    ) -> List[cf.Future]:
+        """In-process super-frame dispatch from PYTHON objects: one
+        task on this client's serial executor runs every sub-op across
+        the colocated shards (grouped SPMD/np fast paths included) and
+        resolves one future per sub — the routed fan-out's hot path,
+        with ZERO wire encode/parse on either side (the socket-framed
+        MSG_MULTI pays that only when a super-frame actually crosses a
+        wire). Ordering: same executor queue as every other routed op,
+        so per-(client, owner) FIFO holds."""
+        futs: List[cf.Future] = [cf.Future() for _ in subs]
+        # INLINE on the caller thread (like every routed dispatch when
+        # the fan-out plane is armed): program order IS per-owner FIFO,
+        # and an executor hop would cost two thread wakeups per op — on
+        # an oversubscribed host the scheduler latency of that
+        # ping-pong dominated the op itself (measured: 2 workers at 2
+        # shards ran 2x SLOWER than one until dispatch went inline)
+        try:
+            results = self._handle_multi_obj(subs)
+        except Exception as e:   # noqa: BLE001 — transport-level
+            for f in futs:
+                f.set_exception(e)
+            return futs
+        for f, (ok, rm, ra) in zip(futs, results):
+            if ok:
+                f.set_result((rm, ra))
+            elif rm.get("peer"):
+                # rethrow TYPED: callers branch on PSPeerError (dead
+                # owner → retry/failover) vs PSError (fail fast), and a
+                # sub-op riding a super-frame must not lose that
+                f.set_exception(PSPeerError(rm.get("error", "?")))
+            else:
+                f.set_exception(PSError(rm.get("error", "?")))
+        return futs
+
+    def _handle_multi(self, meta: Dict, arrays: Sequence[np.ndarray]
+                      ) -> Tuple[Dict, List[np.ndarray]]:
+        """Wire entry for a MSG_MULTI super-frame (socket / native
+        punt): unpack the inner frames, run the shared sub-op engine,
+        and pack the inner replies (OK or ERR per sub, in order) the
+        same way."""
+        subs = wire.unpack_batch(arrays)
+        results = self._handle_multi_obj(subs)
+        blobs = [wire.encode(MSG_REPLY_OK if ok else MSG_REPLY_ERR,
+                             i, rm, ra)
+                 for i, (ok, rm, ra) in enumerate(results)]
+        return {"n": len(subs)}, wire.pack_batch(blobs)
+
+    def _handle_multi_obj(self, subs: Sequence[Tuple[int, Dict,
+                                                     Sequence]]
+                          ) -> List[Tuple[bool, Dict, Any]]:
+        """The super-frame sub-op engine: dispatch every ``(msg_type,
+        meta, arrays)`` sub-op to its owning colocated shard and return
+        ``(ok, reply_meta, reply_arrays)`` per sub. Plain (unstamped)
+        row adds and gets whose target shards share an ACTIVE
+        mesh-stacked plane collapse into ONE SPMD dispatch per kind
+        (MeshStack.apply_grouped / gather_grouped); host-numpy shards
+        python alone serves take a direct lock+apply/gather fast path
+        (no coalescing-queue event round trip — this executor thread is
+        the one server for the client's routed ops); everything else —
+        batch frames, replay-stamped frames, state ops, natively-
+        registered shards — dispatches through the shard's ordinary
+        handler in frame order. Per-sub failures come back as per-sub
+        errors (per-owner independence: sub K failing must not fail sub
+        K+1); grouping requires each owner to appear at most once, else
+        the whole frame falls back to in-order per-sub dispatch."""
+        n = len(subs)
+        results: List[Optional[Tuple[bool, Dict, Any]]] = [None] * n
+        owners = [int(m.get(wire.OWNER_META_KEY, self.rank))
+                  for _mt, m, _a in subs]
+        groupable = len(set(owners)) == n
+        add_group: List[Tuple[int, Any, Dict, Sequence]] = []
+        get_group: List[Tuple[int, Any, Dict, Sequence]] = []
+        direct: List[int] = []
+        from multiverso_tpu.ps.shard import RowShard as _RowShard
+        for i, (mt, m, arrs) in enumerate(subs):
+            shard = None
+            if groupable and mt in (MSG_ADD_ROWS, MSG_GET_ROWS):
+                try:
+                    shard = self._owner_service(
+                        owners[i])._shards.get(m.get("table"))
+                except PSError as e:
+                    results[i] = (False, _sub_err(e), [])
+                    continue
+            plane = getattr(shard, "_plane", None)
+            # the np fast path mirrors the plane grouping for
+            # host-numpy shards python alone serves: direct lock+apply
+            # and pinned gather, skipping the per-request machinery a
+            # socket frame needs. Natively-registered shards keep the
+            # ordinary handler (its wrapper holds the C++ shard mutex).
+            np_fast = (type(shard) is _RowShard and shard._np_mode
+                       and shard._native_ref is None)
+            if (mt == MSG_ADD_ROWS
+                    and wire.REPLAY_CLIENT_KEY not in m
+                    and ((plane is not None and plane.active)
+                         or np_fast)):
+                add_group.append((i, shard, m, arrs))
+            elif (mt == MSG_GET_ROWS and not m.get("sparse")
+                    and not m.get("chunk")
+                    and ((plane is not None and plane.active)
+                         or np_fast)):
+                get_group.append((i, shard, m, arrs))
+            else:
+                direct.append(i)
+        # one SPMD dispatch for all grouped adds (per-sub validation
+        # errors stay per-sub; a dispatch-level failure fails exactly
+        # the subs that were in it)
+        if add_group:
+            entries = []
+            for i, shard, m, arrs in add_group:
+                try:
+                    local, vals, opt = shard._prep_add(m, arrs)
+                    entries.append((i, shard, local, vals, opt))
+                except Exception as e:  # noqa: BLE001 — per sub
+                    results[i] = (False,
+                                  _sub_err(e),
+                                  [])
+            planes: Dict[int, List] = {}
+            np_done: List[Tuple[Any, float, int]] = []
+            from multiverso_tpu.updaters import \
+                STATELESS_LINEAR as _LINEAR
+            for ent in entries:
+                p = ent[1]._plane
+                if p is not None and p.active:
+                    planes.setdefault(id(p), []).append(ent)
+                    continue
+                # np fast path: apply under the shard lock directly —
+                # the coalescing queue exists to merge CONCURRENT
+                # senders' adds, and the routed plane's callers apply
+                # in program order. The lock hold is the MUTATION
+                # alone: the telemetry sinks (shared apply monitor,
+                # global flightrec ring) have their own locks, and
+                # nesting them inside n shard locks per super-frame
+                # chained lock convoys across every concurrent worker.
+                i, s, l, v, o = ent
+                try:
+                    sign = _LINEAR[type(s.updater)]
+                    t0 = time.perf_counter()
+                    with s._lock:
+                        data = s._writable_data()
+                        if sign > 0:
+                            data[l] += v
+                        else:
+                            data[l] -= v
+                        if s._dirty is not None:
+                            s._dirty[:, l] = True
+                        s._version += 1
+                        s._record_wave(1)
+                        s._stat_adds += 1
+                        s._stat_applies += 1
+                    np_done.append((s, (time.perf_counter() - t0) * 1e3,
+                                    int(v.nbytes)))
+                    results[i] = (True, {}, [])
+                except Exception as e:  # noqa: BLE001 — per sub
+                    results[i] = (False,
+                                  _sub_err(e),
+                                  [])
+            if np_done:
+                # off-lock telemetry: per-shard apply histogram samples
+                # plus ONE flight edge + beat for the frame's np waves
+                for s, ms, _nb in np_done:
+                    s._mon_apply.observe_ms(ms)
+                _flight.beat("apply")
+                _flight.record(_flight.EV_APPLY,
+                               nbytes=sum(nb for _s, _m, nb in np_done),
+                               note=f"multi np ops={len(np_done)}")
+            for group in planes.values():
+                plane = group[0][1]._plane
+                try:
+                    plane.apply_grouped(
+                        [(s, l, v, o) for _i, s, l, v, o in group])
+                    for _i, s, _l, _v, _o in group:
+                        with s._lock:
+                            s._record_wave(1)
+                            s._stat_adds += 1
+                            s._stat_applies += 1
+                    for i, *_rest in group:
+                        results[i] = (True, {}, [])
+                except Exception as e:  # noqa: BLE001
+                    err = _sub_err(e)
+                    for i, *_rest in group:
+                        results[i] = (False, dict(err), [])
+        # grouped gets: ONE SPMD dispatch per stacked plane; np shards
+        # serve off the shared pinned-epoch body directly
+        if get_group:
+            pairs = []
+            np_srv_bytes = 0
+            np_srv = 0
+            for i, shard, m, arrs in get_group:
+                p = shard._plane
+                if p is None or not p.active:
+                    try:
+                        if (shard._host_serve
+                                and m.get("wire", "none") == "none"):
+                            # np fast path: ONE lock hold around the
+                            # small gather — the pin/release round trip
+                            # costs TWO contended lock handoffs per sub
+                            # (sampled: a quarter of the 2-worker wall
+                            # sat in _pin_data), and a fan-out part's
+                            # gather is tiny
+                            s = shard
+                            local = s._localize_raw(arrs[0])
+                            s._note_rows(local)
+                            with s._lock:
+                                rows = np.asarray(s._data)[local]
+                            s._stat_gets += 1
+                            s._stat_get_bytes += int(rows.nbytes)
+                            np_srv += 1
+                            np_srv_bytes += int(rows.nbytes)
+                            results[i] = (True, {}, [rows])
+                        else:
+                            results[i] = (
+                                True, *shard._serve_get_rows(m, arrs))
+                    except Exception as e:  # noqa: BLE001 — per sub
+                        results[i] = (
+                            False,
+                            _sub_err(e), [])
+                    continue
+                try:
+                    local = shard._localize_raw(arrs[0])
+                    shard._note_rows(local)
+                    pairs.append((i, shard, m, local))
+                except Exception as e:  # noqa: BLE001 — per sub
+                    results[i] = (False,
+                                  _sub_err(e),
+                                  [])
+            if np_srv:
+                # ONE flight edge for the frame's np-served gathers
+                _flight.record(_flight.EV_GET_SERVE,
+                               nbytes=np_srv_bytes,
+                               note=f"multi np ops={np_srv}")
+            if pairs:
+                planes = {}
+                for ent in pairs:
+                    planes.setdefault(id(ent[1]._plane), []).append(ent)
+                for group in planes.values():
+                    plane = group[0][1]._plane
+                    try:
+                        blocks = plane.gather_grouped(
+                            [(s, l) for _i, s, _m, l in group])
+                        for (i, s, m, l), rows in zip(group, blocks):
+                            w = m.get("wire", "none")
+                            payload = wire.encode_payload(rows, w)
+                            s._stat_gets += 1
+                            s._stat_get_bytes += sum(
+                                int(a.nbytes) for a in payload)
+                            _flight.record(
+                                _flight.EV_GET_SERVE,
+                                nbytes=l.size * s.num_col
+                                * s.dtype.itemsize)
+                            results[i] = (True, {}, payload)
+                    except Exception as e:  # noqa: BLE001
+                        err = _sub_err(e)
+                        for i, *_rest in group:
+                            results[i] = (False, dict(err), [])
+        # everything else: in-order per-sub dispatch through the owning
+        # shard's ordinary handler (stamp gates, batch waves, native
+        # mutex wrappers all apply exactly as for a direct frame)
+        for i in direct:
+            mt, m, arrs = subs[i]
+            try:
+                svc2 = self._owner_service(owners[i])
+                handler = svc2._wait_handler(m["table"])
+                with monitor(f"ps[{m['table']}].serve"):
+                    rmeta, rarrays = handler(mt, m, arrs)
+                if isinstance(rarrays, wire.ChunkedReply):
+                    raise PSError(
+                        "chunk-streamed replies cannot ride a "
+                        "super-frame")
+                results[i] = (True, rmeta, rarrays)
+            except Exception as e:  # noqa: BLE001 — per sub
+                results[i] = (False,
+                              _sub_err(e), [])
+        return results
+
     def _wait_handler(self, table: str, timeout: float = 20.0) -> Callable:
         # a worker can race ahead of a peer still constructing its tables
         # (the reference serialized this through MV_CreateTable's barrier;
@@ -1199,18 +1572,28 @@ class PSService:
                             msg_type, msg_id, rank=self.rank)
                         if _slow_s:
                             time.sleep(_slow_s)
-                    handler = self._wait_handler(meta["table"])
                     tr = (meta.get(wire.TRACE_META_KEY)
                           if _trace.enabled() else None)
                     t0 = time.time() if tr is not None else 0.0
                     # server-side Dashboard visibility (ref MONITOR_BEGIN
                     # around Server::ProcessAdd/Get, src/server.cpp:37-45)
-                    with monitor(f"ps[{meta['table']}].serve"):
-                        rmeta, rarrays = handler(msg_type, meta, arrays)
+                    if msg_type == MSG_MULTI:
+                        # multi-owner super-frame over a real socket:
+                        # dispatch across this process's colocated
+                        # shards (sub-ops carry their owning rank)
+                        with monitor("ps[multi].serve"):
+                            rmeta, rarrays = self._handle_multi(
+                                meta, arrays)
+                    else:
+                        handler = self._wait_handler(meta["table"])
+                        with monitor(f"ps[{meta['table']}].serve"):
+                            rmeta, rarrays = handler(msg_type, meta,
+                                                     arrays)
                     if tr is not None:
                         _trace.add_span("ps.serve", t0, time.time(),
                                         trace=tr,
-                                        args={"table": meta["table"],
+                                        args={"table": meta.get(
+                                            "table", "multi"),
                                               "type": msg_type})
                     if isinstance(rarrays, wire.ChunkedReply):
                         # streamed get reply: one MSG_REPLY_CHUNK per
@@ -1433,30 +1816,26 @@ class PSService:
         fire-and-forget callers stay fire-and-forget and multi-owner ops
         keep their live-shard futures."""
         if rank == self.rank:
+            return self._dispatch_inproc(self, msg_type, meta, arrays,
+                                         chunk_sink)
+        # process-coalesced routing (ps/spmd.py; flag ps_fanout): a
+        # COLOCATED rank's request skips the localhost socket and
+        # dispatches on this client's serial local executor straight
+        # into the owning service's handler — per-(client, owner) FIFO
+        # (and with it read-your-writes and every window fence) holds
+        # because all of one client's routed ops ride ONE queue. A
+        # routed rank observed gone (service closed / not yet
+        # respawned) fails fast like a dead peer AND fires the death
+        # hooks, so the send-window replay plane re-arms exactly as it
+        # would off a dying socket.
+        rsvc, rerr = self._route(rank)
+        if rerr is not None:
             fut: cf.Future = cf.Future()
-
-            def _run():
-                try:
-                    handler = self._wait_handler(meta["table"])
-                    rmeta, rarrays = handler(msg_type, meta, arrays)
-                    if isinstance(rarrays, wire.ChunkedReply):
-                        # local short-circuit: drive the sink inline (no
-                        # socket to overlap, but the caller's scatter
-                        # contract holds); clients normally skip the
-                        # chunk request for the local rank entirely
-                        if chunk_sink is None:
-                            raise PSError(
-                                "chunked reply without a chunk sink on "
-                                "the local path")
-                        for cmeta, carrays in rarrays.chunks:
-                            chunk_sink(cmeta, carrays)
-                        rmeta, rarrays = rarrays.meta, []
-                    fut.set_result((rmeta, rarrays))
-                except Exception as e:
-                    fut.set_exception(e)
-
-            self._local_exec.submit(_run)
+            fut.set_exception(rerr)
             return fut
+        if rsvc is not None:
+            return self._dispatch_inproc(rsvc, msg_type, meta, arrays,
+                                         chunk_sink)
         try:
             return self._peer(rank).request(
                 msg_type, meta if meta_b is None else meta_b, arrays,
@@ -1466,6 +1845,75 @@ class PSService:
             fut.set_exception(e if isinstance(e, PSPeerError)
                               else PSPeerError(str(e)))
             return fut
+
+    def _route(self, rank: int):
+        """Resolve ``rank`` to a live colocated service (or a typed
+        fast-fail once a previously-routed rank is observed gone).
+        ``(None, None)`` = not routed, use the socket path."""
+        if self._proc_key is None or not config.get_flag("ps_fanout"):
+            return None, None
+        svc = _spmd.colocated_service(self._proc_key, rank)
+        if svc is not None:
+            self._routed_seen.add(rank)
+            if rank in self._routed_dead:
+                # fresh incarnation registered (respawn): clear the
+                # tombstone so backoff-free routing resumes
+                self._routed_dead.discard(rank)
+                with self._peers_lock:
+                    self._dead_ranks.pop(rank, None)
+            return svc, None
+        if rank in self._routed_seen:
+            err = PSPeerError(
+                f"rank {rank} (in-process route) is down")
+            if rank not in self._routed_dead:
+                self._routed_dead.add(rank)
+                self._note_death(rank)
+            return None, err
+        return None, None
+
+    def _dispatch_inproc(self, svc: "PSService", msg_type: int,
+                         meta: Dict, arrays,
+                         chunk_sink: Optional[Callable]) -> cf.Future:
+        """The local short-circuit, generalized to any colocated
+        service. With the fan-out plane armed (flag ``ps_fanout``), the
+        dispatch runs INLINE on the caller thread: the caller's program
+        order IS per-owner FIFO (stronger than the executor queue), and
+        skipping the two thread wakeups per op removes the scheduler
+        ping-pong that dominated routed round trips on oversubscribed
+        hosts. With the plane off (the classic local-rank path), the
+        serial executor keeps the established fire-and-forget timing.
+        Multi-owner super-frames dispatch through the target's
+        :meth:`_handle_multi`."""
+        fut: cf.Future = cf.Future()
+
+        def _run():
+            try:
+                if msg_type == MSG_MULTI:
+                    rmeta, rarrays = svc._handle_multi(meta, arrays)
+                else:
+                    handler = svc._wait_handler(meta["table"])
+                    rmeta, rarrays = handler(msg_type, meta, arrays)
+                if isinstance(rarrays, wire.ChunkedReply):
+                    # in-process dispatch: drive the sink inline (no
+                    # socket to overlap, but the caller's scatter
+                    # contract holds); clients normally skip the
+                    # chunk request for in-process ranks entirely
+                    if chunk_sink is None:
+                        raise PSError(
+                            "chunked reply without a chunk sink on "
+                            "the local path")
+                    for cmeta, carrays in rarrays.chunks:
+                        chunk_sink(cmeta, carrays)
+                    rmeta, rarrays = rarrays.meta, []
+                fut.set_result((rmeta, rarrays))
+            except Exception as e:
+                fut.set_exception(e)
+
+        if config.get_flag("ps_fanout"):
+            _run()
+        else:
+            self._local_exec.submit(_run)
+        return fut
 
     def ping(self, rank: int, timeout: Optional[float] = None) -> bool:
         if rank == self.rank:
@@ -1487,6 +1935,12 @@ class PSService:
         _aggregator.stop_if_bound(self)
         _failover.stop_if_bound(self)
         self._closed = True
+        # mesh data plane (ps/spmd.py): leave the routing registry (so
+        # colocated clients observe this rank's death like a dead
+        # socket) and evict this service's shards from their stacked
+        # groups — they keep working standalone for the failover
+        # checkpointer's final save below
+        _spmd.release_service(self)
         # shutdown, not just close: close() does NOT wake a thread blocked
         # in accept() on Linux — shutdown() makes accept return EINVAL
         # immediately (close alone left the join below eating its timeout

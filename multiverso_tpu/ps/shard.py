@@ -192,6 +192,19 @@ class RowShard:
         self.name = name
         self.dtype = jnp.dtype(dtype)
         self.updater = updater
+        # mesh-stacked group membership (ps/spmd.py, flag
+        # ps_spmd_stack): when a plane adopts this shard, its storage
+        # lives as one lane of the group's (S, R, C) stacked device
+        # array and the _data/_ustate properties below serve lazily
+        # materialized per-epoch slab views; None = classic standalone
+        # storage. Set/cleared by MeshStack.admit/evict under this
+        # shard's lock.
+        self._plane = None
+        self._plane_slot: Optional[int] = None
+        self._view_cache = None
+        self._view_epoch = -1
+        self._ustate_view_cache = None
+        self._mem_state_bytes = 0
         # shard this process's rows over its LOCAL devices: on a real
         # multi-host TPU every host owns several chips, and its row range
         # should live (and its updater run) across all of them — the
@@ -349,6 +362,54 @@ class RowShard:
             "retired_bytes": 0, "oldest_pin_age_s": 0.0}
         _memstats.register(f"shard[{name}:{self.lo}-{self.hi}]", self)
 
+    # ------------------------------------------------------------------ #
+    # storage indirection (mesh-stacked groups, ps/spmd.py): classic
+    # shards read/write `_data_raw`/`_ustate_raw` straight through these
+    # properties; a grouped shard's storage lives as one lane of its
+    # plane's stacked array, and reads materialize a lazily-sliced slab
+    # view (cached per plane epoch — a slice is its own buffer, so
+    # pinned views survive the stack's donated swaps untouched). Every
+    # existing read/rebind site keeps its spelling.
+    # ------------------------------------------------------------------ #
+    @property
+    def _data(self):
+        p = getattr(self, "_plane", None)
+        if p is not None:
+            return p.view(self)
+        return self._data_raw
+
+    @_data.setter
+    def _data(self, v):
+        self._data_raw = v
+
+    @property
+    def _ustate(self):
+        p = getattr(self, "_plane", None)
+        if p is not None:
+            return p.ustate_view(self)
+        return self._ustate_raw
+
+    @_ustate.setter
+    def _ustate(self, v):
+        self._ustate_raw = v
+
+    def _plane_lock(self):
+        """The plane's lock as a context when grouped (nests INSIDE the
+        shard lock — the one global order), else a no-op. Read paths
+        that must see (bytes, version) atomically vs grouped applies
+        hold it across both reads."""
+        import contextlib
+        p = self._plane
+        return p.lock if p is not None else contextlib.nullcontext()
+
+    def _plane_evict(self) -> None:
+        """Fall back to classic per-shard storage before an exotic
+        mutation (set_rows / whole-table add / state restore) — the
+        always-safe path; row add/get traffic never needs it."""
+        p = self._plane
+        if p is not None:
+            p.evict(self)
+
     def _place_rows(self, host):
         """Place a row buffer honoring the size-gated local-device sharding
         (numpy-mode shards keep a writable host buffer instead)."""
@@ -475,6 +536,14 @@ class RowShard:
             out["dirty_rows"] = dirty_rows   # sparse-protocol staleness
         if self._hotkeys is not None:
             out["hotkeys"] = self._hotkeys.to_dict()
+        # mesh-stacked group placement (ps/spmd.py): slot -> device plus
+        # this shard's share of the plane's grouped applies — mvtop's
+        # shard-placement panel renders skew from bad placement off it
+        p = self._plane
+        if p is not None:
+            sp = p.stats_for(self)
+            if sp is not None:
+                out["spmd"] = sp
         return out
 
     def queue_depth(self) -> int:
@@ -502,11 +571,26 @@ class RowShard:
         the ledger tolerates a one-sweep-old figure."""
         if self._lock.acquire(blocking=False):
             try:
-                data_nb = int(getattr(self._data, "nbytes", 0))
-                live_id = id(self._data)
+                p = self._plane
+                if p is not None:
+                    # grouped (ps/spmd.py): report the slab SHARE of the
+                    # pooled stack from cached static sizes — the pull
+                    # must never materialize a view (that would pay a
+                    # device slice per ledger sweep) nor block on the
+                    # plane lock mid-apply. The stack itself has its own
+                    # spmd[table] ledger component.
+                    data_nb = int(self._padded[0] * self.num_col
+                                  * self.dtype.itemsize)
+                    vc = self._view_cache
+                    live_id = id(vc) if vc is not None else -1
+                    ustate_nb = int(self._mem_state_bytes)
+                else:
+                    data_nb = int(getattr(self._data_raw, "nbytes", 0))
+                    live_id = id(self._data_raw)
+                    ustate_nb = sum(
+                        int(getattr(l, "nbytes", 0))
+                        for l in jax.tree.leaves(self._ustate_raw))
                 pins = list(self._pin_reg.values())
-                ustate_nb = sum(int(getattr(l, "nbytes", 0))
-                                for l in jax.tree.leaves(self._ustate))
             finally:
                 self._lock.release()
             now = time.monotonic()
@@ -527,6 +611,11 @@ class RowShard:
                 "retired_bytes": int(sum(retired.values())),
                 "oldest_pin_age_s": round(oldest, 3),
             }
+            if self._plane is not None:
+                # pooled storage: these bytes are the shard's SHARE of
+                # the plane's stack (which carries its own spmd[table]
+                # ledger component)
+                core["spmd"] = True
             self._mem_cache = core
         else:
             core = dict(self._mem_cache)
@@ -768,6 +857,16 @@ class RowShard:
         """One merged, deduped row-delta batch -> the updater (under
         ``self._lock``). Times itself into the ``ps[name].apply``
         histogram and bumps the shard mutation version."""
+        p = self._plane
+        if p is not None:
+            # mesh-stacked group (ps/spmd.py): the update runs as one
+            # lane of the plane's SPMD program — the plane owns the
+            # version bump (under its lock, atomic with the stack swap),
+            # the apply histogram sample, and the flight-recorder edge.
+            # Wave/stat recording stays with this path's callers, who
+            # hold self._lock exactly as they do classically.
+            p.apply_rows(self, local, vals, opt)
+            return
         t0 = time.perf_counter()
         if self._np_mode:
             data = self._writable_data()   # copy-on-write vs pinned reads
@@ -1147,7 +1246,13 @@ class RowShard:
         since_gen = int(meta.get("since_gen", -1))
         tr = meta.get(wire.TRACE_META_KEY) if _trace.enabled() else None
         t0 = time.time() if tr is not None else 0.0
-        with self._lock:
+        with self._lock, self._plane_lock():
+            # plane lock (grouped shards only): a cross-shard SPMD apply
+            # bumps _version under the PLANE lock, so the pin and the
+            # advertised version must be read under it to stay the same
+            # epoch — serving new bytes under an old version only costs
+            # a redundant re-pull, but old bytes under a NEW version
+            # would let the replica dedupe real changes away
             version = self._version + self._native_stats()[1]
             if since >= 0 and version == since and since_gen == gen:
                 self._stat_snapshots += 1
@@ -1343,7 +1448,11 @@ class RowShard:
         takes _stamp_lock) — the reverse order deadlocks a stamped
         punted frame against a concurrent checkpoint."""
         with self._native_mutex(), self._stamp_lock:
-            with self._lock:
+            # grouped shards additionally hold the PLANE lock across the
+            # (version, bytes) read: a cross-shard SPMD apply bumps the
+            # version under the plane lock WITHOUT this shard's lock, so
+            # the shard lock alone no longer makes the pair atomic
+            with self._lock, self._plane_lock():
                 chans = {k: v.to_dict()
                          for k, v in self._replay_seq.items()}
                 version = self._version
@@ -1378,6 +1487,10 @@ class RowShard:
                 f"!= live [{self.lo}, {self.hi})x{self.num_col} — "
                 "partition changed since the save")
         data, leaves = arrays[0], list(arrays[1:])
+        # a grouped shard restores into CLASSIC storage (the restore
+        # rebinds the buffer wholesale — exactly the mutation shape the
+        # stacked plane evicts on)
+        self._plane_evict()
         # native mutex FIRST (same order rule as checkpoint_state)
         with self._native_mutex(), self._stamp_lock:
             with self._lock:
@@ -1424,9 +1537,16 @@ class RowShard:
         self._durable_floor = {k: c.floor
                                for k, c in self._replay_seq.items()}
 
+    # exotic mutations evict a grouped shard back to classic storage
+    # first (always-safe; the stacked fast path is for row add/get
+    # traffic — docs/HOSTPLANE.md "Mesh-sharded data plane")
+    _EVICT_TYPES = frozenset()   # filled below, after svc constants
+
     def _handle(self, msg_type: int, meta: Dict,
                 arrays: Sequence[np.ndarray]
                 ) -> Tuple[Dict, List[np.ndarray]]:
+        if self._plane is not None and msg_type in self._EVICT_TYPES:
+            self._plane_evict()
         if msg_type == svc.MSG_ADD_ROWS:
             local, vals, opt = self._prep_add(meta, arrays)
             tr = (meta.get(wire.TRACE_META_KEY)
@@ -1558,6 +1678,10 @@ class RowShard:
                 self._version += 1
             return {}, []
         raise svc.PSError(f"unknown message type {msg_type}")
+
+
+RowShard._EVICT_TYPES = frozenset(
+    (svc.MSG_SET_ROWS, svc.MSG_ADD_FULL, svc.MSG_SET_STATE))
 
 
 class HashShard(RowShard):

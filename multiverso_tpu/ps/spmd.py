@@ -1,0 +1,688 @@
+"""Mesh-native SPMD data plane: process colocation + stacked shard groups.
+
+Two halves, both opt-in by flag (compiled in everywhere, disarmed by
+default — the fault-plane discipline):
+
+**Process colocation registry + fan-out routing** (flag ``ps_fanout``).
+Every :class:`~multiverso_tpu.ps.service.PSService` registers here under
+``(world key, rank)`` — the world key is the rendezvous identity, so two
+independent worlds in one process can never cross-route. With the flag
+armed, a client's python-plane request to a COLOCATED rank skips the
+localhost socket and dispatches on the client's serial local executor
+straight into the owning service's handler (the general form of the
+local-rank short-circuit that always existed) — per-(client, owner)
+FIFO holds because every routed op of one client rides ONE executor
+queue, so read-your-writes and the send-window fences keep their exact
+contract. Multi-owner fan-outs coalesce into ONE ``MSG_MULTI``
+super-frame per destination process (service._handle_multi dispatches
+the sub-ops across the colocated shards), so an N-shard row op costs
+one dispatch, not N socket round-trips — the reference's worker-side
+``Partition`` fan-out collapsed to its minimum transport cost.
+
+**Stacked shard groups** (flag ``ps_spmd_stack``). Colocated
+``RowShard``\\ s of one table stop being N independent lock+jit islands:
+their storage pools into ONE ``(S, R, C)`` device array sharded over a
+local ``("shards",)`` mesh axis, and the apply/gather paths compile to
+ONE per-device SPMD program (ops/spmd_apply.py) that applies every
+local shard's pending wave — or serves every shard's row gather — in a
+single dispatch. Shards keep their identity (locks, pins, stats,
+replay channels, checkpoints all per shard); only the buffer and the
+dispatch are pooled. Classic per-shard reads materialize a lazy slab
+view (cached per plane epoch; pinned views survive the stack's donated
+swaps because a slice is its own buffer). Exotic mutations (set_rows,
+whole-table adds, state restores) EVICT the shard back to classic
+storage — always-safe, never wrong.
+
+Lock order (everywhere): shard locks BEFORE the plane lock. The plane
+never takes a shard lock; admit/evict take every member's lock in
+sorted order, then the plane's.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from multiverso_tpu.telemetry import flightrec as _flight
+from multiverso_tpu.telemetry import memstats as _memstats
+from multiverso_tpu.utils import config, log
+
+config.define_bool(
+    "ps_fanout", False,
+    "process-coalesced fan-out routing for the async PS: python-plane "
+    "requests to COLOCATED ranks (same process, same world) skip the "
+    "localhost socket and dispatch in-process, and multi-owner row ops "
+    "ship as ONE multi-owner super-frame (MSG_MULTI) per destination "
+    "process instead of one frame per shard. Off by default: the wire "
+    "benches and chaos planes measure the socket path; tools/"
+    "bench_scale.py arms it for the mesh scale curve")
+config.define_bool(
+    "ps_spmd_stack", False,
+    "pool colocated same-table device-backed row shards into one "
+    "mesh-sharded (S, rows, cols) stacked array and compile the "
+    "apply/gather paths to ONE per-device SPMD program per dispatch "
+    "(ops/spmd_apply.py). Engages only for shards that are not "
+    "host-numpy mode, not natively registered, not locally sharded, "
+    "with a row-local-state updater and no sparse dirty-bit protocol; "
+    "anything else keeps the classic per-shard path")
+
+
+# ---------------------------------------------------------------------- #
+# process colocation registry
+# ---------------------------------------------------------------------- #
+_REG_LOCK = threading.RLock()
+# (world key, rank) -> PSService (live services only; close() removes)
+_SERVICES: Dict[Tuple[Any, int], Any] = {}
+# world key -> {table name -> MeshStack}
+_PLANES: Dict[Tuple[Any, str], "MeshStack"] = {}
+
+
+def proc_key(rendezvous) -> Optional[Tuple]:
+    """World identity for colocation decisions: services may only route
+    to each other when they share BOTH a process and a rendezvous (two
+    independent in-process worlds must never cross-route). ``None`` =
+    no rendezvous = single-rank world, nothing to route."""
+    if rendezvous is None:
+        return None
+    d = getattr(rendezvous, "_dir", None)
+    if d is not None:
+        import os
+        return ("file", os.path.abspath(d))
+    ns = getattr(rendezvous, "_ns", None)
+    if ns is not None:
+        return ("jaxkv", ns)
+    return ("obj", id(rendezvous))
+
+
+def register_service(service) -> None:
+    key = getattr(service, "_proc_key", None)
+    if key is None:
+        return
+    with _REG_LOCK:
+        _SERVICES[(key, service.rank)] = service
+
+
+def unregister_service(service) -> None:
+    key = getattr(service, "_proc_key", None)
+    if key is None:
+        return
+    with _REG_LOCK:
+        cur = _SERVICES.get((key, service.rank))
+        if cur is service:
+            del _SERVICES[(key, service.rank)]
+
+
+def colocated_service(key, rank: int):
+    """The LIVE colocated service for ``(key, rank)``, or None. A closed
+    service that never unregistered (crash-shaped teardown) is pruned
+    here so routing observes its death like a dead socket would."""
+    if key is None:
+        return None
+    with _REG_LOCK:
+        svc = _SERVICES.get((key, int(rank)))
+        if svc is None:
+            return None
+        if getattr(svc, "_closed", False):
+            del _SERVICES[(key, int(rank))]
+            return None
+        return svc
+
+
+def colocated_ranks(key) -> List[int]:
+    if key is None:
+        return []
+    with _REG_LOCK:
+        return sorted(r for (k, r), s in _SERVICES.items()
+                      if k == key and not getattr(s, "_closed", False))
+
+
+def reset_registry() -> None:
+    """Test isolation: drop every registration (leaked services keep
+    their threads; the registry must not keep routing to them)."""
+    with _REG_LOCK:
+        _SERVICES.clear()
+        _PLANES.clear()
+
+
+# ---------------------------------------------------------------------- #
+# stacked shard groups
+# ---------------------------------------------------------------------- #
+def shard_eligible(shard) -> bool:
+    """Stacked-grouping eligibility — every condition is a documented
+    invariant the pooled layout preserves by CONSTRUCTION, everything
+    else keeps the classic path (never wrong, only ungrouped):
+
+    * device-backed (``_np_mode`` shards apply with in-place numpy at
+      ~20 us — pooling them would ADD a dispatch, and the native C++
+      server may hold their raw buffer pointer);
+    * not natively registered, not locally device-sharded (the group IS
+      the device placement);
+    * a ROW_LOCAL_STATE updater (per-row elementwise with row-aligned
+      state, so a stacked zero-delta scratch lane is a no-op — adam's
+      global step counter would miscount);
+    * no sparse dirty-bit protocol (its mask snapshot is coupled to the
+      per-shard lock discipline)."""
+    from multiverso_tpu.ps.shard import RowShard
+    from multiverso_tpu.updaters import ROW_LOCAL_STATE
+    return (type(shard) is RowShard
+            and not shard._np_mode
+            and shard._native_ref is None
+            and shard._local_sharding is None
+            and shard._dirty is None
+            and type(shard.updater) in ROW_LOCAL_STATE)
+
+
+def try_join(service, table: str, shard) -> Optional["MeshStack"]:
+    """Admit ``shard`` to its table's process-wide stacked group when
+    the flag is armed and the shard qualifies. Called from
+    ``PSService.register_handler`` — the one point where (service,
+    table, shard) meet. Returns the plane when the shard ended up
+    grouped (it activates at the second member)."""
+    key = getattr(service, "_proc_key", None)
+    if (key is None or not config.get_flag("ps_spmd_stack")
+            or not shard_eligible(shard)):
+        return None
+    with _REG_LOCK:
+        plane = _PLANES.get((key, table))
+        if plane is None:
+            plane = _PLANES[(key, table)] = MeshStack(table)
+    try:
+        plane.admit(shard, service)
+    except Exception as e:   # noqa: BLE001 — grouping is an optimization
+        log.error("spmd: admit of %s shard [%d,%d) failed (%s); shard "
+                  "stays classic", table, shard.lo, shard.hi, e)
+        return None
+    return plane
+
+
+def release_service(service) -> None:
+    """Evict the closing service's shards from their planes (they keep
+    working standalone — e.g. for a final failover checkpoint save) and
+    drop the service from the routing registry. A plane left with no
+    live members is dropped — its stacked device array must not outlive
+    the world it served."""
+    unregister_service(service)
+    key = getattr(service, "_proc_key", None)
+    if key is None:
+        return
+    with _REG_LOCK:
+        planes = [(kt, p) for kt, p in _PLANES.items() if kt[0] == key]
+    for _kt, p in planes:
+        p.release_owner(service)
+    with _REG_LOCK:
+        for kt, p in planes:
+            with p.lock:
+                dead = not any(m is not None for m in p.members) \
+                    and not p._pending
+                if dead:
+                    p.stack = None
+                    p.ustate = None
+                    p._progs.clear()
+            if dead and _PLANES.get(kt) is p:
+                del _PLANES[kt]
+
+
+class MeshStack:
+    """One table's process-wide stacked shard group (see module doc).
+
+    ``members[slot]`` is the slot's RowShard (None = evicted slot; its
+    stack lane goes stale and is simply never addressed again). The
+    stack activates at the second admitted member — a lone shard stays
+    classic, so single-rank worlds never pay the stacked layout."""
+
+    def __init__(self, table: str):
+        self.table = table
+        self.lock = threading.RLock()
+        # serializes admit/evict/rebuild end to end (OUTERMOST, before
+        # any member shard lock): two concurrent admits each capturing
+        # the roster and committing a rebuild would otherwise overwrite
+        # each other's member list — a shard left pointing at a lane a
+        # DIFFERENT shard owns is silent cross-shard corruption
+        self._admit_lock = threading.Lock()
+        self.members: List[Any] = []      # slot -> shard (or None)
+        self._owners: List[Any] = []      # slot -> owning service
+        self._pending: List[Tuple[Any, Any]] = []   # pre-activation
+        self.stack = None                 # (S, R, C) device array
+        self.ustate = None                # tree, leaves (S, ...)
+        self.epoch = 0
+        self.mesh = None
+        self._row_axes = None
+        self._padded: Optional[Tuple[int, int]] = None
+        self._dtype = None
+        self._updater = None
+        self._progs: Dict[Any, Any] = {}
+        self._slot_applies: Dict[int, int] = {}
+        self._slot_waves: Dict[int, Dict[int, int]] = {}
+        self._dispatches = 0
+        self._registered_mem = False
+
+    # ------------------------------------------------------------------ #
+    @property
+    def active(self) -> bool:
+        return self.stack is not None
+
+    def slot_of(self, shard) -> Optional[int]:
+        for i, m in enumerate(self.members):
+            if m is shard:
+                return i
+        return None
+
+    def admit(self, shard, service) -> None:
+        """Admit one shard; activates (builds the stack) at 2+ live
+        members. Compatibility is checked against the group (same
+        dtype/cols/updater type); an incompatible shard stays classic.
+        Serialized end to end on ``_admit_lock``: a concurrent admit's
+        rebuild committing over a stale roster would strand this
+        shard's ``_plane_slot`` on a lane a DIFFERENT shard owns."""
+        with self._admit_lock:
+            with self.lock:
+                have = [s for s in self.members if s is not None]
+                ref = have[0] if have else (self._pending[0][0]
+                                            if self._pending else None)
+                if ref is not None:
+                    if (shard.dtype != ref.dtype
+                            or shard.num_col != ref.num_col
+                            or type(shard.updater)
+                            is not type(ref.updater)):
+                        raise ValueError("incompatible shard for group "
+                                         f"{self.table}")
+                if any(s is shard for s in self.members) or any(
+                        s is shard for s, _ in self._pending):
+                    return
+            # activation/growth mutates member storage: take every
+            # member shard's lock (sorted by row range — deterministic
+            # order), then the plane lock (the global order)
+            self._rebuild(extra=[(shard, service)])
+
+    def release_owner(self, service) -> None:
+        with self.lock:
+            shards = [s for s, o in zip(self.members, self._owners)
+                      if s is not None and o is service]
+            self._pending = [(s, o) for s, o in self._pending
+                             if o is not service]
+        for s in shards:
+            self.evict(s)
+
+    # ------------------------------------------------------------------ #
+    def _locked_members(self, shards):
+        import contextlib
+        stack = contextlib.ExitStack()
+        for s in sorted(shards, key=lambda x: (x.lo, id(x))):
+            stack.enter_context(s._lock)
+        return stack
+
+    def _rebuild(self, extra: Sequence[Tuple[Any, Any]] = ()) -> None:
+        """(Re)build the stacked storage from the members' CURRENT
+        state + ``extra`` joiners. Runs with every involved shard's lock
+        held (applies quiesced), then the plane lock."""
+        import jax
+
+        with self.lock:
+            live = [(s, o) for s, o in zip(self.members, self._owners)
+                    if s is not None]
+            joiners = list(self._pending) + [
+                (s, o) for s, o in extra
+                if not any(s is m for m, _ in live)]
+            roster = live + joiners
+        if len(roster) < 2:
+            with self.lock:
+                self._pending = joiners
+            return
+        shards = [s for s, _ in roster]
+        with self._locked_members(shards):
+            with self.lock:
+                r_max = max(s._padded[0] for s in shards)
+                cols = shards[0].num_col
+                dtype = shards[0].dtype
+
+                def _pad_rows(arr, axis):
+                    arr = np.asarray(arr)
+                    if arr.shape[axis] == r_max:
+                        return arr
+                    widths = [(0, 0)] * arr.ndim
+                    widths[axis] = (0, r_max - arr.shape[axis])
+                    return np.pad(arr, widths)
+
+                datas, states = [], []
+                for s in shards:
+                    # raw storage: a grouped member's _data/_ustate
+                    # properties would route back here
+                    d = (np.asarray(s._data_raw) if s._plane is None
+                         else np.asarray(self._slot_data(s)))
+                    datas.append(_pad_rows(d, 0))
+                    st = (s._ustate_raw if s._plane is None
+                          else self._slot_state(s))
+                    leaves, treedef = jax.tree.flatten(st)
+                    axes = [s._state_row_axis(l) for l in
+                            jax.tree.leaves(st)]
+                    states.append((
+                        [(_pad_rows(l, ax) if ax >= 0 else np.asarray(l))
+                         for l, ax in zip(leaves, axes)], treedef))
+                host_stack = np.stack(datas)
+                tdef = states[0][1]
+                host_state = [np.stack([st[0][i] for st in states])
+                              for i in range(len(states[0][0]))]
+                mesh = self._make_mesh(len(shards))
+                self.mesh = mesh
+                self.stack = self._place(host_stack, mesh)
+                self.ustate = jax.tree.unflatten(
+                    tdef, [self._place(l, mesh) for l in host_state])
+                self._padded = (r_max, cols)
+                self._dtype = dtype
+                self._updater = shards[0].updater
+                self._progs.clear()
+                self.epoch += 1
+                self.members = list(shards)
+                self._owners = [o for _, o in roster]
+                self._pending = []
+                # row-axis tree from the normalized padded shape
+                for s in shards:
+                    s._padded = (r_max, cols)
+                self._row_axes = jax.tree.map(
+                    shards[0]._state_row_axis,
+                    jax.tree.unflatten(tdef, states[0][0]))
+                state_nb = sum(int(l.nbytes) for l in host_state)
+                for i, s in enumerate(shards):
+                    s._plane = self
+                    s._plane_slot = i
+                    s._view_cache = None
+                    s._ustate_view_cache = None
+                    s._data_raw = None
+                    s._ustate_raw = None
+                    # static ledger share (per-shard memory_stats must
+                    # never materialize a view just to report bytes)
+                    s._mem_state_bytes = state_nb // len(shards)
+                if not self._registered_mem:
+                    self._registered_mem = True
+                    _memstats.register(f"spmd[{self.table}]", self)
+        log.debug("spmd: %s stacked %d shards over %s", self.table,
+                  len(shards), "host" if self.mesh is None else
+                  f"{self.mesh.devices.size}-device mesh")
+
+    def _make_mesh(self, s: int):
+        import jax
+        local = jax.local_devices()
+        g = min(s, len(local))
+        while g > 1 and s % g:
+            g -= 1
+        if g <= 1:
+            return None
+        from jax.sharding import Mesh
+        return Mesh(np.asarray(local[:g]), ("shards",))
+
+    def _place(self, host, mesh):
+        import jax
+        import jax.numpy as jnp
+        if mesh is None:
+            return jnp.asarray(host)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        nd = np.ndim(host)
+        spec = P("shards", *([None] * (nd - 1)))
+        return jax.device_put(host, NamedSharding(mesh, spec))
+
+    # ------------------------------------------------------------------ #
+    # per-shard materialized views (classic read paths, checkpoints)
+    # ------------------------------------------------------------------ #
+    def _slice_prog(self):
+        import jax
+        fn = self._progs.get("slice")
+        if fn is None:
+            from multiverso_tpu.ops import spmd_apply
+            fn = self._progs["slice"] = spmd_apply.build_slice()
+        return fn
+
+    def _slot_data(self, shard):
+        """Caller holds the plane lock: the shard's current slab."""
+        import numpy as _np
+        fn = self._slice_prog()
+        return fn(self.stack, _np.int32(shard._plane_slot))
+
+    def _slot_state(self, shard):
+        import jax
+        import numpy as _np
+        fn = self._slice_prog()
+        return jax.tree.map(
+            lambda l: fn(l, _np.int32(shard._plane_slot)), self.ustate)
+
+    def view(self, shard):
+        """The shard's slab as its own device buffer, cached per plane
+        epoch (a stack swap invalidates it; pinned old views stay valid
+        — a slice is an independent buffer, so the stack's donated
+        applies can never touch it)."""
+        with self.lock:
+            if (shard._view_cache is not None
+                    and shard._view_epoch == self.epoch):
+                return shard._view_cache
+            v = self._slot_data(shard)
+            shard._view_cache = v
+            shard._view_epoch = self.epoch
+            return v
+
+    def ustate_view(self, shard):
+        with self.lock:
+            cached = shard._ustate_view_cache
+            if cached is not None:
+                return cached
+            v = self._slot_state(shard)
+            shard._ustate_view_cache = v
+            return v
+
+    def evict(self, shard) -> None:
+        """Materialize the shard back to classic per-shard storage (the
+        always-safe fallback for exotic mutations and teardown). The
+        slot's stack lane goes stale and is never addressed again.
+        ``_admit_lock`` first (the outermost admit/evict serializer):
+        an eviction racing a concurrent admit's rebuild could otherwise
+        be re-admitted from the rebuild's stale roster."""
+        with self._admit_lock, shard._lock:
+            with self.lock:
+                if shard._plane is not self:
+                    return
+                data = self._slot_data(shard)
+                ustate = self._slot_state(shard)
+                slot = shard._plane_slot
+                shard._data_raw = data
+                shard._ustate_raw = ustate
+                shard._plane = None
+                shard._plane_slot = None
+                shard._view_cache = None
+                shard._ustate_view_cache = None
+                self.members[slot] = None
+        log.debug("spmd: %s slot %d evicted to classic storage",
+                  self.table, slot)
+
+    # ------------------------------------------------------------------ #
+    # the SPMD dispatch paths
+    # ------------------------------------------------------------------ #
+    def _bucket(self, n: int) -> int:
+        """Shared power-of-two bucket for one dispatch round — the same
+        shape rule every row path uses (matrix_table._bucket_size), so
+        the compiled-program set is bounded and steady state never
+        recompiles."""
+        from multiverso_tpu.tables.matrix_table import _bucket_size
+        return _bucket_size(n, self._padded[0])
+
+    def _apply_prog(self, bucket: int):
+        key = ("apply", bucket)
+        fn = self._progs.get(key)
+        if fn is None:
+            from multiverso_tpu.ops import spmd_apply
+            fn = self._progs[key] = spmd_apply.build_apply(
+                self._updater, self._row_axes, self.mesh)
+        return fn
+
+    def _gather_prog(self, bucket: int):
+        key = ("gather", bucket)
+        fn = self._progs.get(key)
+        if fn is None:
+            from multiverso_tpu.ops import spmd_apply
+            fn = self._progs[key] = spmd_apply.build_gather(self.mesh)
+        return fn
+
+    def apply_rows(self, shard, local: np.ndarray, vals: np.ndarray,
+                   opt) -> None:
+        """Single-shard apply through the stacked program (the classic
+        ``_apply_rows`` body of a grouped shard redirects here; caller
+        holds the shard's lock — plane lock nests inside, the global
+        order)."""
+        self.apply_grouped([(shard, local, vals, opt)])
+
+    def apply_grouped(self, entries: Sequence[Tuple[Any, np.ndarray,
+                                                    np.ndarray, Any]]
+                      ) -> None:
+        """Apply one wave ROUND — at most one (ids, vals, opt) per
+        member shard — as ONE donated SPMD dispatch. Shards without
+        pending work ride along as all-scratch zero-delta lanes (the
+        same padding discipline every row path uses). Raises on a
+        malformed entry BEFORE dispatch; the program itself is
+        conflict-free by construction (per-shard disjoint slabs)."""
+        import time as _time
+        from multiverso_tpu.ops import spmd_apply
+        from multiverso_tpu.telemetry import devstats as _devstats
+        from multiverso_tpu.updaters import AddOption
+
+        t0 = _time.perf_counter()
+        with self.lock:
+            s_count = len(self.members)
+            by_slot: Dict[int, Tuple[Any, np.ndarray, np.ndarray, Any]] \
+                = {}
+            for shard, local, vals, opt in entries:
+                if shard._plane is not self:
+                    raise RuntimeError(
+                        f"{shard.name}: not grouped in this plane")
+                slot = shard._plane_slot
+                if slot in by_slot:
+                    raise RuntimeError(
+                        f"{self.table}: two waves for slot {slot} in one "
+                        "round")
+                by_slot[slot] = (shard, np.asarray(local, np.int64),
+                                 np.asarray(vals), opt)
+            bucket = self._bucket(max(
+                v[1].size for v in by_slot.values()))
+            cols = self._padded[1]
+            ids = np.empty((s_count, bucket), np.int32)
+            dvals = np.zeros((s_count, bucket, cols), self._dtype)
+            opts: List[Any] = []
+            for slot in range(s_count):
+                ent = by_slot.get(slot)
+                m = self.members[slot]
+                scratch = m.scratch if m is not None else 0
+                if ent is None:
+                    ids[slot] = scratch
+                    opts.append(AddOption())
+                    continue
+                _, local, vals, opt = ent
+                ids[slot, : local.size] = local
+                ids[slot, local.size:] = scratch
+                dvals[slot, : vals.shape[0]] = vals
+                opts.append(opt if opt is not None else AddOption())
+            fn = self._apply_prog(bucket)
+            scope = _devstats.mesh_scope(self.mesh) \
+                if self.mesh is not None else None
+            try:
+                if scope is not None:
+                    scope.__enter__()
+                self.stack, self.ustate = fn(
+                    self.stack, self.ustate, ids, dvals,
+                    spmd_apply.opt_leaves(opts))
+            finally:
+                if scope is not None:
+                    scope.__exit__(None, None, None)
+            self.epoch += 1
+            self._dispatches += 1
+            nbytes = 0
+            for slot, (shard, local, vals, _o) in by_slot.items():
+                shard._version += 1
+                shard._view_cache = None
+                shard._ustate_view_cache = None
+                self._slot_applies[slot] = \
+                    self._slot_applies.get(slot, 0) + 1
+                nbytes += vals.nbytes
+        ms = (_time.perf_counter() - t0) * 1e3
+        for slot, (shard, local, vals, _o) in by_slot.items():
+            shard._mon_apply.observe_ms(ms)
+        _flight.beat("apply")
+        _flight.record(_flight.EV_APPLY, nbytes=nbytes,
+                       note=f"spmd ops={len(by_slot)}")
+
+    def gather_grouped(self, pairs: Sequence[Tuple[Any, np.ndarray]]
+                       ) -> List[np.ndarray]:
+        """Serve every pair's row gather in ONE dispatch; returns the
+        per-pair OWNED host row blocks in input order. Ids are
+        shard-local and validated by the caller."""
+        with self.lock:
+            s_count = len(self.members)
+            bucket = self._bucket(max(p[1].size for p in pairs))
+            ids = np.empty((s_count, bucket), np.int32)
+            rows_of: Dict[int, int] = {}
+            order: List[Tuple[int, int]] = []
+            for shard, local in pairs:
+                if shard._plane is not self:
+                    raise RuntimeError(
+                        f"{shard.name}: not grouped in this plane")
+                slot = shard._plane_slot
+                if slot in rows_of:
+                    raise RuntimeError(
+                        f"{self.table}: duplicate gather slot {slot}")
+                ids[slot, : local.size] = local
+                ids[slot, local.size:] = shard.scratch
+                rows_of[slot] = local.size
+                order.append((slot, local.size))
+            for slot in range(s_count):
+                if slot not in rows_of:
+                    m = self.members[slot]
+                    ids[slot] = m.scratch if m is not None else 0
+            fn = self._gather_prog(bucket)
+            out = np.asarray(fn(self.stack, ids))
+        return [np.ascontiguousarray(out[slot, :n])
+                for slot, n in order]
+
+    # ------------------------------------------------------------------ #
+    # observability
+    # ------------------------------------------------------------------ #
+    def stats_for(self, shard) -> Optional[Dict[str, Any]]:
+        """The shard's slice of the plane for ``stats()['spmd']``:
+        placement (slot -> device) + its share of grouped applies —
+        what mvtop's placement panel renders."""
+        with self.lock:
+            if shard._plane is not self:
+                return None
+            slot = shard._plane_slot
+            total = sum(self._slot_applies.values()) or 0
+            mine = self._slot_applies.get(slot, 0)
+            if self.mesh is not None:
+                # NamedSharding splits the shard axis into CONTIGUOUS
+                # blocks: slots [k*S/G, (k+1)*S/G) live on device k
+                devs = list(self.mesh.devices.reshape(-1))
+                per = max(len(self.members) // len(devs), 1)
+                dev = str(devs[min(slot // per, len(devs) - 1)])
+            else:
+                dev = "host"
+            return {
+                "group": self.table,
+                "slot": slot,
+                "members": sum(1 for m in self.members if m is not None),
+                "device": dev,
+                "applies": mine,
+                "apply_share": (round(mine / total, 4) if total else 0.0),
+                "dispatches": self._dispatches,
+                "stack_bytes": int(getattr(self.stack, "nbytes", 0)),
+            }
+
+    def memory_stats(self) -> Dict[str, Any]:
+        """Byte-ledger gauges for the pooled storage (the per-shard
+        gauges report their slab SHARE; this is the stack itself, incl.
+        lanes kept alive by evicted slots)."""
+        import jax
+        with self.lock:
+            stack_nb = int(getattr(self.stack, "nbytes", 0))
+            state_nb = sum(int(getattr(l, "nbytes", 0))
+                           for l in jax.tree.leaves(self.ustate))
+            live = sum(1 for m in self.members if m is not None)
+            return {"stack_bytes": stack_nb,
+                    "ustate_bytes": state_nb,
+                    "slots": len(self.members),
+                    "live_slots": live,
+                    "dispatches": self._dispatches}

@@ -590,8 +590,7 @@ class _SendWindow:
         with self._cv:
             owners = set(self._pending) | set(self._send_locks)
             self._deadline = None
-        for owner in owners:
-            self._flush_owner(owner)
+        self._flush_owners(owners)
 
     def memory_stats(self) -> Dict[str, Any]:
         """Byte-ledger gauges (telemetry/memstats.py, pull-only): queued
@@ -683,8 +682,7 @@ class _SendWindow:
         # while senders holding those locks block on the cv to queue
         # armed frames — calling it under the cv would be an ABBA
         # deadlock of the table during exactly the failover it serves
-        for owner in owners:
-            self._flush_owner(owner)
+        self._flush_owners(owners)
         return self._replay_step() or bool(owners)
 
     # ------------------------------------------------------------------ #
@@ -694,6 +692,91 @@ class _SendWindow:
             if lock is None:
                 lock = self._send_locks[owner] = threading.Lock()
             return lock
+
+    # shared flush pool for concurrent multi-owner sweeps (class-level,
+    # like the drain-handoff pool: windows are many, the pool is one;
+    # per-owner flushes never block on anything but the owner's send
+    # lock and its socket, so owners never deadlock across threads)
+    _flush_pool: Optional[Any] = None
+    _flush_pool_lock = threading.Lock()
+
+    @classmethod
+    def _flush_executor(cls):
+        with cls._flush_pool_lock:
+            if cls._flush_pool is None:
+                cls._flush_pool = cf.ThreadPoolExecutor(
+                    max_workers=4, thread_name_prefix="ps-flush")
+            return cls._flush_pool
+
+    def _flush_owners(self, owners) -> None:
+        """One multi-owner flush sweep. Colocated owners' frames (flag
+        ps_fanout, replay off) coalesce into ONE multi-owner super-frame
+        — one dispatch per destination process instead of one per shard
+        — and the remaining owners flush CONCURRENTLY on the shared
+        pool instead of serializing their socket sends; the sweep still
+        returns only when every owner's batch is on its conn (the fence
+        contract)."""
+        owners = sorted(owners)
+        if not owners:
+            return
+        t = self._table_ref()
+        routed: List[int] = []
+        if (t is not None and self._replay is None
+                and getattr(t, "_fanout", False) and len(owners) > 1):
+            routed = [o for o in owners
+                      if o == t.ctx.rank
+                      or o in getattr(t, "_routed_set", ())]
+            if len(routed) < 2:
+                routed = []
+        rest = [o for o in owners if o not in routed]
+        if routed:
+            self._flush_coalesced(t, routed)
+        if len(rest) > 1:
+            pool = self._flush_executor()
+            futs = [pool.submit(self._flush_owner, o) for o in rest]
+            cf.wait(futs)
+            # propagate the first failure AFTER every owner flushed —
+            # the serial loop surfaced flush exceptions (encode/packing
+            # re-raises), and swallowing one here would stall the
+            # popped entries' waiters to the full timeout instead
+            for f in futs:
+                f.result()
+        elif rest:
+            self._flush_owner(rest[0])
+
+    def _flush_coalesced(self, t, owners: List[int]) -> None:
+        """Pop + merge every routed owner's queue under ALL their send
+        locks (sorted — deterministic, so concurrent sweeps cannot
+        deadlock) and ship the collected frames as ONE multi-owner
+        super-frame; the packed inner replies fan back out to each
+        frame's window futures. Locks are held until the super-frame is
+        dispatched, so a later frame to any of these owners cannot
+        overtake the batch (the same ordering the per-owner send lock
+        buys the classic path)."""
+        collected: List[Tuple] = []
+        with contextlib.ExitStack() as st:
+            for o in owners:
+                st.enter_context(self._send_lock(o))
+            for o in owners:
+                with self._cv:
+                    entries = self._pending.pop(o, None)
+                    self._nbytes.pop(o, None)
+                if entries:
+                    self._send(o, entries, collect=collected.append)
+            if not collected:
+                return
+            subs = []
+            frames = []
+            for owner, msg_type, meta, arrays, gfuts in collected:
+                meta = dict(meta)
+                meta[wire_mod.OWNER_META_KEY] = owner
+                subs.append((msg_type, meta, arrays))
+                frames.append((owner, gfuts))
+            pfuts = t.ctx.service.multi_local(subs)
+        for (owner, gfuts), pf in zip(frames, pfuts):
+            pf.add_done_callback(
+                lambda bf, gf=gfuts, o=owner:
+                    _complete_window_futures(bf, gf, owner=o))
 
     def _flush_owner(self, owner: int) -> None:
         """Merge + ship one owner's queue as one frame. The send lock is
@@ -709,7 +792,14 @@ class _SendWindow:
             if entries:
                 self._send(owner, entries)
 
-    def _send(self, owner: int, entries: List[Tuple]) -> None:
+    def _send(self, owner: int, entries: List[Tuple],
+              collect=None) -> None:
+        """``collect`` (coalesced multi-owner sweep): instead of
+        dispatching each wire frame, hand ``(owner, msg_type, meta,
+        arrays, gfuts)`` to the collector — the sweep ships every
+        owner's frames as ONE super-frame and fans the inner replies
+        back to ``gfuts``. Never used with replay armed (stamped frames
+        keep their per-owner retained dispatch)."""
         t = self._table_ref()
         if t is None:
             # table died with queued adds (caller dropped it without a
@@ -830,6 +920,13 @@ class _SendWindow:
                 # request)
                 self._dispatch_retained(t, owner, msg_type, meta,
                                         frame_arrays, gfuts)
+                continue
+            if collect is not None:
+                # coalesced sweep: the caller ships this frame inside
+                # one multi-owner super-frame (trace ack spans stay off
+                # this path like the replay one — the fan-out future is
+                # not the wire request)
+                collect((owner, msg_type, meta, frame_arrays, gfuts))
                 continue
             req = t.ctx.service.request(owner, msg_type, meta,
                                         frame_arrays, meta_b=meta_b)
@@ -1336,6 +1433,26 @@ class _GetWindow:
         req.add_done_callback(_done)
 
 
+def _part_len(ix) -> int:
+    """Row count of an ``_owner_slices`` indexer (slice or positions)."""
+    return ix.stop - ix.start if isinstance(ix, slice) else ix.size
+
+
+def _part_index(ix) -> np.ndarray:
+    """An ``_owner_slices`` indexer as explicit positions (the chunk
+    sinks scatter by position array)."""
+    return (np.arange(ix.start, ix.stop) if isinstance(ix, slice)
+            else ix)
+
+
+def _owned_part(arr: np.ndarray, ix) -> np.ndarray:
+    """``arr[ix]`` as OWNED bytes (deferred in-process dispatch reads
+    the part later): fancy indexing already copies, a slice view gets
+    an explicit copy."""
+    part = arr[ix]
+    return part.copy() if isinstance(ix, slice) else part
+
+
 def _maybe_register_in_zoo(table) -> Optional[int]:
     """Async tables join the Zoo registry (checkpoint walk, C ABI) when the
     runtime is up; standalone PSContext tests run without a Zoo."""
@@ -1631,6 +1748,29 @@ class AsyncMatrixTable(_AsyncBase):
                          min((r + 1) * self._rows_per, self.num_row))
                         for r in range(world)]
         self._ranges = [(r, a, b) for r, a, b in self._ranges if b > a]
+        # process-coalesced fan-out (ps/spmd.py, flag ps_fanout):
+        # owners whose PSService shares this process AND this world
+        # route in-process — their wire is raw like the local rank's
+        # (no socket = compression buys nothing), multi-owner fan-outs
+        # coalesce into ONE MSG_MULTI super-frame, and the native fast
+        # path stays off (routing pins ordering to the one local
+        # executor queue, the same rule as the send window). Captured
+        # at construct: tables are built after the world's services,
+        # and a routed rank dying/respawning changes liveness, not
+        # membership.
+        self._routed_set: frozenset = frozenset()
+        # with the plane armed, EVERY in-process dispatch (local rank
+        # included) runs INLINE on the caller thread — sub arrays are
+        # consumed before the call returns, so the deferred-read
+        # defensive copies below are skipped
+        self._inline = bool(config.get_flag("ps_fanout"))
+        if self._inline:
+            from multiverso_tpu.ps import spmd as _spmd
+            key = getattr(self.ctx.service, "_proc_key", None)
+            self._routed_set = frozenset(
+                r for r in _spmd.colocated_ranks(key)
+                if r < world and r != self.ctx.rank)
+        self._fanout = bool(self._routed_set)
         self._make_window(send_window_ms)
         # client get coalescer (flag get_window_ms / per-table override):
         # None = every get is its own frame (the default)
@@ -1644,6 +1784,11 @@ class AsyncMatrixTable(_AsyncBase):
             # other op must share that per-conn FIFO for the fences to
             # mean read-your-writes, so the native fast path (its own
             # socket = no cross-plane ordering) stays off for this table
+            self._native_ok = False
+        if self._fanout:
+            # routed ops ride the client's local executor queue; a
+            # native add racing them on its own socket would break the
+            # per-owner ordering the routing plane guarantees
             self._native_ok = False
         # hot-row TRAINING cache (flag train_cache_rows; ISSUE 11): cached
         # rows serve gets locally, only cold rows cross the wire. Write-
@@ -1716,16 +1861,69 @@ class AsyncMatrixTable(_AsyncBase):
         return _dedupe_batch(row_ids, self.num_col, self.dtype,
                              self.num_row, values)
 
+    def _owner_slices(self, uids: np.ndarray) -> List[Tuple[int, Any]]:
+        """Partition an id batch into per-owner ``(rank, indexer)``
+        parts. Sorted batches (every ``_prep`` dedupe output) get ONE
+        boundary ``searchsorted`` pass and contiguous ``slice``
+        indexers (zero-copy views); caller-ordered batches (``_prep``'s
+        no-duplicate fast path — the PR-5 searchsorted-on-unsorted
+        lesson) get vectorized per-owner position arrays, so each
+        part's consumption is O(part), never an O(n) mask scan per use.
+        ``arr[indexer]`` works for both shapes;
+        :func:`_part_len`/:func:`_part_index` give size/positions. This
+        is the ONE partition implementation — ``_by_owner`` and the
+        native ``_owner_conns`` derive from it. Measured vs the
+        per-owner mask generator: 100k sorted ids over 8 owners
+        592 -> 108 us (5.5x), the 256-row strided train shape
+        31.8 -> 15.6 us (2x), single-owner 9.9 -> 0.8 us (13x)."""
+        n = uids.size
+        if n == 0:
+            return []
+        rp = self._rows_per
+        first = int(uids[0]) // rp
+        last = int(uids[-1]) // rp
+        if (first <= last
+                and (n == 1 or bool(np.all(uids[1:] >= uids[:-1])))):
+            # sorted batch (every np.unique dedupe output — the common
+            # shape): O(owners · log n) boundary searchsorted, no
+            # per-id division, no masks. Single-owner batches (the
+            # small-add hot path) cost the monotonicity check alone.
+            if first == last:
+                return [(first, slice(0, n))]
+            bounds = np.searchsorted(
+                uids,
+                np.arange(first + 1, last + 1, dtype=np.int64) * rp)
+            starts = [0] + [int(b) for b in bounds] + [n]
+            return [(r, slice(starts[i], starts[i + 1]))
+                    for i, r in enumerate(range(first, last + 1))
+                    if starts[i + 1] > starts[i]]
+        # caller-ordered batch (_prep's no-duplicate fast path): one
+        # vectorized division + per-owner position extraction — the
+        # owner count is small (<= world), so this stays O(owners · n)
+        # vectorized compares, never a python per-uid loop
+        owners = uids // rp
+        r0 = int(owners[0])
+        if not np.any(owners != r0):
+            return [(r0, slice(0, n))]
+        return [(int(r), np.flatnonzero(owners == r))
+                for r in np.unique(owners)]
+
     def _by_owner(self, uids: np.ndarray):
-        owners = uids // self._rows_per
-        for r in np.unique(owners):
-            yield int(r), owners == r
+        """Mask-shaped compatibility wrapper over :meth:`_owner_slices`
+        for callers that still want boolean masks."""
+        n = uids.size
+        for r, ix in self._owner_slices(uids):
+            m = np.zeros(n, bool)
+            m[ix] = True
+            yield r, m
 
     def _wire_for(self, rank: int) -> str:
-        """Wire codec per destination: the local rank short-circuits the
-        socket, so compressing its payload would cost two casts (and bf16
+        """Wire codec per destination: the local rank — and any
+        in-process ROUTED rank (ps_fanout) — short-circuits the socket,
+        so compressing its payload would cost two casts (and bf16
         precision) for zero transport savings."""
-        return "none" if rank == self.ctx.rank else self._wire
+        return ("none" if rank == self.ctx.rank
+                or rank in self._routed_set else self._wire)
 
     def _reply_wire(self) -> str:
         """Reply wire for gets, rank-independent: 1bit/topk apply to
@@ -1735,8 +1933,10 @@ class AsyncMatrixTable(_AsyncBase):
         return "bf16" if self._wire in ("1bit", "topk") else self._wire
 
     def _get_wire_for(self, rank: int) -> str:
-        """Reply wire per source rank (local short-circuit stays raw)."""
-        return "none" if rank == self.ctx.rank else self._reply_wire()
+        """Reply wire per source rank (local short-circuit and routed
+        in-process ranks stay raw)."""
+        return ("none" if rank == self.ctx.rank
+                or rank in self._routed_set else self._reply_wire())
 
     def _owner_conns(self, uids: np.ndarray):
         """Native conns for the C-side fanout, indexed by rank. ONLY the
@@ -1746,8 +1946,10 @@ class AsyncMatrixTable(_AsyncBase):
         stay None, which the fanout reads as no-rows/unreachable."""
         svc_ = self.ctx.service
         conns = [None] * self.ctx.world
-        for r in np.unique(uids // self._rows_per).tolist():
-            conns[r] = svc_.native_conn_or_none(int(r))
+        # owner set from the shared one-searchsorted partition pass —
+        # no O(n) division/unique sweep over the id batch
+        for r, _sl in self._owner_slices(uids):
+            conns[r] = svc_.native_conn_or_none(r)
         return conns
 
     def _native_flush(self) -> None:
@@ -1795,20 +1997,20 @@ class AsyncMatrixTable(_AsyncBase):
                 # queue as ONE (multi-op) frame. Single-owner batches (the
                 # 1-row small-add hot path) skip the mask partitioning.
                 t_enq0 = time.time() if tid is not None else 0.0
-                owners = uids // self._rows_per
-                r0 = int(owners[0])
-                if uids.size == 1 or not np.any(owners != r0):
+                oparts = self._owner_slices(uids)
+                if len(oparts) == 1:
                     # the queue reads vals LATER (flusher thread), so it
                     # must own the bytes: _prep's no-dup path can return
                     # a zero-copy view of the caller's buffer, and a
                     # reused gradient scratch would corrupt queued deltas
-                    # (mask slicing below always copies)
+                    # (multi-owner slicing below always copies)
                     if vals is values or vals.base is not None:
                         vals = vals.copy()
-                    parts = [(r0, uids, vals)]
+                    parts = [(oparts[0][0], uids, vals)]
                 else:
-                    parts = [(r, uids[m], vals[m])
-                             for r, m in self._by_owner(uids)]
+                    parts = [(r, _owned_part(uids, ix),
+                              _owned_part(vals, ix))
+                             for r, ix in oparts]
                 mid = self._track(self._window.submit(parts, opt, tid),
                                   op="ps.add")
                 if tid is not None:
@@ -1830,7 +2032,32 @@ class AsyncMatrixTable(_AsyncBase):
                     op="ps.add")
             t_send0 = time.time() if tid is not None else 0.0
             futs = []
-            for r, m in self._by_owner(uids):
+            parts = self._owner_slices(uids)
+            rest = parts
+            if self._fanout and len(parts) > 1:
+                # multi-owner fan-out to COLOCATED owners coalesces
+                # into ONE super-frame per destination process (the
+                # client's local-executor hop) — one dispatch instead
+                # of one frame per shard; non-colocated owners keep
+                # their classic per-owner frames below
+                grp = [i for i, (r, _ix) in enumerate(parts)
+                       if r == self.ctx.rank or r in self._routed_set]
+                if len(grp) > 1:
+                    gset = set(grp)
+                    rest = [p for i, p in enumerate(parts)
+                            if i not in gset]
+                    subs = []
+                    for i in grp:
+                        r, ix = parts[i]
+                        meta = wire_mod.with_trace(
+                            {"table": self.name, "opt": opt._asdict(),
+                             wire_mod.OWNER_META_KEY: r}, tid)
+                        # object sub-ops, no wire framing, consumed
+                        # INLINE by multi_local — views are safe
+                        subs.append((svc.MSG_ADD_ROWS, meta,
+                                     [uids[ix], vals[ix]]))
+                    futs.extend(self.ctx.service.multi_local(subs))
+            for r, ix in rest:
                 w = self._wire_for(r)
                 # meta and blobs per destination wire: the local short-
                 # circuit stays uncompressed, remote peers get the codec
@@ -1839,9 +2066,20 @@ class AsyncMatrixTable(_AsyncBase):
                     {"table": self.name, "opt": opt._asdict()}, tid)
                 if tid is not None and w != "none":
                     meta["wire"] = w
+                # deferred in-process dispatch (the legacy local-rank
+                # executor path, plane off) reads the arrays LATER:
+                # own the bytes. With the plane armed the dispatch is
+                # inline — views are safe.
+                deferred = (not self._inline
+                            and (r == self.ctx.rank
+                                 or r in self._routed_set))
+                ids_part = (_owned_part(uids, ix) if deferred
+                            else uids[ix])
+                vals_part = (_owned_part(vals, ix) if deferred
+                             else vals[ix])
                 futs.append(self.ctx.service.request(
                     r, svc.MSG_ADD_ROWS, meta,
-                    [uids[m]] + wire_mod.encode_payload(vals[m], w),
+                    [ids_part] + wire_mod.encode_payload(vals_part, w),
                     meta_b=(None if tid is not None
                             else self._add_meta_b(opt, w))))
             if tid is not None:
@@ -1986,19 +2224,19 @@ class AsyncMatrixTable(_AsyncBase):
                     return buf if inv is None else buf[inv]
 
                 return futs, _assemble_native
-            parts = list(self._by_owner(uids))
+            parts = self._owner_slices(uids)
             if self._get_window is not None:
                 # coalesced single-flight fetches: each part resolves to
                 # its own row block (possibly served by a batch shared
                 # with concurrent callers)
-                futs = [self._get_window.fetch(int(r), uids[m])
-                        for r, m in parts]
+                futs = [self._get_window.fetch(r, _owned_part(uids, ix))
+                        for r, ix in parts]
 
                 def _assemble_win(results):
                     buf = self._reply_buffer(out if inv is None else None,
                                              uids.size)
-                    for (r, m), rows in zip(parts, results):
-                        buf[m] = rows
+                    for (r, ix), rows in zip(parts, results):
+                        buf[ix] = rows
                     if inv is None:
                         return buf
                     dest = self._reply_buffer(out, inv.size)
@@ -2014,10 +2252,14 @@ class AsyncMatrixTable(_AsyncBase):
             t_send0 = time.time() if tid is not None else 0.0
             meta_b = wire_mod.pack_meta(wire_mod.with_trace(
                 {"table": self.name, "wire": gw}, tid))
-            will_chunk = {r for r, m in parts
-                          if (chunk > 0
-                              and int(np.count_nonzero(m)) > chunk
-                              and r != self.ctx.rank)}
+            # in-process destinations (local rank / routed colocated
+            # ranks) never chunk-stream: there is no network receive to
+            # overlap, and routed multi-owner parts coalesce below
+            inproc = {r for r, _ix in parts
+                      if r == self.ctx.rank or r in self._routed_set}
+            will_chunk = {r for r, ix in parts
+                          if (chunk > 0 and _part_len(ix) > chunk
+                              and r not in inproc)}
             # the scatter target exists BEFORE dispatch when a part may
             # stream back chunked: the sinks decode each sub-frame on
             # the recv thread straight into it, overlapping the receive.
@@ -2028,37 +2270,66 @@ class AsyncMatrixTable(_AsyncBase):
             buf = self._reply_buffer(
                 out if inv is None and not will_chunk else None,
                 uids.size)
-            futs = []
+            futs_by_part: Dict[int, Any] = {}
             chunked: Dict[int, bool] = {}
-            for r, m in parts:
+            grp: List[Tuple[int, Tuple[int, slice]]] = []
+            if self._fanout and len(parts) > 1:
+                grp = [(i, p) for i, p in enumerate(parts)
+                       if p[0] in inproc]
+                if len(grp) < 2:
+                    grp = []
+            if grp:
+                # multi-owner fan-out to colocated owners: ONE
+                # super-frame, one grouped SPMD gather at the other end
+                # (object sub-ops — no wire framing in-process)
+                subs = []
+                for _i, (r, ix) in grp:
+                    subs.append((svc.MSG_GET_ROWS,
+                                 wire_mod.with_trace(
+                                     {"table": self.name,
+                                      "wire": "none",
+                                      wire_mod.OWNER_META_KEY: r}, tid),
+                                 [uids[ix]]))
+                for (i, _p), f in zip(
+                        grp, self.ctx.service.multi_local(subs)):
+                    futs_by_part[i] = f
+            for i, (r, ix) in enumerate(parts):
+                if i in futs_by_part:
+                    continue
                 if r in will_chunk:
-                    futs.append(self.ctx.service.request(
+                    futs_by_part[i] = self.ctx.service.request(
                         r, svc.MSG_GET_ROWS,
                         wire_mod.with_trace(
                             {"table": self.name, "wire": gw,
                              "chunk": chunk}, tid),
-                        [uids[m]],
+                        [uids[ix]],
                         chunk_sink=_chunk_scatter(
-                            buf, np.flatnonzero(m), self.num_col,
-                            self.dtype)))
+                            buf, _part_index(ix),
+                            self.num_col, self.dtype))
                     chunked[r] = True
                 else:
-                    futs.append(self.ctx.service.request(
+                    # legacy executor dispatch (plane off) reads the
+                    # ids later: own the bytes; inline = views safe
+                    ids_part = (_owned_part(uids, ix)
+                                if r in inproc and not self._inline
+                                else uids[ix])
+                    futs_by_part[i] = self.ctx.service.request(
                         r, svc.MSG_GET_ROWS,
                         wire_mod.with_trace(
                             {"table": self.name, "wire": "none"}, tid),
-                        [uids[m]], meta_b=meta_b))
+                        [ids_part], meta_b=meta_b)
+            futs = [futs_by_part[i] for i in range(len(parts))]
             if tid is not None:
                 _attach_reply_span(futs, "client.get_rows", t_send0, tid,
                                    self.name)
 
             def _assemble(results):
-                for (r, m), (rmeta, arrays) in zip(parts, results):
+                for (r, ix), (rmeta, arrays) in zip(parts, results):
                     if chunked.get(r) and rmeta.get("chunks"):
                         continue   # the sinks already scattered this part
-                    w = "none" if r == self.ctx.rank else gw
-                    buf[m] = wire_mod.decode_payload(
-                        arrays, w, (int(np.count_nonzero(m)),
+                    w = "none" if r in inproc else gw
+                    buf[ix] = wire_mod.decode_payload(
+                        arrays, w, (_part_len(ix),
                                     self.num_col), self.dtype)
                 if inv is None:
                     if (out is not None and buf is not out

@@ -87,6 +87,14 @@ REPLAY_SEQ_KEY = "seq"       # request: per-(client, table) sequence
 REPLAY_DURABLE_KEY = "dseq"  # reply: durable high-water mark for cl
 REPLAY_DUP_KEY = "dup"       # reply: frame was a dedup'd duplicate
 
+# Multi-owner super-frame sub-op addressing (MSG_MULTI, ps/spmd.py):
+# each inner frame of a super-frame names its OWNING rank here, so the
+# receiving process can dispatch it to the right colocated shard. The
+# native C++ server's meta whitelist does not know the key — a
+# super-frame always punts to Python, like MSG_BATCH. Absent key = the
+# receiving rank owns the sub-op.
+OWNER_META_KEY = "ow"
+
 
 def with_trace(meta: Dict, trace) -> Dict:
     """Meta dict + trace ID (no-op passthrough for ``trace=None`` so

@@ -62,7 +62,12 @@ config.define_float(
 _SHARD_SCALARS = ("kind", "lo", "rows", "adds", "applies", "gets",
                   "get_bytes", "add_bytes", "queue_depth",
                   "pending_bytes", "version", "keys", "dirty_rows",
-                  "cow_applies")
+                  "cow_applies",
+                  # mesh-stacked placement block (ps/spmd.py): slot ->
+                  # device + grouped-apply share — mvtop's placement
+                  # panel renders it per shard (a dict, passed through
+                  # whole like the scalars)
+                  "spmd")
 # fields summed into the per-table cluster totals
 _TABLE_SUMS = ("adds", "applies", "gets", "get_bytes", "add_bytes",
                "queue_depth", "rows")

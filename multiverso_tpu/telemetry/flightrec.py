@@ -198,6 +198,13 @@ MSG_EV_COVERAGE = {
     "MSG_HEALTH": (),        # probe: excluded from the tape (PR 4)
     "MSG_SNAPSHOT": (EV_SNAPSHOT_SERVE, EV_REPLICA_PULL,
                      EV_FAULT_INJECT),
+    # multi-owner super-frame (ps/spmd.py, flag ps_fanout): carries
+    # add/get sub-ops for every colocated shard of the destination
+    # process — grouped applies land EV_APPLY (note "spmd ops=K"),
+    # grouped gathers EV_GET_SERVE, per-sub batch dispatch EV_WAVE,
+    # and the wire path the ordinary send/recv edges
+    "MSG_MULTI": (EV_SEND, EV_RECV, EV_APPLY, EV_WAVE, EV_GET_SERVE,
+                  EV_FAULT_INJECT),
 }
 
 

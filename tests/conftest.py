@@ -116,6 +116,12 @@ def _fresh_runtime():
     # scenario must not inject into its neighbors' wires
     from multiverso_tpu.ps import faults as _faults
     _faults.disarm()
+    # mesh data plane (ISSUE 15): drop the process-colocation registry
+    # and any stacked shard groups — a leaked service must not stay
+    # routable, and a plane's pooled device array must not outlive its
+    # test (services that closed cleanly already unregistered)
+    from multiverso_tpu.ps import spmd as _spmd
+    _spmd.reset_registry()
     # flight-recorder plane: drop the ring/in-flight table and stop the
     # watchdog so one test's wedged ops can't trip a neighbor's verdict;
     # unpin the logger's rank stamp too (first-caller-wins, like the

@@ -520,6 +520,25 @@ class TestRunBenchScale:
         assert flag_regressions(prev, prev) == []
         assert flag_regressions({"extra": {}}, worse) == []
 
+    def test_per_point_efficiency_regression_flagged(self):
+        """ISSUE 15: E_2 / E_4 are tracked as their OWN keys — a drop
+        at one point must flag even when the curve's min (a different
+        point) holds."""
+        from tools.run_bench import flag_regressions
+        prev = {"extra": {"scale": {"efficiency_min": 0.1,
+                                    "e2": 0.8, "e4": 0.4,
+                                    "t1_rows_per_s": 4000}}}
+        e2_drop = {"extra": {"scale": {"efficiency_min": 0.1,
+                                       "e2": 0.3, "e4": 0.4,
+                                       "t1_rows_per_s": 4000}}}
+        flags = flag_regressions(prev, e2_drop)
+        assert len(flags) == 1 and "E_2" in flags[0]
+        e4_drop = {"extra": {"scale": {"efficiency_min": 0.1,
+                                       "e2": 0.8, "e4": 0.15,
+                                       "t1_rows_per_s": 4000}}}
+        flags = flag_regressions(prev, e4_drop)
+        assert len(flags) == 1 and "E_4" in flags[0]
+
     def test_history_entry_and_append(self, tmp_path):
         from tools.run_bench import append_history, history_entry
         rec = {"complete": True, "truncated": False,
@@ -631,6 +650,18 @@ def test_bench_scale_smoke_two_points():
     assert r["efficiency"]["1"] == 1.0
     assert 0 < r["efficiency"]["2"] == r["efficiency_min"]
     assert r["t1_rows_per_s"] == c1["rows_per_s"]
+    # ISSUE 15: constant offered load at every point, the per-point
+    # E_n scalars feeding run_bench, and the mesh-data-plane gates —
+    # bit-parity vs the 1-shard oracle and zero steady recompiles —
+    # asserted through the real subprocess path
+    assert c1["workers"] == c2["workers"] == r["workers"]
+    assert r["e2"] == r["efficiency"]["2"]
+    assert r["fanout"] is True and r["spmd_stack"] is True
+    assert r["parity_bit_for_bit"] is True
+    assert r["steady_recompiles"] == 0
+    # the stacked SPMD plane compiled under its own mesh label
+    assert any(k.startswith("{'shards':")
+               for k in r["compiles_by_mesh"])
     # the hygiene gate RAN and passed for both mesh shapes
     assert r["hygiene_clean"] is True and r["hygiene_checked"] >= 2
     # device-plane attribution came back mesh-keyed

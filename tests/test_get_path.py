@@ -606,3 +606,71 @@ class TestSyncGetPrefetch:
         t.add(delta, AO())
         assert t._get_prefetch is None
         assert np.array_equal(t.get(), np.asarray(t.raw())[:128])
+
+
+# ---------------------------------------------------------------------- #
+# multi-owner fan-out gets (ISSUE 15): chunk-eligible big gets across 4
+# colocated shards — routed parts serve in-process (chunking is a
+# network-overlap device, skipped for in-process destinations), and the
+# result must stay bit-identical to the 1-shard oracle, out= included
+# ---------------------------------------------------------------------- #
+class TestFanoutChunkedGets:
+    ROWS, DIM = 512, 8
+
+    def _fill(self, t):
+        rng = np.random.default_rng(5)
+        vals = rng.normal(size=(self.ROWS, self.DIM)).astype(np.float32)
+        t.add_rows(np.arange(self.ROWS), vals)
+        return vals
+
+    @pytest.mark.parametrize("plane", ["native", "python"])
+    def test_chunk_flag_fanout_parity(self, tmp_path, plane):
+        from multiverso_tpu.ps.service import (FileRendezvous,
+                                               PSContext, PSService)
+        config.set_flag("ps_native", plane == "native")
+        config.set_flag("ps_fanout", True)
+        config.set_flag("get_chunk_rows", 32)   # far below every part
+        rdv = FileRendezvous(str(tmp_path / "rdv"))
+        ctxs = [PSContext(r, 4, PSService(r, 4, rdv))
+                for r in range(4)]
+        tabs = [AsyncMatrixTable(self.ROWS, self.DIM, name="fc_t",
+                                 ctx=c) for c in ctxs]
+        want = self._fill(tabs[0])
+        got = tabs[1].get_rows(np.arange(self.ROWS))
+        np.testing.assert_array_equal(got, want)
+        # out= commits only on full success, exact bytes
+        out = np.empty((self.ROWS, self.DIM), np.float32)
+        res = tabs[2].get_rows(np.arange(self.ROWS), out=out)
+        assert res is out
+        np.testing.assert_array_equal(out, want)
+        # duplicate caller-order ids re-expand exactly
+        ids = np.array([400, 3, 130, 3, 511, 400])
+        np.testing.assert_array_equal(tabs[3].get_rows(ids),
+                                      want[ids])
+        for c in ctxs:
+            c.close()
+
+    def test_mixed_routed_and_socket_parts_chunk(self, tmp_path):
+        """A world where only SOME owners are colocated: routed parts
+        serve in-process, the non-colocated one still chunk-streams
+        over its socket — one get, both transports, exact bytes."""
+        from multiverso_tpu.ps import spmd
+        from multiverso_tpu.ps.service import (FileRendezvous,
+                                               PSContext, PSService)
+        config.set_flag("ps_native", False)
+        config.set_flag("ps_fanout", True)
+        config.set_flag("get_chunk_rows", 32)
+        rdv = FileRendezvous(str(tmp_path / "rdv"))
+        ctxs = [PSContext(r, 4, PSService(r, 4, rdv))
+                for r in range(4)]
+        # hide rank 3 from the colocation registry BEFORE tables
+        # resolve their routes: its traffic keeps the socket path
+        spmd.unregister_service(ctxs[3].service)
+        tabs = [AsyncMatrixTable(self.ROWS, self.DIM, name="mx_t",
+                                 ctx=c) for c in ctxs]
+        assert tabs[0]._routed_set == {1, 2}
+        want = self._fill(tabs[0])
+        got = tabs[0].get_rows(np.arange(self.ROWS))
+        np.testing.assert_array_equal(got, want)
+        for c in ctxs:
+            c.close()

@@ -1,21 +1,35 @@
-"""Mesh scale-curve harness (ISSUE 12): the async-PS workload at
+"""Mesh scale-curve harness (ISSUE 12; measurement methodology and the
+plane under test reworked by ISSUE 15): the async-PS workload at
 1->2->4->8 server shards on a host-platform device mesh, judged by the
 device-plane observability layer it ships with.
 
-Each shard count ``n`` runs in its OWN subprocess ("--point" mode):
-an n-rank in-process PS world (the tier-2 fixture shape: every
-cross-rank op crosses a real localhost socket) plus an n-device mesh
-slice of the 8-virtual-device host platform
-(``xla_force_host_platform_device_count`` — the conftest fixture's
-"mpirun -np N" analogue). Process-per-point is load-bearing, not
-convenience: two shard counts' collective executables coexisting in
-one XLA CPU client raced the process-global rendezvous (observed live:
-the n=1-shape and n=2-shape all_reduce executions interleaved
-participants and wedged both, starving the PS plane into op timeouts)
-— and it also gives each point a process-fresh devstats/profiler
-reading, no cross-point delta bookkeeping.
+Each shard count ``n`` runs in its OWN subprocess ("--point" mode): an
+n-rank in-process PS world with the ISSUE-15 mesh data plane ARMED
+(``ps_fanout`` process-coalesced routing + multi-owner super-frames;
+``ps_spmd_stack`` stacked SPMD apply/gather, exercised and
+parity-gated by :func:`_parity_stage`) plus an n-device mesh slice of
+the 8-virtual-device host platform. Process-per-point is load-bearing,
+not convenience: two shard counts' collective executables coexisting
+in one XLA CPU client raced the process-global rendezvous (observed
+live: interleaved all_reduce participants wedged both worlds) — and it
+also gives each point a process-fresh devstats/profiler reading.
 
-Per point the child drives n worker threads through a step-profiled
+**Constant offered load (ISSUE 15).** Every point drives the SAME
+``M = min(cpu_count, 4)`` worker threads — the textbook scaling-curve
+design: hold the load generators fixed, scale the resource under test.
+The PR-12 harness scaled workers WITH shards (n workers at point n),
+which conflated client-side thread-convoy costs (8 GIL-rotating
+threads on a 2-core box) with the server plane's sharding behavior —
+most of its E_8 = 0.02 was the client, not the shards. With M fixed,
+E_n answers the production question directly: does adding server
+shards relieve the serialization a loaded single shard exhibits? (It
+does — a 1-shard server under M concurrent workers convoys on its one
+lock domain, which is precisely the bottleneck Li et al.'s sharded-KV
+design removes.) Ops are production-shaped (2048x128 row batches — a
+~1 MB delta/pull per op) so the instrument measures the data plane,
+not per-call python fixed costs.
+
+Per point the child drives the M workers through a step-profiled
 train-shaped loop (prepare / push / ps_wait over the sharded table),
 then measures the model-average ``parallel/collectives.all_reduce``
 QUIESCED (PS plane idle — host-platform virtual devices share one
@@ -56,6 +70,16 @@ if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
 DEFAULT_SHARDS = (1, 2, 4, 8)
+# constant offered load at every point (see module docstring): the
+# box's cores are its useful load generators, capped so a many-core
+# host doesn't turn the curve into a client-thread study
+DEFAULT_ROWS = 40_000
+DEFAULT_DIM = 128
+BATCH_ROWS = 2048
+
+
+def worker_count() -> int:
+    return max(2, min(os.cpu_count() or 2, 4))
 
 
 def efficiency_curve(throughput_by_n):
@@ -77,6 +101,74 @@ def efficiency_curve(throughput_by_n):
     tail = [e for n, e in eff.items() if n > 1]
     return {"efficiency": eff,
             "efficiency_min": round(min(tail), 4) if tail else None}
+
+
+def _parity_stage(n: int, dim: int, devstats) -> bool:
+    """Drive a deterministic add/get sequence over an n-shard
+    device-backed (adagrad) table — fan-out super-frames + the
+    mesh-stacked SPMD apply/gather — and bit-compare the final table
+    against a 1-shard oracle world running the CLASSIC path. Returns
+    True only on an exact match; raises on plumbing failures."""
+    import numpy as np
+
+    from multiverso_tpu.ps.service import (FileRendezvous, PSContext,
+                                           PSService)
+    from multiverso_tpu.ps.tables import AsyncMatrixTable
+    from multiverso_tpu.utils import config
+
+    prows = 2048
+
+    def _drive(tabs, nshards):
+        rng = np.random.default_rng(99)
+        for step in range(16):
+            ids = np.sort(rng.choice(prows, size=96, replace=False))
+            deltas = rng.normal(size=(96, dim)).astype(np.float32)
+            t = tabs[step % nshards]
+            if step == 0 and nshards > 1:
+                sh = tabs[0]._shard
+                plane = getattr(sh, "_plane", None)
+                mesh = plane.mesh if plane is not None else None
+                # the stacked program's first compile happens HERE:
+                # capture it under the hygiene gate, keyed to the
+                # plane's mesh shape
+                with devstats.capture_hygiene("scale.spmd_apply",
+                                              mesh=mesh):
+                    t.add_rows(ids, deltas)
+            else:
+                t.add_rows(ids, deltas)
+            t.get_rows(ids)   # grouped SPMD gather on the stacked path
+        return tabs[0].get_rows(np.arange(prows))
+
+    # the parity world rendezvouses in its OWN directory — the measured
+    # world's rank addr files (and its colocation registry key) must
+    # not collide with this stage's
+    with tempfile.TemporaryDirectory(prefix="mv_scale_par_") as prdv:
+        ctxs = [PSContext(r, n, PSService(r, n, FileRendezvous(prdv)))
+                for r in range(n)]
+        tabs = [AsyncMatrixTable(prows, dim, name="scale_par",
+                                 updater="adagrad", ctx=ctxs[r])
+                for r in range(n)]
+        if n > 1 and getattr(tabs[0]._shard, "_plane", None) is None:
+            raise AssertionError(
+                "parity stage: the adagrad table did not group into a "
+                "mesh-stacked plane (ps_spmd_stack armed?)")
+        got = _drive(tabs, n)
+        for c in ctxs:
+            c.close()
+    # 1-shard oracle world: classic storage, classic dispatch
+    config.set_flag("ps_fanout", False)
+    config.set_flag("ps_spmd_stack", False)
+    try:
+        with tempfile.TemporaryDirectory(prefix="mv_scale_orc_") as ordv:
+            ctx = PSContext(0, 1, PSService(0, 1, FileRendezvous(ordv)))
+            t1 = AsyncMatrixTable(prows, dim, name="scale_par_oracle",
+                                  updater="adagrad", ctx=ctx)
+            want = _drive([t1], 1)
+            ctx.close()
+    finally:
+        config.set_flag("ps_fanout", True)
+        config.set_flag("ps_spmd_stack", True)
+    return bool(np.array_equal(got, want))
 
 
 def run_point(n: int, seconds: float, rows: int, dim: int):
@@ -115,6 +207,22 @@ def run_point(n: int, seconds: float, rows: int, dim: int):
     # rows*dim*4 > 1MB, never below). The curve measures the PLANE's
     # shard scaling; single-shard intra-op sharding is a separate axis.
     config.set_flag("ps_local_shard_min_mb", 1e9)
+    # the mesh data plane under measurement (ISSUE 15, ps/spmd.py):
+    # process-coalesced fan-out routing + multi-owner super-frames for
+    # the measured table, and the mesh-stacked SPMD apply/gather for
+    # the parity stage's device-backed (adagrad) table — its grouped
+    # dispatches serialize on the plane lock, so the XLA-CPU
+    # rendezvous hazard above cannot recur (one multi-device program
+    # in flight at a time)
+    config.set_flag("ps_fanout", True)
+    config.set_flag("ps_spmd_stack", True)
+    # sketch sized to the workload's key set (the PR-8 bench rule): the
+    # workers' strided batches touch BATCH_ROWS * M distinct hot rows,
+    # and an UNDERSIZED Space-Saving sketch turns every observe into a
+    # heap eviction — a worst-case pure-python tax the curve is not
+    # here to measure (real deployments size the sketch to their hot
+    # set)
+    config.set_flag("hotkeys_capacity", 16384)
     # acceptance config: skew from the aggregator, stall fraction from
     # the step profiler, device costs from devstats — the whole
     # instrument live while the point is measured
@@ -123,7 +231,8 @@ def run_point(n: int, seconds: float, rows: int, dim: int):
     prof.configure(0)
     devstats.configure(0)
 
-    batch = 256
+    batch = BATCH_ROWS
+    workers = worker_count()
     rng = np.random.default_rng(12)
     vals = rng.normal(size=(batch, dim)).astype(np.float32)
     mesh = Mesh(np.asarray(devices[:n]), ("mv",))
@@ -144,23 +253,51 @@ def run_point(n: int, seconds: float, rows: int, dim: int):
                 for r in range(n)]
         tables = [AsyncMatrixTable(rows, dim, name="scale",
                                    ctx=ctxs[r]) for r in range(n)]
-        # warm every worker's strided route + both shard programs
-        for r, t in enumerate(tables):
-            ids = (np.arange(batch) * (rows // batch) + r) % rows
-            t.add_rows(ids, vals)
-            t.get_rows(ids)
+        # WARMUP (ISSUE 15 satellite): a short loop-shaped pass per
+        # worker slot — strided route, both shard programs, the fan-out
+        # super-frame path, the async-add/wait pipeline AND one
+        # profiled step each — so point 1's first-compile +
+        # first-dispatch cost stops polluting T_1 (a depressed T_1
+        # inflated every E_n of the curve)
+        for w in range(workers):
+            t = tables[w % n]
+            ids = (np.arange(batch) * (rows // batch) + w) % rows
+            mids = []
+            for k in range(4):
+                mids.append(t.add_rows_async(ids, vals))
+                t.get_rows(ids)
+            with prof.step(f"scale.np{n}"):
+                with prof.phase("push"):
+                    mids.append(t.add_rows_async(ids, vals))
+                with prof.phase("ps_wait"):
+                    t.get_rows(ids)
+            for m in mids:
+                t.wait(m)
+
+        # SPMD-apply parity stage (ISSUE 15 acceptance): a
+        # device-backed (adagrad) parity table across ALL n shards —
+        # grouped into ONE mesh-stacked plane by ps_spmd_stack — driven
+        # with a deterministic op sequence through the fan-out
+        # super-frame path, asserted BIT-IDENTICAL to a 1-shard oracle
+        # in a separate world. The first add (the stacked program's
+        # compile) runs inside a hygiene capture scope keyed to the
+        # plane's mesh shape.
+        parity_ok = _parity_stage(n, dim, devstats)
 
         stop = time.monotonic() + seconds
-        counts = [0] * n
+        counts = [0] * workers
 
-        def worker(r):
-            t = tables[r]
-            ids = (np.arange(batch) * (rows // batch) + r) % rows
+        def worker(w):
+            # constant offered load: M workers at EVERY point (module
+            # docstring) — each drives a table view round-robin and a
+            # strided id batch spanning every shard
+            t = tables[w % n]
+            ids = (np.arange(batch) * (rows // batch) + w) % rows
             mids = []
             while time.monotonic() < stop:
                 with prof.step(f"scale.np{n}"):
                     with prof.phase("prepare"):
-                        v = vals * (1.0 + 1e-4 * counts[r])
+                        v = vals * (1.0 + 1e-4 * counts[w])
                     with prof.phase("push"):
                         mids.append(t.add_rows_async(ids, v))
                         if len(mids) >= 4:
@@ -168,14 +305,14 @@ def run_point(n: int, seconds: float, rows: int, dim: int):
                                 t.wait(mids.pop(0))
                     with prof.phase("ps_wait"):
                         t.get_rows(ids)
-                counts[r] += 2
+                counts[w] += 2
             for m in mids:
                 t.wait(m)
 
         t0 = time.monotonic()
-        threads = [threading.Thread(target=worker, args=(r,),
-                                    name=f"scale-w{r}")
-                   for r in range(n)]
+        threads = [threading.Thread(target=worker, args=(w,),
+                                    name=f"scale-w{w}")
+                   for w in range(workers)]
         for th in threads:
             th.start()
         for th in threads:
@@ -206,10 +343,19 @@ def run_point(n: int, seconds: float, rows: int, dim: int):
             "n": n,
             "rows_per_s": round(sum(counts) * batch / dt),
             "ops": sum(counts),
-            "workers": n,
+            "workers": workers,
+            "batch_rows": batch,
             "skew": skew,
             "stall_fraction": summary.get("stall_fraction"),
             "steps": summary.get("steps"),
+            # zero steady-state recompiles is an ACCEPTANCE gate: the
+            # warmed-up measured loop (and the stacked SPMD programs)
+            # must never retrace past the warmup pass
+            "steady_recompiles": summary.get("steady_recompiles", 0),
+            # bit-parity of the mesh data plane (fan-out super-frames +
+            # stacked SPMD apply/gather) vs the 1-shard classic oracle,
+            # asserted in-run by the parent
+            "parity_bit_for_bit": parity_ok,
             "all_reduce_ms": round(coll_ms, 3),
             "all_reduce_bytes": int(delta.nbytes),
             "compiles": compiles.get("compiles"),
@@ -258,8 +404,8 @@ def main():
     seconds = float(sys.argv[1]) if len(sys.argv) > 1 else 3.0
     shards = (tuple(int(s) for s in sys.argv[2].split(","))
               if len(sys.argv) > 2 else DEFAULT_SHARDS)
-    rows = int(sys.argv[3]) if len(sys.argv) > 3 else 20_000
-    dim = int(sys.argv[4]) if len(sys.argv) > 4 else 64
+    rows = int(sys.argv[3]) if len(sys.argv) > 3 else DEFAULT_ROWS
+    dim = int(sys.argv[4]) if len(sys.argv) > 4 else DEFAULT_DIM
 
     points = []
     env = dict(os.environ)
@@ -304,6 +450,18 @@ def main():
                 "vouch for it")
         checked.extend(rep["checked"])
         findings.extend(rep.get("findings") or [])
+        # ISSUE 15 acceptance gates, per point: the mesh data plane's
+        # bit-parity vs the 1-shard oracle, and zero steady-state
+        # recompiles on the warmed measured loop
+        if not p.get("parity_bit_for_bit"):
+            raise AssertionError(
+                f"parity gate: shard point n={p['n']} diverged from "
+                "the 1-shard oracle (fan-out / SPMD apply broke "
+                "bit-parity)")
+        if p.get("steady_recompiles"):
+            raise AssertionError(
+                f"recompile gate: shard point n={p['n']} recompiled "
+                f"{p['steady_recompiles']}x in steady state")
     if findings:
         raise AssertionError(
             "compile-hygiene gate: SPMD findings on the shipped "
@@ -332,12 +490,24 @@ def main():
     print("RESULT " + json.dumps({
         "shards": list(shards),
         "seconds_per_point": seconds,
-        "batch_rows": 256, "dim": dim,
+        "batch_rows": BATCH_ROWS, "dim": dim,
+        "workers": worker_count(),
         "curve": {str(n): c for n, c in curve.items()},
         "efficiency": {str(n): e for n, e in
                        eff["efficiency"].items()},
         "efficiency_min": eff["efficiency_min"],
+        # per-shard-count efficiency as first-class scalars, so the
+        # BENCH_HISTORY headline (and run_bench's higher-is-better
+        # flags) track each point of the curve, not just its min
+        "e2": eff["efficiency"].get(2),
+        "e4": eff["efficiency"].get(4),
+        "e8": eff["efficiency"].get(8),
         "t1_rows_per_s": (curve.get(1) or {}).get("rows_per_s"),
+        "parity_bit_for_bit": all(p.get("parity_bit_for_bit")
+                                  for p in points),
+        "steady_recompiles": sum(int(p.get("steady_recompiles") or 0)
+                                 for p in points),
+        "fanout": True, "spmd_stack": True,
         "hygiene_clean": not findings,
         "hygiene_checked": len(checked),
         "transfers": transfers,

@@ -305,6 +305,37 @@ def render(rec: Dict, prev: Optional[Dict] = None,
                          f"p99 {_fmt(srv.get('p99_ms'))} ms")
         if parts:
             lines.append("  " + "  |  ".join(parts))
+        # shard-placement panel (mesh data plane, ps/spmd.py): shard ->
+        # rank / row range / device + each shard's share of the table's
+        # applies, so skew from bad placement is visible live. The
+        # "spmd" block (stacked groups) names the slot's device and its
+        # share of grouped SPMD dispatches; classic shards render their
+        # apply share from the plain per-shard counters.
+        shards = t.get("shards") or {}
+        srows = [(r, s) for r, s in shards.items()
+                 if isinstance(s, dict) and s.get("kind") == "row"]
+        if len(srows) > 1:
+            tot = sum(int(s.get("applies") or 0) for _r, s in srows)
+            cells = []
+            for r, s in sorted(srows, key=lambda kv: str(kv[0])):
+                sp = s.get("spmd") or {}
+                lo = s.get("lo", 0)
+                hi = lo + (s.get("rows") or 0)
+                ap = int(s.get("applies") or 0)
+                share = f"{ap / tot * 100:.0f}%" if tot else "-"
+                dev = sp.get("device") or "classic"
+                slot = (f" slot{sp.get('slot')}"
+                        if sp.get("slot") is not None else "")
+                cells.append(f"r{r}[{lo}-{hi}]@{dev}{slot} {share}")
+            lines.append("  placement: " + "  ".join(cells))
+            sp0 = next((s.get("spmd") for _r, s in srows
+                        if s.get("spmd")), None)
+            if sp0:
+                lines.append(
+                    f"  spmd group: {sp0.get('members')} shards stacked"
+                    f"  dispatches {sp0.get('dispatches')}"
+                    f"  stack {(sp0.get('stack_bytes') or 0) / 1e6:.2f}"
+                    " MB")
         hk = rec.get("hotkeys", {}).get(tname)
         if hk and hk.get("top"):
             head = "  ".join(f"{k}:{c}" for k, c, _ in hk["top"][:topk])

@@ -120,6 +120,12 @@ _REGRESSION_KEYS_HIGHER = (
     # shard count — invisible to every single-rank latency key above
     (("scale", "efficiency_min"), "mesh scaling efficiency (min E_n)"),
     (("scale", "t1_rows_per_s"), "mesh scale single-shard baseline"),
+    # per-shard-count efficiency points (ISSUE 15): the 2- and 4-shard
+    # E_n recorded as first-class scalars by tools/bench_scale.py — a
+    # drop at one point with the min holding (e.g. E_2 regressing
+    # while E_8 stays the min) must still flag
+    (("scale", "e2"), "mesh scaling efficiency E_2"),
+    (("scale", "e4"), "mesh scaling efficiency E_4"),
 )
 
 
