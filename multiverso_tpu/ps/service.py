@@ -71,6 +71,7 @@ from multiverso_tpu.telemetry import exporter as _exporter
 from multiverso_tpu.telemetry import flightrec as _flight
 from multiverso_tpu.telemetry import memstats as _memstats
 from multiverso_tpu.telemetry import profiler as _profiler
+from multiverso_tpu.telemetry import tenants as _tenants
 from multiverso_tpu.telemetry import trace as _trace
 from multiverso_tpu.telemetry import watchdog as _watchdog
 from multiverso_tpu.utils import config, log, retry as _retry
@@ -1032,6 +1033,18 @@ class PSService:
             devices = _devstats.stats_snapshot()
             if devices:
                 payload["devices"] = devices
+        except Exception:   # noqa: BLE001
+            pass
+        # tenant attribution plane (telemetry/tenants.py): per-tenant
+        # serve ledger + budgets + the noisy-neighbor verdict sweep
+        # (the pull drives one sweep interval). Process-global like
+        # serving ((host, pid) dedupe in the aggregator); OMITTED when
+        # no tenant traffic was ever accounted — consumers render its
+        # absence as "-", never a KeyError.
+        try:
+            tenants = _tenants.stats_snapshot()
+            if tenants:
+                payload["tenants"] = tenants
         except Exception:   # noqa: BLE001
             pass
         return payload

@@ -48,6 +48,7 @@ from multiverso_tpu.table import _ceil_to
 from multiverso_tpu.telemetry import flightrec as _flight
 from multiverso_tpu.telemetry import hotkeys as _hotkeys
 from multiverso_tpu.telemetry import memstats as _memstats
+from multiverso_tpu.telemetry import tenants as _tenants
 from multiverso_tpu.tables.matrix_table import _bucket_size
 from multiverso_tpu.telemetry import trace as _trace
 from multiverso_tpu.updaters import AddOption, Updater
@@ -315,6 +316,14 @@ class RowShard:
         # only (natively-served ops bypass it, same rule as tracing).
         cap = _config.get_flag("hotkeys_capacity")
         self._hotkeys = (_hotkeys.SpaceSaving(cap) if cap > 0 else None)
+        # tenant attribution (telemetry/tenants.py): per-tenant op/byte
+        # counters at the same chokepoints as the byte counters above.
+        # Default-tenant path is one attribute read + one dict increment
+        # (benign-race, same tolerance as _stat_gets); named tenants —
+        # the wire-stamped minority — pay the meter's lock and feed its
+        # Space-Saving ranking. Python-plane only, same rule as the
+        # hot-key sketch (stamped frames always punt).
+        self._tenants = _tenants.TenantMeter()
         # apply latency histogram (the p50/p99 of one updater dispatch)
         self._mon_apply = Dashboard.get(f"ps[{name}].apply")
         # native shard PIN once the native server serves this shard's hot
@@ -536,6 +545,12 @@ class RowShard:
             out["dirty_rows"] = dirty_rows   # sparse-protocol staleness
         if self._hotkeys is not None:
             out["hotkeys"] = self._hotkeys.to_dict()
+        # per-tenant op/byte counters (telemetry/tenants.py): omitted
+        # until the meter counts — the aggregator sums these per rank,
+        # unlike the process-global "tenants" MSG_STATS block
+        tm = self._tenants.to_dict()
+        if tm:
+            out["tenants"] = tm
         # mesh-stacked group placement (ps/spmd.py): slot -> device plus
         # this shard's share of the plane's grouped applies — mvtop's
         # shard-placement panel renders skew from bad placement off it
@@ -1017,8 +1032,12 @@ class RowShard:
         # (like _stat_adds counts requests): the coalescing queue
         # merges K overlapping adds into one deduped apply, and
         # counting at apply time would underreport by up to Kx
-        self._stat_add_bytes += sum(int(getattr(a, "nbytes", 0))
-                                    for a in arrays[1:])
+        nbytes = sum(int(getattr(a, "nbytes", 0)) for a in arrays[1:])
+        self._stat_add_bytes += nbytes
+        # tenant attribution rides the SAME per-request chokepoint (an
+        # unstamped frame is the default tenant — one dict increment)
+        self._tenants.note(meta.get(wire.TENANT_META_KEY),
+                           add_bytes=nbytes)
         return local, vals, opt
 
     def _prep_add_entry(self, meta: Dict, arrays: Sequence[np.ndarray]
@@ -1296,20 +1315,27 @@ class RowShard:
         w = meta.get("wire", "none")
         chunk = int(meta.get("chunk", 0) or 0)
         if chunk > 0 and rows.shape[0] > chunk:
-            return self._chunked_reply(rows, w, chunk, tr)
+            return self._chunked_reply(rows, w, chunk, tr,
+                                       meta.get(wire.TENANT_META_KEY))
         t0 = time.time() if tr is not None else 0.0
         payload = wire.encode_payload(rows, w)
         # ENCODED reply bytes (what actually crosses the wire — a topk/
         # 1bit reply is ~16-29x smaller than the gathered f32 rows);
         # feeds the aggregator's wire-bytes/s honestly
-        self._stat_get_bytes += sum(int(a.nbytes) for a in payload)
+        nbytes = sum(int(a.nbytes) for a in payload)
+        self._stat_get_bytes += nbytes
+        # every reply-encoded read (get, full-get, snapshot pull) is one
+        # tenant op at the same chokepoint as the byte counter above
+        self._tenants.note(meta.get(wire.TENANT_META_KEY),
+                           get_bytes=nbytes)
         if tr is not None:
             _trace.add_span("shard.get_encode", t0, time.time(), trace=tr,
                             args={"table": self.name, "wire": w})
         return {}, payload
 
     def _chunked_reply(self, rows: np.ndarray, w: str, chunk: int,
-                       tr: Optional[int]) -> Tuple[Dict, Any]:
+                       tr: Optional[int],
+                       tn: Optional[str] = None) -> Tuple[Dict, Any]:
         """Stream a big get as self-describing sub-frames: the service
         sends each (MSG_REPLY_CHUNK) as the generator yields, so the
         client's decode + out= scatter overlaps the network receive
@@ -1318,6 +1344,9 @@ class RowShard:
         n = rows.shape[0]
         nchunks = -(-n // chunk)
         self._stat_chunks += nchunks
+        # one tenant op per streamed request (bytes ride per chunk below
+        # — counted as they encode, same lazy cadence as the byte stat)
+        self._tenants.note(tn)
         shard = self
 
         def gen():
@@ -1328,8 +1357,9 @@ class RowShard:
                     cmeta["wire"] = w
                 t0 = time.time() if tr is not None else 0.0
                 payload = wire.encode_payload(rows[a:b], w)
-                shard._stat_get_bytes += sum(int(x.nbytes)
-                                             for x in payload)
+                cbytes = sum(int(x.nbytes) for x in payload)
+                shard._stat_get_bytes += cbytes
+                shard._tenants.note(tn, ops=0, get_bytes=cbytes)
                 if tr is not None:
                     _trace.add_span("shard.get_encode", t0, time.time(),
                                     trace=tr,
@@ -1599,6 +1629,8 @@ class RowShard:
             # sparse replies ship [mask, stale rows] uncompressed: that
             # pair IS the wire payload
             self._stat_get_bytes += mask.nbytes + rows.nbytes
+            self._tenants.note(meta.get(wire.TENANT_META_KEY),
+                               get_bytes=mask.nbytes + rows.nbytes)
             return {}, [mask, rows]
         if msg_type == svc.MSG_GET_ROWS:
             return self._serve_get_rows(meta, arrays)

@@ -95,6 +95,15 @@ REPLAY_DUP_KEY = "dup"       # reply: frame was a dedup'd duplicate
 # receiving rank owns the sub-op.
 OWNER_META_KEY = "ow"
 
+# Tenant attribution (telemetry/tenants.py): the effective tenant id of
+# the CALLER rides here on add/get/window/pull frames so the owning
+# shard can account per-tenant op/byte counters. Stamped ONLY for
+# non-default tenants — default traffic keeps the cached meta bytes and
+# the native fast path. The native C++ server's meta whitelist does not
+# know the key, so stamped frames punt to the Python handler like every
+# modern meta key: one accounting implementation on both wire planes.
+TENANT_META_KEY = "tn"
+
 
 def with_trace(meta: Dict, trace) -> Dict:
     """Meta dict + trace ID (no-op passthrough for ``trace=None`` so
@@ -103,6 +112,16 @@ def with_trace(meta: Dict, trace) -> Dict:
         return meta
     meta = dict(meta)
     meta[TRACE_META_KEY] = trace
+    return meta
+
+
+def with_tenant(meta: Dict, tenant) -> Dict:
+    """Meta dict + tenant id (no-op passthrough for the default tenant
+    so call sites stay branch-free, mirroring :func:`with_trace`)."""
+    if not tenant:
+        return meta
+    meta = dict(meta)
+    meta[TENANT_META_KEY] = tenant
     return meta
 
 

@@ -390,6 +390,114 @@ def merge_cluster(stats_by_rank: Dict[int, Any],
                 + int(d["hygiene_findings"]))
     if devices:
         rec["devices"] = {"ranks": devices, "totals": dev_totals}
+    # tenant attribution plane (telemetry/tenants.py): the MSG_STATS
+    # "tenants" block is PROCESS-global like serving (one ledger per OS
+    # process), so serve counters/episodes dedupe by (host, pid);
+    # latency histograms merge exactly (shared bucket table). Shard-side
+    # meters (shards[<table>]["tenants"]) are per-shard objects like the
+    # hot-key sketches: summed per rank, never deduped. ADDITIVE — a
+    # payload without the block contributes nothing.
+    ten_tables: Dict[str, Dict] = {}
+    ten_hists: Dict[tuple, List] = {}
+    ten_adm: Dict[str, Dict] = {}
+    ten_episodes = 0
+    ten_active = False
+    ten_verdict: Optional[Dict] = None
+    seen_ten: set = set()
+    wire_tenants: Dict[str, Dict[str, int]] = {}
+    wire_sketches: List[Dict] = []
+    for r in sorted(stats_by_rank):
+        st = stats_by_rank[r]
+        if not isinstance(st, dict):
+            continue
+        # shard meters: per-shard, per-rank — no proc dedupe
+        for tname, sh in st.get("shards", {}).items():
+            tm = sh.get("tenants") if isinstance(sh, dict) else None
+            if not isinstance(tm, dict):
+                continue
+            for tn, c in tm.items():
+                if tn == "~sketch":
+                    if isinstance(c, dict):
+                        wire_sketches.append(c)
+                    continue
+                if not isinstance(c, dict):
+                    continue
+                w = wire_tenants.setdefault(
+                    tn, {"ops": 0, "add_bytes": 0, "get_bytes": 0})
+                for k in ("ops", "add_bytes", "get_bytes"):
+                    w[k] += int(c.get(k) or 0)
+        ten = st.get("tenants")
+        if not isinstance(ten, dict):
+            continue
+        proc = _proc_key(st, r)
+        if proc in seen_ten:
+            continue
+        seen_ten.add(proc)
+        ten_episodes += int(ten.get("episodes") or 0)
+        ten_active = ten_active or bool(ten.get("active"))
+        v = ten.get("verdict")
+        if isinstance(v, dict) and (ten_verdict is None
+                                    or (v.get("ts") or 0)
+                                    > (ten_verdict.get("ts") or 0)):
+            ten_verdict = v
+        for tname, tens in (ten.get("tables") or {}).items():
+            if not isinstance(tens, dict):
+                continue
+            tt = ten_tables.setdefault(tname, {})
+            for tn, e in tens.items():
+                if not isinstance(e, dict):
+                    continue
+                ent = tt.setdefault(tn, {"served": 0, "shed": 0,
+                                         "deferred": 0, "max_age_s": 0.0})
+                for k in ("served", "shed", "deferred"):
+                    ent[k] += int(e.get(k) or 0)
+                age = float(e.get("max_age_s") or 0.0)
+                if age > ent["max_age_s"]:
+                    ent["max_age_s"] = age
+                ten_hists.setdefault((tname, tn), []).append(
+                    e.get("infer"))
+        for k, a in (ten.get("admission") or {}).items():
+            if not isinstance(a, dict):
+                continue
+            e = ten_adm.get(k)
+            if e is None:
+                ten_adm[k] = dict(a)
+            else:
+                e["admitted"] += int(a.get("admitted") or 0)
+                e["shed"] += int(a.get("shed") or 0)
+                if e.get("qps_limit") is None:
+                    e["qps_limit"] = a.get("qps_limit")
+    if ten_tables or wire_tenants or ten_adm:
+        share_ops: Dict[str, int] = {}
+        for tname, tt in ten_tables.items():
+            for tn, ent in tt.items():
+                ent["infer"] = merge_hist_dicts(
+                    ten_hists.get((tname, tn), []))
+                dem = ent["served"] + ent["shed"]
+                ent["shed_rate"] = (round(ent["shed"] / dem, 4)
+                                    if dem else None)
+                share_ops[tn] = share_ops.get(tn, 0) + dem
+        tot_ops = sum(share_ops.values())
+        tblock: Dict[str, Any] = {
+            "tables": ten_tables,
+            "shares": ({tn: round(d / tot_ops, 4)
+                        for tn, d in sorted(share_ops.items())}
+                       if tot_ops else {}),
+            "episodes": ten_episodes,
+            "active": ten_active,
+        }
+        if ten_verdict is not None:
+            tblock["verdict"] = ten_verdict
+        if ten_adm:
+            tblock["admission"] = ten_adm
+        if wire_tenants:
+            tblock["wire"] = wire_tenants
+        if wire_sketches:
+            merged = _hotkeys.merge_sketches(wire_sketches, key=str)
+            tblock["sketch"] = {"total": merged["total"],
+                                "observed": merged["observed"],
+                                "top": merged["items"][:32]}
+        rec["tenants"] = tblock
     if hot:
         rec["hotkeys"] = {}
         for tname, sketches in hot.items():
@@ -473,6 +581,27 @@ def derive_rates(prev: Optional[Dict], cur: Dict) -> Optional[Dict]:
             "shed_per_s": round(
                 max(ent.get("shed", 0) - p.get("shed", 0), 0) / dt, 2),
         }
+    # tenant plane: per-(table, tenant) interval rates, written INTO
+    # the merged tenant entries (same discipline as serving — counters
+    # absent from either end of the interval sit it out)
+    prev_ten = (prev.get("tenants") or {}).get("tables") or {}
+    for tname, tt in ((cur.get("tenants") or {}).get("tables")
+                      or {}).items():
+        pt = prev_ten.get(tname)
+        if not isinstance(pt, dict):
+            continue
+        for tn, ent in tt.items():
+            p = pt.get(tn)
+            if not isinstance(p, dict):
+                continue
+            ent["rates"] = {
+                "served_per_s": round(
+                    max(ent.get("served", 0)
+                        - p.get("served", 0), 0) / dt, 2),
+                "shed_per_s": round(
+                    max(ent.get("shed", 0)
+                        - p.get("shed", 0), 0) / dt, 2),
+            }
     cur["rates"] = rates
     return rates
 
@@ -519,6 +648,10 @@ def compact_record(rec: Dict, top: int = 8,
         # per-rank RSS/device/ledger digests + cluster totals (already
         # compact) — run_bench compares peak figures run-over-run
         out["memory"] = rec["memory"]
+    if rec.get("tenants"):
+        # per-tenant serve/shed/share digest + verdict state (already
+        # merged compact) — run_bench compares victim-tenant p99/shed
+        out["tenants"] = rec["tenants"]
     mons: Dict[str, Any] = {}
     for n, m in sorted(rec.get("monitors", {}).items()):
         if not m.get("timed"):

@@ -165,6 +165,58 @@ def prometheus_text(payload: Dict) -> str:
         if dev.get("hygiene_findings"):
             lines.append(f'mv_dev_hygiene_findings{{rank="{rank}"}} '
                          f"{dev['hygiene_findings']}")
+    # tenant attribution plane (telemetry/tenants.py): per-(table,
+    # tenant) serve counters + latency gauges + verdict state off the
+    # MSG_STATS "tenants" block. Absent block = no series, like the
+    # device plane.
+    ten = payload.get("tenants")
+    if isinstance(ten, dict):
+        lines.append("# TYPE mv_tenant_served_total counter")
+        lines.append("# TYPE mv_tenant_shed_total counter")
+        lines.append("# TYPE mv_tenant_deferred_total counter")
+        lines.append("# TYPE mv_tenant_p99_ms gauge")
+        lines.append("# TYPE mv_tenant_share gauge")
+        lines.append("# TYPE mv_tenant_episodes counter")
+        for table in sorted(ten.get("tables") or {}):
+            tt = ten["tables"][table]
+            if not isinstance(tt, dict):
+                continue
+            for tn in sorted(tt):
+                e = tt[tn]
+                if not isinstance(e, dict):
+                    continue
+                lbl = (f'{{table="{_prom_name(table)}",'
+                       f'tenant="{_prom_name(tn)}",rank="{rank}"}}')
+                lines.append(f"mv_tenant_served_total{lbl} "
+                             f"{e.get('served', 0)}")
+                lines.append(f"mv_tenant_shed_total{lbl} "
+                             f"{e.get('shed', 0)}")
+                lines.append(f"mv_tenant_deferred_total{lbl} "
+                             f"{e.get('deferred', 0)}")
+                lines.append(f"mv_tenant_max_age_s{lbl} "
+                             f"{e.get('max_age_s', 0)}")
+                h = e.get("infer") or {}
+                if h.get("timed"):
+                    lines.append(f"mv_tenant_p50_ms{lbl} "
+                                 f"{h.get('p50_ms', 0.0)}")
+                    lines.append(f"mv_tenant_p99_ms{lbl} "
+                                 f"{h.get('p99_ms', 0.0)}")
+        for tn, sh in sorted((ten.get("shares") or {}).items()):
+            if isinstance(sh, (int, float)):
+                lines.append(f'mv_tenant_share{{tenant='
+                             f'"{_prom_name(tn)}",rank="{rank}"}} {sh}')
+        for k, a in sorted((ten.get("admission") or {}).items()):
+            if not isinstance(a, dict):
+                continue
+            lbl = f'{{budget="{_prom_name(k)}",rank="{rank}"}}'
+            lines.append(f"mv_tenant_budget_admitted{lbl} "
+                         f"{a.get('admitted', 0)}")
+            lines.append(f"mv_tenant_budget_shed{lbl} "
+                         f"{a.get('shed', 0)}")
+        lines.append(f'mv_tenant_episodes{{rank="{rank}"}} '
+                     f"{ten.get('episodes', 0)}")
+        lines.append(f'mv_tenant_verdict_active{{rank="{rank}"}} '
+                     f"{1 if ten.get('active') else 0}")
     return "\n".join(lines) + "\n"
 
 

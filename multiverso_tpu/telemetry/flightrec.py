@@ -132,6 +132,14 @@ EV_COLL_END = 39          # collective op returned to the caller
 # mark the scenario's envelope on the same tape
 EV_FAULT_INJECT = 40      # one fault injected into the wire plane
 EV_FAULT_PLANE = 41       # fault plane armed / disarmed / phase flip
+# tenant attribution plane (telemetry/tenants.py): a per-tenant budget
+# shed is POLICY, not incident — it lands its own event (note carries
+# "table:tenant") so a chaos run's sheds read as intended throttling in
+# postmortem timelines; the noisy-neighbor verdict is one event per
+# episode, deduped by the ledger until the condition clears (the same
+# discipline as the EV_MEM_* verdicts)
+EV_TENANT_SHED = 42       # admission refused a read on a tenant budget
+EV_TENANT_VERDICT = 43    # noisy-neighbor episode opened
 
 EV_NAMES = {
     EV_SEND: "send", EV_ACK: "ack", EV_ERR: "err", EV_RECV: "recv",
@@ -161,6 +169,8 @@ EV_NAMES = {
     EV_COLL_END: "coll.end",
     EV_FAULT_INJECT: "fault.inject",
     EV_FAULT_PLANE: "fault.plane",
+    EV_TENANT_SHED: "tenant.shed",
+    EV_TENANT_VERDICT: "tenant.verdict",
 }
 
 # ---------------------------------------------------------------------- #
@@ -183,8 +193,12 @@ MSG_EV_COVERAGE = {
     # an add frame is part of that opcode's lifecycle on the tape
     "MSG_ADD_ROWS": (EV_SEND, EV_RECV, EV_APPLY, EV_WIN_ENQ,
                      EV_WIN_FLUSH, EV_WIN_ACK, EV_FAULT_INJECT),
+    # EV_TENANT_SHED: a read refused on a per-tenant admission budget
+    # never reaches the wire, but the shed IS part of the get lifecycle
+    # — the tape must show policy throttling next to the frames it
+    # displaced (tools/postmortem.py renders both)
     "MSG_GET_ROWS": (EV_SEND, EV_RECV, EV_GET_SERVE, EV_GET_WIN,
-                     EV_FAULT_INJECT),
+                     EV_FAULT_INJECT, EV_TENANT_SHED),
     "MSG_SET_ROWS": (EV_SEND, EV_RECV, EV_APPLY),
     "MSG_ADD_FULL": (EV_SEND, EV_RECV, EV_APPLY),
     "MSG_GET_FULL": (EV_SEND, EV_RECV, EV_GET_SERVE),
@@ -194,10 +208,13 @@ MSG_EV_COVERAGE = {
     "MSG_SET_STATE": (EV_SEND, EV_RECV),
     "MSG_BATCH": (EV_SEND, EV_RECV, EV_WAVE, EV_WIN_FLUSH, EV_WIN_ACK,
                   EV_FAULT_INJECT),
-    "MSG_STATS": (),         # probe: excluded from the tape (PR 4)
+    # probe traffic itself stays off the tape (PR 4) — but the tenant
+    # verdict sweep rides the stats pull and lands ONE event per
+    # noisy-neighbor episode (ledger-deduped, never a per-poll flood)
+    "MSG_STATS": (EV_TENANT_VERDICT,),
     "MSG_HEALTH": (),        # probe: excluded from the tape (PR 4)
     "MSG_SNAPSHOT": (EV_SNAPSHOT_SERVE, EV_REPLICA_PULL,
-                     EV_FAULT_INJECT),
+                     EV_FAULT_INJECT, EV_TENANT_SHED),
     # multi-owner super-frame (ps/spmd.py, flag ps_fanout): carries
     # add/get sub-ops for every colocated shard of the destination
     # process — grouped applies land EV_APPLY (note "spmd ops=K"),

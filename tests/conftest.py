@@ -128,5 +128,10 @@ def _fresh_runtime():
     # tracer — a rank-R test must not stamp every later test's records)
     _watchdog.reset()
     _flightrec.reset()
+    # tenant attribution plane (ISSUE 18): drop per-tenant counters,
+    # ledger episodes and any thread-local tenant override — one test's
+    # storm must not verdict (or attribute into) a neighbor's sweep
+    from multiverso_tpu.telemetry import tenants as _tenants
+    _tenants.reset()
     from multiverso_tpu.utils import log as _log
     _log.reset_rank()

@@ -836,7 +836,7 @@ class TestFullMatrix:
         assert res["gates_failed"] == []
         assert set(res["scenarios"]) == {
             "partition_heal", "dup_reorder", "slow_shard_shed",
-            "replica_kill", "combined"}
+            "replica_kill", "noisy_neighbor", "combined"}
         assert res["ops_lost"] == 0
         assert res["ops_double_applied"] == 0
         assert res["parity_bit_for_bit"]

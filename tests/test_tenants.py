@@ -1,0 +1,659 @@
+"""ISSUE 18 battery: the tenant attribution plane end-to-end.
+
+Identity resolution (flag < scope), wire-meta stamping on BOTH wire
+planes (the two-tenant shard oracle), per-tenant send-window budgets
+(deferred-never-dropped), admission budget isolation (a tenant shed
+never burns the table-wide bucket), the noisy-neighbor verdict
+lifecycle (fires once, stays open, clears, re-fires), the aggregator's
+dedupe/sum merge, every renderer (mvtop, dump_metrics, exporter),
+lint 6 of check_obs_surface, flightrec EV coverage + the postmortem
+tenant timeline, run_bench's victim-tenant regression keys, and the
+tier-1 noisy_neighbor chaos smoke. All tier-1 (CPU, seconds)."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+
+from multiverso_tpu.ps import wire  # noqa: E402
+from multiverso_tpu.serving.admission import (AdmissionController,  # noqa: E402
+                                              tenant_stats_all)
+from multiverso_tpu.telemetry import aggregator  # noqa: E402
+from multiverso_tpu.telemetry import flightrec  # noqa: E402
+from multiverso_tpu.telemetry import hotkeys  # noqa: E402
+from multiverso_tpu.telemetry import tenants  # noqa: E402
+from multiverso_tpu.utils import config  # noqa: E402
+
+
+def _tools():
+    if TOOLS not in sys.path:
+        sys.path.insert(0, TOOLS)
+
+
+def _hist(count=3, sum_ms=9.0):
+    return {"count": count, "timed": count, "sum_ms": sum_ms,
+            "min_ms": 1.0, "max_ms": 5.0, "buckets": []}
+
+
+# ---------------------------------------------------------------------- #
+# identity resolution
+# ---------------------------------------------------------------------- #
+class TestIdentity:
+    def test_default_is_none(self):
+        assert tenants.current() is None
+        assert tenants.label(None) == "default"
+        assert tenants.label("acme") == "acme"
+
+    def test_flag_then_scope_precedence(self):
+        config.set_flag("tenant_id", "acme")
+        assert tenants.current() == "acme"
+        with tenants.tenant_scope("storm"):
+            assert tenants.current() == "storm"
+            with tenants.tenant_scope("inner"):
+                assert tenants.current() == "inner"
+            assert tenants.current() == "storm"
+            # "" explicitly selects the default tenant OVER the flag
+            with tenants.tenant_scope(""):
+                assert tenants.current() is None
+        assert tenants.current() == "acme"
+
+    def test_reset_clears_thread_local(self):
+        with tenants.tenant_scope("leak"):
+            tenants.reset()
+            assert tenants.current() is None
+
+
+# ---------------------------------------------------------------------- #
+# wire meta stamping
+# ---------------------------------------------------------------------- #
+class TestWireMeta:
+    def test_default_tenant_is_a_passthrough(self):
+        m = {"table": "t"}
+        assert wire.with_tenant(m, None) is m
+        assert wire.with_tenant(m, "") is m
+
+    def test_named_tenant_stamps_and_round_trips(self):
+        m = wire.with_tenant({"table": "t"}, "acme")
+        assert m[wire.TENANT_META_KEY] == "acme"
+        back = json.loads(wire.pack_meta(m).decode())
+        assert back[wire.TENANT_META_KEY] == "acme"
+
+
+# ---------------------------------------------------------------------- #
+# shard-side meter (pure)
+# ---------------------------------------------------------------------- #
+class TestTenantMeter:
+    def test_empty_meter_omits_block(self):
+        assert tenants.TenantMeter().to_dict() == {}
+
+    def test_default_and_named_exact(self):
+        m = tenants.TenantMeter()
+        m.note(None, add_bytes=10)
+        m.note(None, get_bytes=4)
+        m.note("a", ops=2, add_bytes=7)
+        m.note("b", get_bytes=5)
+        d = m.to_dict()
+        assert d["default"] == {"ops": 2, "add_bytes": 10, "get_bytes": 4}
+        assert d["a"] == {"ops": 2, "add_bytes": 7, "get_bytes": 0}
+        assert d["b"] == {"ops": 1, "add_bytes": 0, "get_bytes": 5}
+        assert d["~sketch"]["total"] == 3   # named ops only
+
+    def test_cap_folds_into_other_sketch_keeps_ranking(self):
+        m = tenants.TenantMeter(track_max=2, sketch_capacity=8)
+        for tn, n in (("a", 1), ("b", 1), ("c", 3), ("d", 2)):
+            m.note(tn, ops=n)
+        d = m.to_dict()
+        assert set(d) == {"a", "b", "~other", "~sketch"}
+        assert d["~other"]["ops"] == 5   # c + d folded
+        ranked = {it[0]: it[1] for it in d["~sketch"]["items"]}
+        assert ranked["c"] == 3 and ranked["d"] == 2
+
+
+# ---------------------------------------------------------------------- #
+# two-tenant oracle over the real wire (both planes via two_ranks)
+# ---------------------------------------------------------------------- #
+class TestShardOracle:
+    def test_two_tenant_oracle_both_planes(self, two_ranks):
+        """Named tenants are EXACT on both wire planes: stamped frames
+        punt off the native fast path, so one Python meter counts them
+        either way. Every op targets the remote rank's rows — the
+        local short-circuit must not hide traffic from the meter."""
+        from multiverso_tpu.ps.tables import AsyncMatrixTable
+        t0 = AsyncMatrixTable(16, 8, name="tor", ctx=two_ranks[0])
+        AsyncMatrixTable(16, 8, name="tor", ctx=two_ranks[1])
+        ones = np.ones((1, 8), np.float32)
+        with tenants.tenant_scope("a"):
+            for r in (8, 9, 10):
+                t0.add_rows([r], ones)
+            t0.get_rows(np.array([12]))
+        with tenants.tenant_scope("b"):
+            for r in (11, 12):
+                t0.add_rows([r], ones)
+            for _ in range(4):
+                t0.get_rows(np.array([13]))
+        st = t0.server_stats(1)["shards"]["tor"]["tenants"]
+        a, b = st["a"], st["b"]
+        assert a["ops"] == 4 and b["ops"] == 6
+        # byte exactness as a cross-tenant ratio (independent of the
+        # wire encoding): 3 vs 2 one-row adds, 1 vs 4 one-row gets
+        assert a["add_bytes"] > 0 and a["get_bytes"] > 0
+        assert a["add_bytes"] * 2 == b["add_bytes"] * 3
+        assert b["get_bytes"] == 4 * a["get_bytes"]
+        assert st["~sketch"]["total"] == 10
+
+    def test_default_tenant_counts_on_python_plane(self, tmp_path):
+        """Unstamped frames keep the native fast path (invisible to the
+        Python meter, by design); on the python plane the same
+        chokepoint counts them under "default"."""
+        from multiverso_tpu.ps.service import (FileRendezvous, PSContext,
+                                               PSService)
+        from multiverso_tpu.ps.tables import AsyncMatrixTable
+        config.set_flag("ps_native", False)
+        rdv = FileRendezvous(str(tmp_path / "rdv"))
+        ctxs = [PSContext(r, 2, PSService(r, 2, rdv)) for r in range(2)]
+        try:
+            t0 = AsyncMatrixTable(16, 8, name="tdf", ctx=ctxs[0])
+            AsyncMatrixTable(16, 8, name="tdf", ctx=ctxs[1])
+            t0.add_rows([9], np.ones((1, 8), np.float32))
+            t0.get_rows(np.array([9]))
+            st = t0.server_stats(1)["shards"]["tdf"]["tenants"]
+            assert st["default"]["ops"] == 2
+            assert st["default"]["add_bytes"] > 0
+            assert st["default"]["get_bytes"] > 0
+            assert "~sketch" not in st   # default traffic is not ranked
+        finally:
+            for c in ctxs:
+                c.close()
+
+
+# ---------------------------------------------------------------------- #
+# send-window tenant budgets: deferred, never dropped
+# ---------------------------------------------------------------------- #
+class TestSendWindowBudget:
+    def test_over_budget_adds_deferred_not_dropped(self, tmp_path):
+        from multiverso_tpu.ps.service import (FileRendezvous, PSContext,
+                                               PSService)
+        from multiverso_tpu.ps.tables import AsyncMatrixTable
+        config.set_flag("tenant_add_qps", 5.0)
+        rdv = FileRendezvous(str(tmp_path / "rdv"))
+        ctxs = [PSContext(r, 2, PSService(r, 2, rdv)) for r in range(2)]
+        try:
+            t0 = AsyncMatrixTable(16, 4, name="twin", send_window_ms=2.0,
+                                  ctx=ctxs[0])
+            AsyncMatrixTable(16, 4, name="twin", ctx=ctxs[1])
+            ones = np.ones((1, 4), np.float32)
+            with tenants.tenant_scope("w"):
+                for _ in range(40):
+                    t0.add_rows_async([12], ones)
+            t0.flush()
+            snap = tenants.LEDGER.stats_snapshot()
+            deferred = snap["tables"]["twin"]["w"]["deferred"]
+            # ~5-token burst against 40 instant adds: most defer
+            assert deferred >= 30
+            # writes are sacred: every add still applied
+            final = t0.get_rows(np.arange(16))
+            assert final[12, 0] == 40.0
+        finally:
+            for c in ctxs:
+                c.close()
+
+    def test_window_never_merges_across_tenants(self, tmp_path):
+        """Two tenants adding the SAME row inside one open window stay
+        two attribution records at the shard — coalescing must not blur
+        who wrote."""
+        from multiverso_tpu.ps.service import (FileRendezvous, PSContext,
+                                               PSService)
+        from multiverso_tpu.ps.tables import AsyncMatrixTable
+        rdv = FileRendezvous(str(tmp_path / "rdv"))
+        ctxs = [PSContext(r, 2, PSService(r, 2, rdv)) for r in range(2)]
+        try:
+            t0 = AsyncMatrixTable(16, 4, name="tmix", send_window_ms=50.0,
+                                  ctx=ctxs[0])
+            AsyncMatrixTable(16, 4, name="tmix", ctx=ctxs[1])
+            ones = np.ones((1, 4), np.float32)
+            with tenants.tenant_scope("x"):
+                t0.add_rows_async([12], ones)
+            with tenants.tenant_scope("y"):
+                t0.add_rows_async([12], 2 * ones)
+            t0.flush()
+            st = t0.server_stats(1)["shards"]["tmix"]["tenants"]
+            assert st["x"]["ops"] >= 1 and st["y"]["ops"] >= 1
+            assert t0.get_rows(np.array([12]))[0, 0] == 3.0
+        finally:
+            for c in ctxs:
+                c.close()
+
+
+# ---------------------------------------------------------------------- #
+# admission: per-tenant budgets judged before the table-wide bucket
+# ---------------------------------------------------------------------- #
+class TestAdmissionBudgets:
+    def test_tenant_shed_never_burns_aggregate_tokens(self):
+        ctl = AdmissionController()
+        ctl.set_limit("t", "infer", 10.0, burst=10.0)
+        ctl.set_tenant_limit("t", "storm", "infer", 1.0, burst=1.0)
+        storm_ok = sum(ctl.admit("t", tenant="storm") for _ in range(50))
+        assert storm_ok <= 2   # 1-token burst (+refill jitter)
+        # 49 storm sheds burned ZERO aggregate tokens: the victim
+        # still gets the 9 the storm's admits left in the 10-burst
+        victim_ok = sum(ctl.admit("t", tenant="victim")
+                        for _ in range(10 - storm_ok))
+        assert victim_ok == 10 - storm_ok
+        ts = ctl.tenant_stats()
+        s = ts["t/storm/infer"]
+        assert s["admitted"] + s["shed"] == 50
+        assert s["admitted"] == storm_ok and s["qps_limit"] == 1.0
+
+    def test_lazy_flag_default_named_tenants_only(self):
+        config.set_flag("tenant_infer_qps", 2.0)
+        ctl = AdmissionController()
+        ok = sum(ctl.admit("t", tenant="n") for _ in range(10))
+        assert 2 <= ok <= 3   # burst = max(2 * serving_burst_s, 1)
+        # the DEFAULT tenant is governed by the table-wide budget only
+        assert all(ctl.admit("t") for _ in range(10))
+
+    def test_tombstone_exempts_over_flag(self):
+        config.set_flag("tenant_infer_qps", 1.0)
+        ctl = AdmissionController()
+        ctl.set_tenant_limit("t", "vip", "infer", 0.0)   # exemption
+        assert all(ctl.admit("t", tenant="vip") for _ in range(20))
+
+    def test_validation(self):
+        ctl = AdmissionController()
+        with pytest.raises(ValueError):
+            ctl.set_tenant_limit("t", "", "infer", 1.0)
+        with pytest.raises(ValueError):
+            ctl.set_tenant_limit("t", "a", "nope", 1.0)
+
+    def test_tenant_stats_all_merges_controllers(self):
+        a, b = AdmissionController(), AdmissionController()
+        for ctl in (a, b):
+            ctl.set_tenant_limit("t", "s", "infer", 100.0)
+            ctl.admit("t", tenant="s")
+        merged = tenant_stats_all()
+        assert merged["t/s/infer"]["admitted"] == 2
+        assert merged["t/s/infer"]["qps_limit"] == 100.0
+
+
+# ---------------------------------------------------------------------- #
+# the noisy-neighbor verdict lifecycle (pure ledger)
+# ---------------------------------------------------------------------- #
+class TestLedgerVerdict:
+    def _interval(self, led, storm_serves=20, victim_sheds=1,
+                  victim_serves=2):
+        for _ in range(storm_serves):
+            led.note_serve("t", "storm", ms=1.0)
+        for _ in range(victim_serves):
+            led.note_serve("t", "victim", ms=1.0)
+        if victim_sheds:
+            led.note_shed("t", "victim", n=victim_sheds)
+
+    def test_fires_once_stays_open_clears_refires(self):
+        led = tenants.TenantLedger()
+        self._interval(led)
+        fired = led.sweep(now=100.0)
+        assert fired is not None
+        assert fired["kind"] == "noisy-neighbor"
+        assert fired["tenant"] == "storm"
+        assert fired["victims"] == ["victim"] and fired["why"] == ["shed"]
+        assert led.episodes() == 1
+        # condition persists -> episode stays open, NO refire
+        self._interval(led)
+        assert led.sweep() is None and led.episodes() == 1
+        # zero-delta interval -> clears
+        assert led.sweep() is None
+        snap = led.stats_snapshot()
+        assert snap["active"] is False and snap["episodes"] == 1
+        assert snap["verdict"]["tenant"] == "storm"   # retained
+        # storm returns -> a NEW episode
+        self._interval(led)
+        assert led.sweep() is not None and led.episodes() == 2
+
+    def test_single_active_tenant_never_fires(self):
+        led = tenants.TenantLedger()
+        for _ in range(50):
+            led.note_serve("t", "storm")
+        led.note_shed("t", "storm")
+        assert led.sweep() is None and led.episodes() == 0
+
+    def test_stale_serving_is_a_degradation(self):
+        led = tenants.TenantLedger()
+        for _ in range(20):
+            led.note_serve("t", "storm")
+        led.note_serve("t", "victim", age_s=0.95, bound_s=1.0)
+        fired = led.sweep()
+        assert fired is not None and fired["why"] == ["stale"]
+
+    def test_below_storm_share_never_fires(self):
+        led = tenants.TenantLedger()
+        for _ in range(5):
+            led.note_serve("t", "storm")
+        for _ in range(5):
+            led.note_serve("t", "victim")
+        led.note_shed("t", "victim")
+        assert led.sweep() is None   # 6/11 < 0.6 with the shed counted
+
+    def test_flightrec_records_shed_and_verdict(self):
+        flightrec.reset()
+        led = tenants.TenantLedger()
+        self._interval(led)
+        led.sweep()
+        kinds = [s[2] for s in flightrec.RECORDER.snapshot()]
+        assert flightrec.EV_TENANT_SHED in kinds
+        assert flightrec.EV_TENANT_VERDICT in kinds
+
+    def test_snapshot_shape_and_admission_block(self):
+        tenants.LEDGER.note_serve("t", "a", ms=2.0)
+        tenants.LEDGER.note_serve("t", "a", ms=4.0)
+        ctl = AdmissionController()
+        ctl.set_tenant_limit("t", "a", "infer", 9.0)
+        ctl.admit("t", tenant="a")
+        snap = tenants.stats_snapshot()
+        e = snap["tables"]["t"]["a"]
+        assert e["served"] == 2 and e["shed"] == 0 and e["deferred"] == 0
+        assert e["infer"]["count"] == 2
+        assert snap["shares"] == {"a": 1.0}
+        assert snap["admission"]["t/a/infer"]["admitted"] == 1
+
+    def test_track_max_folds_ledger_entries(self):
+        config.set_flag("tenant_track_max", 2)
+        led = tenants.TenantLedger()
+        for tn in ("a", "b", "c", "d"):
+            led.note_serve("t", tn)
+        t = led.stats_snapshot()["tables"]["t"]
+        assert set(t) == {"a", "b", "~other"}
+        assert t["~other"]["served"] == 2
+
+
+# ---------------------------------------------------------------------- #
+# aggregator merge: proc-dedupe serve ledger, sum shard meters
+# ---------------------------------------------------------------------- #
+def _ten_block(ts=100.0, tenant="storm"):
+    return {
+        "tables": {"t": {
+            "storm": {"served": 80, "shed": 40, "deferred": 0,
+                      "max_age_s": 0.5, "infer": _hist()},
+            "victim": {"served": 4, "shed": 1, "deferred": 2,
+                       "max_age_s": 0.1, "infer": _hist(1, 2.0)},
+        }},
+        "shares": {"storm": 0.9, "victim": 0.1},
+        "episodes": 1, "active": True,
+        "verdict": {"kind": "noisy-neighbor", "tenant": tenant,
+                    "share": 0.9, "victims": ["victim"],
+                    "why": ["shed"], "ts": ts},
+        "admission": {"t/storm/infer": {"admitted": 80, "shed": 40,
+                                        "qps_limit": 50.0}},
+    }
+
+
+def _rank_stats(rank, pid=11, ten=None, sketch=True):
+    sk = hotkeys.SpaceSaving(4)
+    sk.offer_key("acme", 2)
+    shard = {"kind": "row", "adds": 4, "gets": 2, "applies": 4,
+             "queue_depth": 0, "get_bytes": 6, "add_bytes": 10,
+             "rows": 8,
+             "tenants": {"acme": {"ops": 2, "add_bytes": 10,
+                                  "get_bytes": 6}}}
+    if sketch:
+        shard["tenants"]["~sketch"] = sk.to_dict()
+    st = {"rank": rank, "addr": f"h:{rank}", "pid": pid,
+          "monitors": {}, "notes": {}, "shards": {"t": shard}}
+    if ten is not None:
+        st["tenants"] = ten
+    return st
+
+
+class TestAggregatorMerge:
+    def _merge(self, st0, st1):
+        return aggregator.merge_cluster(
+            {0: st0, 1: st1},
+            {0: {"status": "ok", "addr": "h:0"},
+             1: {"status": "ok", "addr": "h:1"}}, world=2)
+
+    def test_same_process_dedupes_ledger_sums_shards(self):
+        ten = _ten_block()
+        rec = self._merge(_rank_stats(0, ten=ten), _rank_stats(1, ten=ten))
+        tb = rec["tenants"]
+        # serve ledger (process-global): ONE process -> counted once
+        assert tb["tables"]["t"]["storm"]["served"] == 80
+        assert tb["episodes"] == 1 and tb["active"] is True
+        # shard meters (per-shard objects): summed across ranks
+        assert tb["wire"]["acme"] == {"ops": 4, "add_bytes": 20,
+                                      "get_bytes": 12}
+        assert tb["sketch"]["total"] == 4
+        # merged extras: shed_rate + merged infer hist + recomputed shares
+        assert tb["tables"]["t"]["storm"]["shed_rate"] == 0.3333
+        assert tb["tables"]["t"]["storm"]["infer"]["count"] == 3
+        assert tb["shares"]["storm"] == round(120 / 125, 4)
+        assert tb["admission"]["t/storm/infer"]["admitted"] == 80
+        json.dumps(rec)
+
+    def test_distinct_processes_sum_and_latest_verdict_wins(self):
+        rec = self._merge(
+            _rank_stats(0, pid=11, ten=_ten_block(ts=100.0)),
+            _rank_stats(1, pid=22, ten=_ten_block(ts=200.0,
+                                                  tenant="other")))
+        tb = rec["tenants"]
+        assert tb["tables"]["t"]["storm"]["served"] == 160
+        assert tb["episodes"] == 2
+        assert tb["verdict"]["tenant"] == "other"   # ts=200 wins
+        assert tb["admission"]["t/storm/infer"]["admitted"] == 160
+
+    def test_absent_block_is_additive(self):
+        rec = self._merge(_rank_stats(0, sketch=False),
+                          _rank_stats(1, sketch=False))
+        # shard meters alone still surface as the wire sub-block
+        assert rec["tenants"]["wire"]["acme"]["ops"] == 4
+        assert not rec["tenants"].get("tables")
+
+    def test_derive_rates_per_tenant(self):
+        def rec_at(ts, served):
+            return {"kind": "cluster", "ts": ts, "tables": {},
+                    "tenants": {"tables": {"t": {
+                        "storm": {"served": served, "shed": 0,
+                                  "deferred": 0}}}}}
+        prev, cur = rec_at(100.0, 10), rec_at(102.0, 50)
+        assert aggregator.derive_rates(prev, cur) is not None
+        r = cur["tenants"]["tables"]["t"]["storm"]["rates"]
+        assert r["served_per_s"] == pytest.approx(20.0)
+        assert r["shed_per_s"] == 0.0
+
+    def test_compact_record_keeps_tenants(self):
+        rec = self._merge(_rank_stats(0, ten=_ten_block()),
+                          _rank_stats(1, ten=_ten_block()))
+        out = aggregator.compact_record(rec)
+        assert out["tenants"]["episodes"] == 1
+
+
+# ---------------------------------------------------------------------- #
+# renderers: mvtop panel, dump_metrics block, exporter gauges
+# ---------------------------------------------------------------------- #
+class TestRenderers:
+    def _rec(self):
+        ten = _ten_block()
+        return aggregator.merge_cluster(
+            {0: _rank_stats(0, ten=ten), 1: _rank_stats(1, ten=ten)},
+            {0: {"status": "ok", "addr": "h:0"},
+             1: {"status": "ok", "addr": "h:1"}}, world=2)
+
+    def test_mvtop_tenant_panel(self):
+        _tools()
+        import mvtop
+        out = mvtop.render(self._rec())
+        assert "tenants: episodes 1  NOISY-NEIGHBOR ACTIVE" in out
+        assert ("verdict: noisy-neighbor tenant=storm share=0.900 "
+                "victims=victim why=shed") in out
+        assert "t/storm" in out and "t/victim" in out
+        assert "budgets (admitted/shed): t/storm/infer 80/40@50.0qps" in out
+        assert "wire ops: acme:4op/0.00MB" in out
+
+    def test_mvtop_renders_without_tenant_block(self):
+        _tools()
+        import mvtop
+        rec = aggregator.merge_cluster(
+            {0: {"rank": 0, "monitors": {}, "shards": {}}},
+            {0: {"status": "ok", "addr": "h:0"}}, world=1)
+        assert "tenants:" not in mvtop.render(rec)
+
+    def test_dump_metrics_tenant_lines(self):
+        _tools()
+        import dump_metrics
+        out = "\n".join(dump_metrics._tenants_lines(
+            self._rec()["tenants"]))
+        assert "tenants: episodes=1 active=True" in out
+        assert "verdict[noisy-neighbor] tenant=storm:" in out
+        assert "budget[t/storm/infer]: admitted=80 shed=40" in out
+        assert "wire: acme=4op/0.00MB" in out
+        # both entry points route through the same renderer
+        assert ("tenants: episodes=1 active=True"
+                in dump_metrics.format_cluster_record(self._rec()))
+        per_rank = dump_metrics.format_record(
+            {"rank": 0, "monitors": {}, "shards": {},
+             "tenants": _ten_block()})
+        assert "tenants: episodes=1 active=True" in per_rank
+
+    def test_dump_metrics_renders_without_block(self):
+        _tools()
+        import dump_metrics
+        out = dump_metrics.format_record(
+            {"rank": 0, "monitors": {}, "shards": {}})
+        assert "tenants:" not in out
+
+    def test_exporter_mv_tenant_gauges(self):
+        from multiverso_tpu.telemetry.exporter import prometheus_text
+        txt = prometheus_text({"rank": 0, "monitors": {}, "shards": {},
+                               "tenants": _ten_block()})
+        assert ('mv_tenant_served_total{table="t",tenant="storm",'
+                'rank="0"} 80') in txt
+        assert ('mv_tenant_shed_total{table="t",tenant="storm",'
+                'rank="0"} 40') in txt
+        assert 'mv_tenant_p99_ms{table="t",tenant="storm",rank="0"}' in txt
+        assert 'mv_tenant_share{tenant="storm",rank="0"} 0.9' in txt
+        assert "mv_tenant_budget_admitted" in txt
+        assert 'mv_tenant_episodes{rank="0"} 1' in txt
+        assert 'mv_tenant_verdict_active{rank="0"} 1' in txt
+
+    def test_exporter_no_series_without_block(self):
+        from multiverso_tpu.telemetry.exporter import prometheus_text
+        txt = prometheus_text({"rank": 0, "monitors": {}, "shards": {}})
+        assert "mv_tenant_" not in txt
+
+
+# ---------------------------------------------------------------------- #
+# check_obs_surface lint 6
+# ---------------------------------------------------------------------- #
+class TestLintSix:
+    def test_real_surface_is_clean(self):
+        _tools()
+        import check_obs_surface
+        assert check_obs_surface.tenant_surface_findings() == []
+
+    def test_catches_a_dark_key(self):
+        _tools()
+        import check_obs_surface
+        fs = check_obs_surface.tenant_surface_findings(
+            keys_by_src={"fake.py:f()": {"darkkey123"}},
+            renderer_text='lines.append("nothing relevant")')
+        assert len(fs) == 1
+        assert "darkkey123" in fs[0] and "fake.py:f()" in fs[0]
+
+    def test_quoted_key_passes(self):
+        _tools()
+        import check_obs_surface
+        assert check_obs_surface.tenant_surface_findings(
+            keys_by_src={"fake.py:f()": {"brightkey"}},
+            renderer_text="x.get('brightkey')") == []
+
+
+# ---------------------------------------------------------------------- #
+# flightrec coverage + postmortem timeline
+# ---------------------------------------------------------------------- #
+class TestFlightrecAndPostmortem:
+    def test_ev_names_and_msg_coverage(self):
+        assert flightrec.EV_NAMES[flightrec.EV_TENANT_SHED] == "tenant.shed"
+        assert (flightrec.EV_NAMES[flightrec.EV_TENANT_VERDICT]
+                == "tenant.verdict")
+        cov = flightrec.MSG_EV_COVERAGE
+        assert flightrec.EV_TENANT_SHED in cov["MSG_GET_ROWS"]
+        assert flightrec.EV_TENANT_SHED in cov["MSG_SNAPSHOT"]
+        assert cov["MSG_STATS"] == (flightrec.EV_TENANT_VERDICT,)
+
+    def test_postmortem_tenant_timeline(self, tmp_path):
+        _tools()
+        import postmortem
+        config.set_flag("flightrec_dir", str(tmp_path))
+        flightrec.configure(0)
+        led = tenants.TenantLedger()
+        for _ in range(20):
+            led.note_serve("t", "storm")
+        led.note_shed("t", "victim", n=2)
+        led.note_serve("t", "victim")
+        assert led.sweep() is not None
+        path = flightrec.dump_global("tenant verdict test")
+        dumps = [postmortem.load_dump(path)]
+        tl = postmortem.tenant_timeline(dumps)
+        evs = {e["ev"] for e in tl}
+        assert evs == {"tenant.shed", "tenant.verdict"}
+        rep = postmortem.render_report(dumps)
+        assert "tenant plane (telemetry/tenants.py): sheds" in rep
+        assert "VERDICT noisy-neighbor storm" in rep
+
+
+# ---------------------------------------------------------------------- #
+# run_bench victim-tenant regression keys
+# ---------------------------------------------------------------------- #
+class TestRunBenchFlags:
+    def _headline(self, p99, shed):
+        return {"extra": {"serving": {"tenants": {"victim": {
+            "infer_p99_ms": p99, "shed_rate": shed}}}}}
+
+    def test_victim_growth_flags(self):
+        _tools()
+        import run_bench
+        flags = run_bench.flag_regressions(
+            self._headline(1.0, 0.06), self._headline(2.5, 0.2))
+        assert any("victim-tenant serving p99" in f for f in flags)
+        assert any("victim-tenant shed rate" in f for f in flags)
+
+    def test_shed_rate_baseline_floor(self):
+        """A 0.0 shed baseline must not flag every first nonzero shed:
+        the floor (0.05) absorbs noise, growth past 2 x floor flags."""
+        _tools()
+        import run_bench
+        assert run_bench.flag_regressions(
+            self._headline(1.0, 0.0), self._headline(1.0, 0.08)) == []
+        flags = run_bench.flag_regressions(
+            self._headline(1.0, 0.0), self._headline(1.0, 0.2))
+        assert any("victim-tenant shed rate" in f for f in flags)
+
+
+# ---------------------------------------------------------------------- #
+# the chaos scenario smoke (tier-1)
+# ---------------------------------------------------------------------- #
+class TestNoisyNeighborSmoke:
+    def test_noisy_neighbor_smoke(self, tmp_path):
+        """Strict gates (budget cap, staleness, exactly-one verdict)
+        hold on every attempt; the victim-p99 gate compares latencies
+        measured seconds apart on a shared box, so that ONE gate gets
+        a second attempt — the scenario-smoke weather rule."""
+        _tools()
+        import bench_chaos
+        last = None
+        for attempt in range(2):
+            r = bench_chaos.scenario_noisy_neighbor(
+                seconds=8.0, tmp=os.path.join(str(tmp_path), str(attempt)))
+            strict = {g: ok for g, ok in r["gates"].items()
+                      if g != "victim_p99"}
+            assert all(strict.values()), r["gates"]
+            last = r
+            if r["gates"]["victim_p99"]:
+                break
+        assert last["gates"]["victim_p99"], last["gates"]
+        assert last["episodes"] == 1 and last["flight_verdicts"] == 1
+        assert last["tenants_block"]["verdict"]["tenant"] == "storm"
+        assert last["tenants_block"]["active"] is False

@@ -171,16 +171,26 @@ def main(argv) -> int:
             my["write_ms"][ctl["phase"]].append(write_ms)
 
     def infer_worker(j: int) -> None:
+        from multiverso_tpu.telemetry.tenants import tenant_scope
         r = np.random.default_rng(200 + j)
         zipf = _zipf_sampler(np.random.default_rng(300 + j),
                              cfg.vocab_sizes[0], perm)
+        # tenant attribution (ISSUE 18): worker 0 is the "victim"
+        # tenant, the rest are one "storm" tenant — the per-tenant
+        # served/shed/p99 split in extra.serving.tenants is what
+        # run_bench's victim-tenant regression flags trend on
+        tenant = "victim" if j == 0 else "storm"
         my = {"lat_ms": {p: [] for p in PHASES},
               "served": {p: 0 for p in PHASES},
               "shed": {p: 0 for p in PHASES},
-              "age_max": 0.0, "errors": 0}
+              "age_max": 0.0, "errors": 0, "tenant": tenant}
         results.append(my)
         B = 16
         next_t = time.perf_counter()
+        with tenant_scope(tenant):
+            _infer_loop(j, r, zipf, my, B, next_t)
+
+    def _infer_loop(j, r, zipf, my, B, next_t) -> None:
         while not stop.is_set():
             c = np.stack(
                 [zipf(B)] + [r.integers(0, v, B)
@@ -295,6 +305,25 @@ def main(argv) -> int:
                 shed[p] += my["shed"][p]
             age_max = max(age_max, my["age_max"])
 
+    # per-tenant split (ISSUE 18), same steady+overload window as the
+    # aggregate infer percentiles: the victim keys feed run_bench's
+    # floored regression flags, so their names are load-bearing
+    tenants_acc = {}
+    for my in results:
+        if "lat_ms" not in my:
+            continue
+        e = tenants_acc.setdefault(
+            my["tenant"], {"served": 0, "shed": 0, "lat": []})
+        e["served"] += my["served"]["steady"] + my["served"]["overload"]
+        e["shed"] += my["shed"]["steady"] + my["shed"]["overload"]
+        e["lat"].extend(my["lat_ms"]["steady"] + my["lat_ms"]["overload"])
+    tenants_res = {
+        t: {"served": e["served"], "shed": e["shed"],
+            "shed_rate": round(
+                e["shed"] / max(e["served"] + e["shed"], 1), 4),
+            "infer_p99_ms": _pct(e["lat"], 99)}
+        for t, e in sorted(tenants_acc.items())}
+
     all_infer = infer_ms["steady"] + infer_ms["overload"]
     train_p50_steady = _pct(train_ms["steady"], 50)
     train_p50_overload = _pct(train_ms["overload"], 50)
@@ -398,6 +427,7 @@ def main(argv) -> int:
         "train_write_degradation_x": degradation,
         "shed_steady": shed["steady"], "shed_overload": shed["overload"],
         "shed_rate_overload": shed_rate_overload,
+        "tenants": tenants_res,
         "staleness_bound_s": BOUND_S,
         "staleness_max_s": round(age_max, 4),
         "staleness_ok": staleness_ok,

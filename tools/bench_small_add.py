@@ -154,6 +154,23 @@ def main():
             raise AssertionError(
                 "send-window parity broke: window-on table diverged from "
                 "window-off under the identical add stream")
+        # ISSUE 18 acceptance, asserted in-run like parity: the tenant
+        # attribution plane is COMPILED IN on the measured path. The
+        # windowed MSG_BATCH frames punt to the python server even
+        # where the native transport is built, so rank 1's shard meter
+        # counted every timed window-on add via the default-tenant
+        # fast path (one attribute read + one dict increment per op) —
+        # the band below is measured WITH tenant accounting live, not
+        # merely imported
+        ten = (t_on.server_stats(1)["shards"]["sa_on"].get("tenants")
+               or {})
+        tenant_default_ops = int((ten.get("default") or {})
+                                 .get("ops", 0))
+        if tenant_default_ops <= 0:
+            raise AssertionError(
+                "tenant meter never counted on the window-on shard: "
+                "the band below would be measured without the tenant "
+                "accounting plane")
         # PR-4 acceptance, asserted in-run like parity: the ALWAYS-ON
         # flight recorder (one ring write on the windowed-add hot path,
         # begin/end-op tracking per wire frame) must be invisible at the
@@ -198,6 +215,7 @@ def main():
         flightrec_band_ms=list(flightrec_band),
         memstats_samples=mem_samples, memory=mem,
         devstats_live=devstats.enabled(),
+        tenant_default_ops=tenant_default_ops,
         # ISSUE 14 acceptance evidence: the fault-injection plane is
         # COMPILED IN (ps/service.py imports it unconditionally; its
         # hook guards ran on every timed add above) but DISARMED —

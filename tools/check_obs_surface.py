@@ -238,6 +238,51 @@ def collective_coverage_findings(
 
 
 # ---------------------------------------------------------------------- #
+# tenant-surface rule (lint 6): the MSG_STATS "tenants" block renders
+# ---------------------------------------------------------------------- #
+# (file, function) pairs whose emitted keys ARE the tenant block: the
+# ledger's stats_snapshot (block structure + per-(table, tenant)
+# counters), the shard meter's counter shape (note builds the entry
+# dicts, to_dict adds the sketch key), and the admission controller's
+# per-tenant budget entries that ride the block's "admission" map.
+_TENANT_SOURCES = (
+    ("multiverso_tpu/telemetry/tenants.py", "stats_snapshot"),
+    ("multiverso_tpu/telemetry/tenants.py", "note"),
+    ("multiverso_tpu/telemetry/tenants.py", "to_dict"),
+    ("multiverso_tpu/serving/admission.py", "tenant_stats"),
+)
+
+
+def tenant_surface_findings(keys_by_src: Dict[str, List[str]] = None,
+                            renderer_text: str = None) -> List[str]:
+    """Lint 6: every key the tenants block emits must appear quoted in
+    ``tools/mvtop.py`` or ``tools/dump_metrics.py`` — the lint-3 rule
+    applied to the tenant plane with NO allowlist: per-tenant evidence
+    that no pane of glass shows is exactly how a noisy-neighbor episode
+    goes dark. Injectable so tests can prove the rule catches a
+    fabricated dark key."""
+    if keys_by_src is None:
+        keys_by_src = {f"{path}:{func}()": stats_keys(path, func)
+                       for path, func in _TENANT_SOURCES}
+    if renderer_text is None:
+        renderer_text = ""
+        for rel in _RENDERERS:
+            with open(os.path.join(_REPO, rel)) as f:
+                renderer_text += f.read()
+    findings = []
+    for src, keys in sorted(keys_by_src.items()):
+        for key in keys:
+            if f'"{key}"' in renderer_text or f"'{key}'" in renderer_text:
+                continue
+            findings.append(
+                f"tenant stats key {key!r} (emitted by {src}): rendered "
+                "by neither tools/mvtop.py nor tools/dump_metrics.py — "
+                "add it to the tenant panel/table so per-tenant "
+                "evidence cannot go dark")
+    return findings
+
+
+# ---------------------------------------------------------------------- #
 # regression-key rule (lint 5): every tracked bench key has a producer
 # ---------------------------------------------------------------------- #
 def regression_paths(repo: str = _REPO) -> List[tuple]:
@@ -337,6 +382,7 @@ def check() -> List[str]:
     findings.extend(stats_surface_findings())
     findings.extend(collective_coverage_findings())
     findings.extend(regression_key_findings())
+    findings.extend(tenant_surface_findings())
     return findings
 
 

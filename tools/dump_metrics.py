@@ -168,6 +168,61 @@ def _devices_lines(dev: Dict) -> List[str]:
     return lines
 
 
+def _tenants_lines(ten: Dict) -> List[str]:
+    """MSG_STATS ``tenants`` block (telemetry/tenants.py) -> the
+    per-(table, tenant) accounting table + budget decisions + verdict
+    state. One renderer for both the per-rank payload and the
+    aggregator's merged cluster shape (extra merged-only fields like
+    ``wire`` render when present)."""
+    lines = ["tenants: episodes=%s active=%s" % (
+        ten.get("episodes", 0), ten.get("active", False))]
+    shares = ten.get("shares") or {}
+    if shares:
+        lines.append("  shares: " + "  ".join(
+            f"{tn}={sh}" for tn, sh in
+            sorted(shares.items(), key=lambda kv: -kv[1])))
+    v = ten.get("verdict")
+    if isinstance(v, dict):
+        lines.append("  verdict[%s] tenant=%s: " % (v.get("kind"),
+                                                    v.get("tenant"))
+                     + ", ".join(f"{k}={x}" for k, x in sorted(v.items())
+                                 if k not in ("kind", "tenant")))
+    tables = ten.get("tables") or {}
+    if tables:
+        lines.append(f"  {'table/tenant':<30} {'served':>8} {'shed':>7} "
+                     f"{'deferred':>9} {'max_age_s':>10} {'p50':>9} "
+                     f"{'p99':>9}")
+        for tname in sorted(tables):
+            tt = tables[tname]
+            if not isinstance(tt, dict):
+                continue
+            for tn in sorted(tt):
+                e = tt[tn]
+                if not isinstance(e, dict):
+                    continue
+                h = e.get("infer") or {}
+                lines.append(
+                    f"  {tname + '/' + tn:<30} {e.get('served', 0):>8} "
+                    f"{e.get('shed', 0):>7} {e.get('deferred', 0):>9} "
+                    f"{e.get('max_age_s', 0):>10} "
+                    f"{h.get('p50_ms', 0):>9} {h.get('p99_ms', 0):>9}")
+    adm = ten.get("admission") or {}
+    for k in sorted(adm):
+        a = adm[k]
+        if isinstance(a, dict):
+            lines.append(
+                f"  budget[{k}]: admitted={a.get('admitted', 0)} "
+                f"shed={a.get('shed', 0)} "
+                f"qps_limit={a.get('qps_limit')}")
+    wire = ten.get("wire") or {}
+    if wire:
+        lines.append("  wire: " + "  ".join(
+            f"{tn}={w.get('ops', 0)}op"
+            f"/{_mb(w.get('add_bytes', 0) + w.get('get_bytes', 0))}MB"
+            for tn, w in sorted(wire.items()) if isinstance(w, dict)))
+    return lines
+
+
 def format_record(rec: Dict) -> str:
     """One record -> the human table (pure function; tested directly).
     Cluster records (``kind: "cluster"``) dispatch to
@@ -183,8 +238,17 @@ def format_record(rec: Dict) -> str:
         s = dict(rec["shards"][table])
         apply_h = s.pop("apply", None)
         hot = s.pop("hotkeys", None)
+        stm = s.pop("tenants", None)
         lines.append(f"shard[{table}]: " + ", ".join(
             f"{k}={v}" for k, v in sorted(s.items())))
+        if isinstance(stm, dict):
+            cells = [
+                f"{tn}={c.get('ops', 0)}op"
+                f"/+{c.get('add_bytes', 0)}B/-{c.get('get_bytes', 0)}B"
+                for tn, c in sorted(stm.items())
+                if tn != "~sketch" and isinstance(c, dict)]
+            if cells:
+                lines.append("  tenants: " + "  ".join(cells))
         if apply_h and apply_h.get("count"):
             lines.append(
                 f"  apply: count={apply_h['count']} "
@@ -212,6 +276,9 @@ def format_record(rec: Dict) -> str:
     dev = rec.get("devices")
     if isinstance(dev, dict):
         lines.extend(_devices_lines(dev))
+    ten = rec.get("tenants")
+    if isinstance(ten, dict):
+        lines.extend(_tenants_lines(ten))
     for name in sorted(rec.get("notes", {})):
         lines.append(f"note[{name}] {rec['notes'][name]}")
     return "\n".join(lines)
@@ -341,6 +408,9 @@ def format_cluster_record(rec: Dict) -> str:
             d = dev["ranks"][r]
             if isinstance(d, dict):
                 lines.extend("  " + ln for ln in _devices_lines(d))
+    ten = rec.get("tenants")
+    if isinstance(ten, dict):
+        lines.extend(_tenants_lines(ten))
     for tname in sorted(rec.get("hotkeys", {})):
         h = rec["hotkeys"][tname]
         head = "  ".join(f"{k}:{c}" for k, c, _ in h.get("top", [])[:8])

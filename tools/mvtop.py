@@ -219,6 +219,64 @@ def render(rec: Dict, prev: Optional[Dict] = None,
             if ops:
                 lines.append("        coll ops: " + "  ".join(
                     f"{op}:{n}" for op, n in ops.items()))
+    # tenant panel (telemetry/tenants.py, MSG_STATS "tenants" block):
+    # per-(table, tenant) served/shed/deferred + latency percentiles,
+    # interval traffic shares, per-tenant budget decisions, and the
+    # noisy-neighbor verdict state. ADDITIVE like the device block — a
+    # cluster with no tenant traffic renders nothing.
+    ten = rec.get("tenants")
+    if ten:
+        lines.append("")
+        head = (f"tenants: episodes {ten.get('episodes', 0)}"
+                + ("  NOISY-NEIGHBOR ACTIVE" if ten.get("active")
+                   else ""))
+        shares = ten.get("shares") or {}
+        if shares:
+            head += "  share " + "  ".join(
+                f"{tn}:{sh * 100:.0f}%" for tn, sh in
+                sorted(shares.items(), key=lambda kv: -kv[1])[:topk])
+        lines.append(head)
+        v = ten.get("verdict")
+        if v:
+            lines.append(
+                f"  verdict: {v.get('kind')} tenant={v.get('tenant')}"
+                f" share={_fmt(v.get('share'))}"
+                f" victims={','.join(v.get('victims') or [])}"
+                f" why={','.join(v.get('why') or [])}")
+        lines.append(f"  {'table/tenant':<28} {'served':>8} {'shed':>7} "
+                     f"{'shed%':>6} {'defer':>6} {'qps':>8} "
+                     f"{'p99_ms':>8} {'age_s':>7}")
+        for tname in sorted(ten.get("tables") or {}):
+            tt = ten["tables"][tname]
+            for tn in sorted(tt):
+                e = tt[tn]
+                h = e.get("infer") or {}
+                er = e.get("rates") or {}
+                sr = e.get("shed_rate")
+                lines.append(
+                    f"  {tname + '/' + tn:<28} {e.get('served', 0):>8} "
+                    f"{e.get('shed', 0):>7} "
+                    f"{('-' if sr is None else f'{sr * 100:.1f}'):>6} "
+                    f"{e.get('deferred', 0):>6} "
+                    f"{_fmt(er.get('served_per_s'), 1):>8} "
+                    f"{_fmt(h.get('p99_ms')):>8} "
+                    f"{_fmt(e.get('max_age_s')):>7}")
+        adm = ten.get("admission") or {}
+        if adm:
+            cells = [
+                f"{k} {a.get('admitted', 0)}/{a.get('shed', 0)}"
+                + (f"@{a['qps_limit']}qps" if a.get("qps_limit")
+                   else "")
+                for k, a in sorted(adm.items())]
+            lines.append("  budgets (admitted/shed): "
+                         + "  ".join(cells[:topk]))
+        wire = ten.get("wire") or {}
+        if wire:
+            cells = [
+                f"{tn}:{w.get('ops', 0)}op/"
+                f"{(w.get('add_bytes', 0) + w.get('get_bytes', 0)) / 1e6:.2f}MB"
+                for tn, w in sorted(wire.items())]
+            lines.append("  wire ops: " + "  ".join(cells[:topk]))
     mons = rec.get("monitors", {})
     rates = rec.get("rates", {})
     serving = rec.get("serving", {})

@@ -289,6 +289,18 @@ def injected_faults(dumps: List[Dict]) -> Dict:
             "events": events}
 
 
+def tenant_timeline(dumps: List[Dict]) -> List[Dict]:
+    """The tenant attribution plane's events (telemetry/tenants.py):
+    every ``tenant.shed`` (a per-tenant budget refusing a read) and
+    ``tenant.verdict`` (a noisy-neighbor episode opening) across the
+    merged dumps, on one wall clock — rendered beside the injected
+    faults so a chaos run's storm reads as scenario. The note carries
+    ``table:tenant`` for sheds and the storm tenant + share for
+    verdicts."""
+    return [r for r in timeline(dumps)
+            if r.get("ev") in ("tenant.shed", "tenant.verdict")]
+
+
 def render_report(dumps: List[Dict], log_lines: List[Dict] = (),
                   tail: int = 40) -> str:
     names = _msg_names()
@@ -327,6 +339,27 @@ def render_report(dumps: List[Dict], log_lines: List[Dict] = (),
                 f"  {e.get('ts', 0.0):.6f} rank{e.get('rank', -1)} "
                 f"{e['ev']} peer={e.get('peer', -1)} "
                 f"{e.get('note') or ''}")
+    tten = tenant_timeline(dumps)
+    if tten:
+        # tenant plane: verdicts print whole, sheds summarize by
+        # table:tenant — one budget's refusals are one line, not a
+        # page, and the verdict stays beside the faults it co-occurred
+        # with
+        shed_counts: Dict[str, int] = {}
+        for e in tten:
+            if e["ev"] == "tenant.shed":
+                key = str(e.get("note") or "?")
+                shed_counts[key] = shed_counts.get(key, 0) + 1
+        lines.append(
+            "tenant plane (telemetry/tenants.py): sheds "
+            + (", ".join(f"{k}={n}" for k, n
+                         in sorted(shed_counts.items())) or "none"))
+        for e in tten:
+            if e["ev"] != "tenant.verdict":
+                continue
+            lines.append(
+                f"  {e.get('ts', 0.0):.6f} rank{e.get('rank', -1)} "
+                f"VERDICT {e.get('note') or ''}")
     rec = recovery_timeline(dumps, log_lines)
     if rec:
         lines.append("recovery timeline (failover plane):")
@@ -432,6 +465,7 @@ def main(argv=None) -> int:
             "stuck_pairs": stuck_pairs(dumps),
             "recovery": recovery_timeline(dumps, log_lines),
             "injected_faults": injected_faults(dumps),
+            "tenant_timeline": tenant_timeline(dumps),
             "memory": memory_report(dumps),
             "timeline": timeline(dumps, log_lines)[-args.tail:],
         }, indent=1))

@@ -82,6 +82,16 @@ _REGRESSION_KEYS = (
     # online-serving plane (tools/bench_serving.py): inference tail
     # latency against the bounded-staleness replica
     (("serving", "infer_p99_ms"), "serving inference p99"),
+    # tenant attribution plane (ISSUE 18): the VICTIM tenant's tail
+    # latency and shed rate out of extra.serving.tenants — growth here
+    # with the aggregate p99 holding is exactly the noisy-neighbor
+    # signature the tenant plane exists to surface. Flagged, never
+    # failed, like every band; the shed rate compares against a
+    # floored baseline (see _REGRESSION_BASELINE_FLOORS)
+    (("serving", "tenants", "victim", "infer_p99_ms"),
+     "victim-tenant serving p99"),
+    (("serving", "tenants", "victim", "shed_rate"),
+     "victim-tenant shed rate"),
     # memory plane (ISSUE 10): peak process RSS over the whole bench
     # (VmHWM — kernel-tracked, no sampling cadence can under-read it).
     # Growth is a regression like latency growth: higher is worse, so
@@ -95,6 +105,16 @@ _REGRESSION_KEYS = (
 # stall comparison floors the baseline at this value instead (a new
 # stall above 2 x 5% flags even against a perfect-zero prior)
 _STALL_BASELINE_FLOOR = 0.05
+
+# per-path baseline floors for the lower-is-better table: a healthy
+# run records ~0 victim-tenant sheds (steady is paced inside the
+# budget; overload sheds mostly land on the storm workers), and the
+# `old <= 0` guard below would then suppress shed-growth flags forever
+# — so these paths compare against max(prev, floor) instead, the same
+# directionality fix as the stall fraction
+_REGRESSION_BASELINE_FLOORS = {
+    ("serving", "tenants", "victim", "shed_rate"): 0.05,
+}
 
 # replay retained-frame bytes: a healthy run with a live failover
 # checkpointer records ~0 here (frames prune at the durable floor), so
@@ -171,11 +191,14 @@ def flag_regressions(prev_headline, new_headline, factor: float = 2.0):
     for path, label in _REGRESSION_KEYS:
         old = _extra_value(prev_headline, path)
         new = _extra_value(new_headline, path)
-        if old is None or new is None or old <= 0:
+        if old is None or new is None:
             continue
-        if new > factor * old:
+        base = max(old, _REGRESSION_BASELINE_FLOORS.get(path, 0.0))
+        if base <= 0:
+            continue
+        if new > factor * base:
             out.append(f"{label}: {new} vs {old} previously "
-                       f"({new / old:.1f}x, flag threshold {factor}x)")
+                       f"({new / base:.1f}x, flag threshold {factor}x)")
     # chaos scenario matrix (ISSUE 14, tools/bench_chaos.py): per-
     # scenario recovery_s growth, keyed by scenario name so a new
     # scenario joining the matrix starts its own trend — never fails,
