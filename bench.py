@@ -1263,6 +1263,12 @@ def main() -> None:
         extra["we"] = we_extra
     if cluster_stats is not None:
         extra["cluster"] = cluster_stats
+    # SLO sentinel episode counts (ISSUE 19, telemetry/slo.py): lifted
+    # first-class from the chaos matrix so run_bench can flag an
+    # objective that fired this run but not last, by name
+    if isinstance(chaos_stats, dict) \
+            and isinstance(chaos_stats.get("slo"), dict):
+        extra["slo"] = chaos_stats["slo"]
     if _DEGENERATE_DIFFERENTIALS:
         # floored noise-negative slopes (see _differential): the raw pairs
         # stay on the record so a degenerate measurement is visible

@@ -71,6 +71,7 @@ from multiverso_tpu.telemetry import exporter as _exporter
 from multiverso_tpu.telemetry import flightrec as _flight
 from multiverso_tpu.telemetry import memstats as _memstats
 from multiverso_tpu.telemetry import profiler as _profiler
+from multiverso_tpu.telemetry import slo as _slo
 from multiverso_tpu.telemetry import tenants as _tenants
 from multiverso_tpu.telemetry import trace as _trace
 from multiverso_tpu.telemetry import watchdog as _watchdog
@@ -1045,6 +1046,16 @@ class PSService:
             tenants = _tenants.stats_snapshot()
             if tenants:
                 payload["tenants"] = tenants
+        except Exception:   # noqa: BLE001
+            pass
+        # SLO sentinel (telemetry/slo.py): per-objective burn rates,
+        # firing state, episode counts, and the named straggler.
+        # Process-global (rank 0's sentinel judges the cluster);
+        # OMITTED while disarmed — the payload stays additive.
+        try:
+            slo_block = _slo.stats_snapshot()
+            if slo_block:
+                payload["slo"] = slo_block
         except Exception:   # noqa: BLE001
             pass
         return payload

@@ -423,6 +423,13 @@ class ReplicaPool:
         ages = [m.replica.age_s() for m in self._members if m.active]
         return min(ages) if ages else float("inf")
 
+    def spares_left(self) -> int:
+        """Warm spares this pool could still promote — the autoscaling
+        signal (telemetry/signals.py ``spares_left``): a grow
+        recommendation is only actionable while this is positive."""
+        with self._lock:
+            return sum(1 for m in self._members if not m.active)
+
     def stats_entry(self) -> Dict[str, Any]:
         """The merged serving-block entry for this table: summed
         member counters under the PR-8 replica-entry keys (the

@@ -45,6 +45,8 @@ import time
 from typing import Any, Dict, List, Optional
 
 from multiverso_tpu.telemetry import hotkeys as _hotkeys
+from multiverso_tpu.telemetry import signals as _signals
+from multiverso_tpu.telemetry import slo as _slo
 from multiverso_tpu.telemetry.histogram import Histogram
 from multiverso_tpu.utils import config, log
 
@@ -508,6 +510,17 @@ def merge_cluster(stats_by_rank: Dict[int, Any],
                 "top": merged["items"][:32],
                 "hit_rate_curve": _hotkeys.hit_rate_curve(merged),
             }
+    # SLO sentinel passthrough (telemetry/slo.py): the block is judged
+    # by ONE sentinel (rank 0's process) and identical wherever it
+    # appears — first answering rank wins. A locally-armed sentinel
+    # overwrites this with a fresher snapshot right after the merge
+    # (poll_once), so the passthrough is what remote pollers (mvtop
+    # against another process's cluster) render.
+    for r in sorted(stats_by_rank):
+        st = stats_by_rank[r]
+        if isinstance(st, dict) and isinstance(st.get("slo"), dict):
+            rec["slo"] = st["slo"]
+            break
     return rec
 
 
@@ -652,6 +665,10 @@ def compact_record(rec: Dict, top: int = 8,
         # per-tenant serve/shed/share digest + verdict state (already
         # merged compact) — run_bench compares victim-tenant p99/shed
         out["tenants"] = rec["tenants"]
+    if rec.get("slo"):
+        # sentinel verdict block (already compact): per-objective burn
+        # rates + firing state, episode totals, the named straggler
+        out["slo"] = rec["slo"]
     mons: Dict[str, Any] = {}
     for n, m in sorted(rec.get("monitors", {}).items()):
         if not m.get("timed"):
@@ -772,6 +789,21 @@ class ClusterAggregator:
             rec = merge_cluster(stats, health, world=self.service.world)
             derive_rates(self.last(), rec)
             self._history.append(rec)
+            # SLO sentinel + signal bus ride every poll (telemetry/slo.py,
+            # telemetry/signals.py): judge the fresh record against the
+            # rolling history, refresh rec["slo"], publish the typed
+            # autoscaling signals. Telemetry never breaks the poll.
+            try:
+                snap = _slo.SENTINEL.on_poll(rec, list(self._history),
+                                             self.directory)
+                if snap is not None:
+                    rec["slo"] = snap
+            except Exception as e:   # noqa: BLE001
+                log.error("SLO sentinel poll failed: %s", e)
+            try:
+                _signals.publish_record(rec)
+            except Exception as e:   # noqa: BLE001
+                log.error("signal bus publish failed: %s", e)
             try:
                 self._write(rec)
             except OSError as e:
@@ -809,6 +841,8 @@ class ClusterAggregator:
             shards[tname] = flat
         payload = {"rank": "cluster", "monitors": rec.get("monitors", {}),
                    "shards": shards}
+        if isinstance(rec.get("slo"), dict):
+            payload["slo"] = rec["slo"]    # mv_slo_* gauges
         ppath = os.path.join(self.directory, "cluster.prom")
         tmp = ppath + ".tmp"
         with open(tmp, "w") as f:

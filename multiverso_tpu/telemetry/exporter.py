@@ -217,6 +217,44 @@ def prometheus_text(payload: Dict) -> str:
                      f"{ten.get('episodes', 0)}")
         lines.append(f'mv_tenant_verdict_active{{rank="{rank}"}} '
                      f"{1 if ten.get('active') else 0}")
+    # SLO sentinel (telemetry/slo.py): per-objective burn-rate gauges +
+    # firing state + episode counters off the MSG_STATS "slo" block.
+    # Absent block (sentinel disarmed) = no series, like every plane.
+    slo = payload.get("slo")
+    if isinstance(slo, dict):
+        lines.append("# TYPE mv_slo_firing gauge")
+        lines.append("# TYPE mv_slo_burn_fast gauge")
+        lines.append("# TYPE mv_slo_burn_slow gauge")
+        lines.append("# TYPE mv_slo_value gauge")
+        lines.append("# TYPE mv_slo_objective_episodes counter")
+        lines.append("# TYPE mv_slo_episodes counter")
+        for name in sorted(slo.get("objectives") or {}):
+            o = slo["objectives"][name]
+            if not isinstance(o, dict):
+                continue
+            lbl = (f'{{objective="{_prom_name(name)}",'
+                   f'kind="{_prom_name(o.get("kind") or "?")}",'
+                   f'table="{_prom_name(o.get("table") or "")}",'
+                   f'rank="{rank}"}}')
+            lines.append(f"mv_slo_firing{lbl} "
+                         f"{1 if o.get('firing') else 0}")
+            lines.append(f"mv_slo_burn_fast{lbl} "
+                         f"{o.get('burn_fast', 0.0)}")
+            lines.append(f"mv_slo_burn_slow{lbl} "
+                         f"{o.get('burn_slow', 0.0)}")
+            v = o.get("value")
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                lines.append(f"mv_slo_value{lbl} {v}")
+            lines.append(f"mv_slo_objective_episodes{lbl} "
+                         f"{o.get('episodes', 0)}")
+        lines.append(f'mv_slo_episodes{{rank="{rank}"}} '
+                     f"{slo.get('episodes', 0)}")
+        s = slo.get("straggler")
+        if isinstance(s, dict) and isinstance(s.get("rank"), int):
+            lines.append(
+                f'mv_slo_straggler_rank{{attribution='
+                f'"{_prom_name(s.get("attribution") or "?")}",'
+                f'rank="{rank}"}} {s["rank"]}')
     return "\n".join(lines) + "\n"
 
 

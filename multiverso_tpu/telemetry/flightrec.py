@@ -140,6 +140,12 @@ EV_FAULT_PLANE = 41       # fault plane armed / disarmed / phase flip
 # discipline as the EV_MEM_* verdicts)
 EV_TENANT_SHED = 42       # admission refused a read on a tenant budget
 EV_TENANT_VERDICT = 43    # noisy-neighbor episode opened
+# SLO sentinel (telemetry/slo.py): ONE event per episode transition —
+# the burn-rate alert firing and later clearing each land exactly one
+# ring write (sentinel-deduped like the tenant verdict), so a chaos
+# run's tape reads objective-first without per-poll flooding
+EV_SLO_FIRED = 44         # an objective's burn-rate episode opened
+EV_SLO_CLEARED = 45       # the episode's fast window re-entered budget
 
 EV_NAMES = {
     EV_SEND: "send", EV_ACK: "ack", EV_ERR: "err", EV_RECV: "recv",
@@ -171,6 +177,8 @@ EV_NAMES = {
     EV_FAULT_PLANE: "fault.plane",
     EV_TENANT_SHED: "tenant.shed",
     EV_TENANT_VERDICT: "tenant.verdict",
+    EV_SLO_FIRED: "slo.fired",
+    EV_SLO_CLEARED: "slo.cleared",
 }
 
 # ---------------------------------------------------------------------- #
@@ -210,8 +218,11 @@ MSG_EV_COVERAGE = {
                   EV_FAULT_INJECT),
     # probe traffic itself stays off the tape (PR 4) — but the tenant
     # verdict sweep rides the stats pull and lands ONE event per
-    # noisy-neighbor episode (ledger-deduped, never a per-poll flood)
-    "MSG_STATS": (EV_TENANT_VERDICT,),
+    # noisy-neighbor episode (ledger-deduped, never a per-poll flood),
+    # and the SLO sentinel judges every objective on the aggregator's
+    # stats poll: an episode firing/clearing is one event each,
+    # sentinel-deduped under the same discipline
+    "MSG_STATS": (EV_TENANT_VERDICT, EV_SLO_FIRED, EV_SLO_CLEARED),
     "MSG_HEALTH": (),        # probe: excluded from the tape (PR 4)
     "MSG_SNAPSHOT": (EV_SNAPSHOT_SERVE, EV_REPLICA_PULL,
                      EV_FAULT_INJECT, EV_TENANT_SHED),

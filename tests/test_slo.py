@@ -1,0 +1,586 @@
+"""SLO sentinel + autoscaling signal bus (ISSUE 19): the burn-rate
+math against an independent integer-grid oracle, spec validation at
+arm time, the availability SLI's reachability/progress/pent-demand
+semantics, the fire -> hold -> clear -> refire episode lifecycle with
+its artifacts (alerts.jsonl + flightrec ring), the false-fire guard on
+quiet histories, straggler naming on a skewed 2-rank record (synthetic
+AND the real merged-record shape), the typed signal bus +
+``mvautoscale.recommend`` on a live pool with a warm spare, mvtop's
+SLO panel / ``--assert-slo`` exit, run_bench's fired-now-not-before
+flag, and the check_obs_surface lint-7 dark-key rule."""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.ps.service import FileRendezvous, PSContext, PSService
+from multiverso_tpu.ps.tables import AsyncMatrixTable
+from multiverso_tpu.serving.pool import ReplicaPool
+from multiverso_tpu.telemetry import aggregator
+from multiverso_tpu.telemetry import flightrec
+from multiverso_tpu.telemetry import signals
+from multiverso_tpu.telemetry import slo
+from multiverso_tpu.utils import config
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+
+
+@pytest.fixture(autouse=True)
+def _clean_sentinel():
+    """The sentinel and bus are process-global (the aggregator drives
+    them on every poll anywhere in this test process) — every test
+    starts and ends disarmed."""
+    slo.reset()
+    signals.reset()
+    config.set_flag("slo_spec", "")
+    yield
+    slo.reset()
+    signals.reset()
+    config.set_flag("slo_spec", "")
+
+
+def _stall_obj(**kw):
+    """The oracle tests' workhorse objective: stall_fraction is the
+    simplest SLI (max over profile blocks), so the burn math — not the
+    measurement — is what the grid exercises."""
+    base = {"name": "stall", "kind": "stall_fraction", "target": 0.9,
+            "max": 0.5, "fast_window_s": 4.0, "slow_window_s": 10.0,
+            "fast_burn": 1.0, "slow_burn": 0.1}
+    base.update(kw)
+    return base
+
+
+def _stall_rec(ts, v=None):
+    """One synthetic poll: ``v=None`` is a record with no evidence
+    (profile absent — the poll must sit out, not count as good)."""
+    rec = {"ts": float(ts), "ranks": {"0": {"status": "serving"},
+                                     "1": {"status": "serving"}}}
+    if v is not None:
+        rec["profile"] = {"0": {"stall_fraction": float(v)}}
+    return rec
+
+
+# ---------------------------------------------------------------------- #
+# spec loading + validation (arm-time failure, not judge-time garbage)
+# ---------------------------------------------------------------------- #
+class TestSpec:
+    def test_unknown_kind_raises(self):
+        with pytest.raises(ValueError, match="unknown SLO objective"):
+            slo.normalize_spec({"objectives": [
+                {"name": "x", "kind": "made_up_kind"}]})
+
+    def test_duplicate_name_raises(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            slo.normalize_spec({"objectives": [
+                {"name": "x", "kind": "staleness", "max": 1.0},
+                {"name": "x", "kind": "shed_rate", "max": 0.1}]})
+
+    def test_bad_target_raises(self):
+        with pytest.raises(ValueError, match="target"):
+            slo.normalize_spec({"objectives": [
+                {"name": "x", "kind": "staleness", "target": 1.0,
+                 "max": 1.0}]})
+
+    def test_threshold_ms_alias_and_floor_default(self):
+        spec = slo.normalize_spec({"objectives": [
+            {"name": "lat", "kind": "serve_latency_p99",
+             "threshold_ms": 5.0},
+            {"name": "avail", "kind": "availability"}]})
+        lat, avail = spec["objectives"]
+        assert lat["max"] == 5.0
+        assert avail["min"] == 1.0       # floor kinds default min=1.0
+        assert lat["fast_window_s"] == 60.0   # spec-level defaults fill
+
+    def test_load_spec_inline_and_path(self, tmp_path):
+        raw = {"objectives": [{"name": "s", "kind": "staleness",
+                               "max": 2.0}]}
+        assert slo.load_spec(json.dumps(raw)) == raw
+        p = tmp_path / "slo.json"
+        p.write_text(json.dumps(raw))
+        assert slo.load_spec(str(p)) == raw
+
+    def test_every_declared_kind_normalizes(self):
+        """OBJECTIVE_KINDS is the promise the lint enforces — every
+        kind must actually be armable."""
+        spec = slo.normalize_spec({"objectives": [
+            {"name": f"o{i}", "kind": k, "max": 1.0}
+            for i, k in enumerate(slo.OBJECTIVE_KINDS)]})
+        assert len(spec["objectives"]) == len(slo.OBJECTIVE_KINDS)
+
+
+# ---------------------------------------------------------------------- #
+# burn-rate math vs an independent integer-grid oracle
+# ---------------------------------------------------------------------- #
+def _oracle(obj, grid, now):
+    """Brute-force reference: same definition, independent code path.
+    ``grid`` is [(ts, value-or-None)]."""
+    budget = max(1.0 - obj["target"], 1e-4)
+    out = {}
+    for label, window in (("fast", obj["fast_window_s"]),
+                          ("slow", obj["slow_window_s"])):
+        hits = [(ts, v) for ts, v in grid
+                if now - window <= ts <= now and v is not None]
+        bad = sum(1 for _ts, v in hits if v > obj["max"])
+        out[label] = round((bad / len(hits)) / budget, 4) if hits \
+            else 0.0
+    return out
+
+
+class TestBurnOracle:
+    # bad polls at ts 7 and 8, a no-evidence hole at ts 5
+    GRID = [(t, (0.9 if t in (7, 8) else None if t == 5 else 0.1))
+            for t in range(11)]
+
+    def _history(self):
+        return [_stall_rec(ts, v) for ts, v in self.GRID]
+
+    def test_grid_matches_oracle_at_every_now(self):
+        obj = slo.normalize_spec(
+            {"objectives": [_stall_obj()]})["objectives"][0]
+        hist = self._history()
+        for now in range(3, 14):
+            br = slo.burn_rates(obj, hist, now=float(now))
+            exp = _oracle(obj, self.GRID, now)
+            assert br["fast"] == exp["fast"], f"fast @ now={now}"
+            assert br["slow"] == exp["slow"], f"slow @ now={now}"
+
+    def test_hand_computed_point(self):
+        """One point fully by hand so the oracle itself is anchored:
+        now=10, fast window [6,10] -> 5 measured, 2 bad ->
+        (2/5)/0.1 = 4.0; slow window [0,10] -> 10 measured (ts 5 sat
+        out), 2 bad -> (2/10)/0.1 = 2.0."""
+        obj = slo.normalize_spec(
+            {"objectives": [_stall_obj()]})["objectives"][0]
+        br = slo.burn_rates(obj, self._history(), now=10.0)
+        assert (br["fast"], br["slow"]) == (4.0, 2.0)
+        assert (br["n_fast"], br["bad_fast"]) == (5, 2)
+        assert (br["n_slow"], br["bad_slow"]) == (10, 2)
+        assert br["value"] == 0.1       # newest measured value
+
+    def test_empty_window_burns_zero(self):
+        obj = slo.normalize_spec(
+            {"objectives": [_stall_obj()]})["objectives"][0]
+        br = slo.burn_rates(obj, self._history(), now=30.0)
+        assert br["fast"] == 0.0 and br["n_fast"] == 0
+        assert slo.burn_rates(obj, [], now=0.0)["fast"] == 0.0
+
+    def test_floor_kind_violates_below_min(self):
+        obj = slo.normalize_spec({"objectives": [
+            {"name": "a", "kind": "availability", "target": 0.9,
+             "min": 1.0}]})["objectives"][0]
+        assert slo.violates(obj, 0.5) and not slo.violates(obj, 1.0)
+        mx = slo.normalize_spec({"objectives": [
+            {"name": "s", "kind": "staleness",
+             "max": 2.0}]})["objectives"][0]
+        assert slo.violates(mx, 2.5) and not slo.violates(mx, 2.0)
+
+
+# ---------------------------------------------------------------------- #
+# the availability SLI: reachability AND progress-vs-demand
+# ---------------------------------------------------------------------- #
+class TestAvailability:
+    OBJ = {"name": "a", "kind": "availability", "table": "tb",
+           "target": 0.9, "min": 1.0}
+
+    def test_unreachable_rank_is_the_fraction(self):
+        rec = {"ts": 1.0, "world": 2,
+               "ranks": {"0": {"status": "serving"},
+                         "1": {"status": "unreachable"}}}
+        assert slo.measure(self.OBJ, rec) == 0.5
+
+    def test_progress_is_available(self):
+        rec = {"ts": 1.0, "world": 2,
+               "ranks": {"0": {"status": "serving"},
+                         "1": {"status": "serving"}},
+               "rates": {"tb": {"adds_per_s": 12.0}}}
+        assert slo.measure(self.OBJ, rec) == 1.0
+
+    def test_pent_demand_without_progress_is_outage(self):
+        rec = {"ts": 1.0, "world": 2,
+               "ranks": {"0": {"status": "serving"},
+                         "1": {"status": "serving"}},
+               "rates": {"tb": {"adds_per_s": 0.0, "gets_per_s": 0.0}},
+               "memory": {"totals": {"retained_bytes": 4096}}}
+        assert slo.measure(self.OBJ, rec) == 0.0
+
+    def test_idle_sits_out(self):
+        rec = {"ts": 1.0, "world": 2,
+               "ranks": {"0": {"status": "serving"},
+                         "1": {"status": "serving"}},
+               "rates": {"tb": {"adds_per_s": 0.0}}}
+        assert slo.measure(self.OBJ, rec) is None
+
+    def test_first_poll_without_rates_sits_out(self):
+        rec = {"ts": 1.0, "world": 2,
+               "ranks": {"0": {"status": "serving"},
+                         "1": {"status": "serving"}}}
+        assert slo.measure(self.OBJ, rec) is None
+
+
+# ---------------------------------------------------------------------- #
+# episode lifecycle: fire once -> hold -> clear -> refire + artifacts
+# ---------------------------------------------------------------------- #
+class TestLifecycle:
+    def _drive(self, sentinel, values, directory=""):
+        """Feed (ts, value) polls one at a time, history growing the
+        way the aggregator's does; returns the snapshot stream."""
+        hist, snaps = [], []
+        for ts, v in values:
+            rec = _stall_rec(ts, v)
+            hist.append(rec)
+            snaps.append(sentinel.on_poll(rec, list(hist), directory))
+        return snaps
+
+    def test_fire_hold_clear_refire(self, tmp_path):
+        s = slo.SLOSentinel({"objectives": [_stall_obj()]})
+        ring_before = len([e for e in flightrec.RECORDER.snapshot()
+                           if e[2] in (flightrec.EV_SLO_FIRED,
+                                       flightrec.EV_SLO_CLEARED)])
+        # good 0-3, bad 4-5 (fire at 4, hold at 5), good 6-10 (the bad
+        # polls age out of the 4 s fast window -> clear at 10), bad 11
+        # (refire: slow window still remembers the first episode)
+        vals = [(t, 0.9 if t in (4, 5, 11) else 0.1) for t in range(12)]
+        snaps = self._drive(s, vals, directory=str(tmp_path))
+        firing = [bool(sn["firing"]) for sn in snaps]
+        assert firing == [False] * 4 + [True] * 6 + [False] + [True]
+        assert snaps[4]["episodes"] == 1
+        assert snaps[5]["episodes"] == 1        # HOLD is not a refire
+        assert snaps[11]["episodes"] == 2
+        kinds = [e["kind"] for e in snaps[-1]["recent"]]
+        assert kinds == ["slo.fired", "slo.cleared", "slo.fired"]
+        # artifacts: one alerts.jsonl line per transition, same order
+        with open(tmp_path / "alerts.jsonl") as f:
+            alerts = [json.loads(ln) for ln in f]
+        assert [a["kind"] for a in alerts] == kinds
+        assert [a["ts"] for a in alerts] == [4.0, 10.0, 11.0]
+        assert all(a["objective"] == "stall" for a in alerts)
+        # and one flightrec EV pair + refire in the always-on ring
+        ring = [e for e in flightrec.RECORDER.snapshot()
+                if e[2] in (flightrec.EV_SLO_FIRED,
+                            flightrec.EV_SLO_CLEARED)][ring_before:]
+        assert [e[2] for e in ring] == [flightrec.EV_SLO_FIRED,
+                                        flightrec.EV_SLO_CLEARED,
+                                        flightrec.EV_SLO_FIRED]
+        assert "stall" in ring[0][7]    # the note names the objective
+
+    def test_false_fire_guard_on_quiet_history(self, tmp_path):
+        """A healthy/idle stream must end with evals > 0 and ZERO
+        episodes — availability polls with no evidence sit out rather
+        than count against the budget."""
+        s = slo.SLOSentinel({"objectives": [
+            {"name": "avail", "kind": "availability", "table": "tb",
+             "target": 0.9, "fast_burn": 1.0, "slow_burn": 0.1}]})
+        hist = []
+        for t in range(30):
+            rec = {"ts": float(t), "world": 2,
+                   "ranks": {"0": {"status": "serving"},
+                             "1": {"status": "serving"}}}
+            if t % 2:    # alternate progressing and idle polls
+                rec["rates"] = {"tb": {"adds_per_s": 9.0}}
+            hist.append(rec)
+            snap = s.on_poll(rec, list(hist), str(tmp_path))
+        assert snap["evals"] == 30
+        assert snap["episodes"] == 0 and snap["firing"] == []
+        assert not os.path.exists(tmp_path / "alerts.jsonl")
+
+    def test_disarmed_is_none_and_flag_arms_lazily(self):
+        s = slo.SLOSentinel()
+        assert s.on_poll(_stall_rec(0, 0.1), [_stall_rec(0, 0.1)]) \
+            is None
+        config.set_flag("slo_spec", json.dumps(
+            {"objectives": [_stall_obj()]}))
+        snap = slo.SLOSentinel().on_poll(
+            _stall_rec(1, 0.1), [_stall_rec(1, 0.1)])
+        assert snap is not None and "stall" in snap["objectives"]
+
+    def test_note_value_feeds_external_kinds(self):
+        s = slo.SLOSentinel({"objectives": [
+            {"name": "rec", "kind": "recovery_s", "target": 0.5,
+             "max": 3.0, "fast_window_s": 10.0, "slow_window_s": 10.0,
+             "fast_burn": 1.0, "slow_burn": 0.5}]})
+        s.note_value("rec", 9.0)         # measured where it happened
+        hist = [_stall_rec(t) for t in range(3)]
+        for i, rec in enumerate(hist):
+            snap = s.on_poll(rec, hist[:i + 1])
+        assert snap["firing"] == ["rec"]
+        assert snap["objectives"]["rec"]["value"] == 9.0
+
+
+# ---------------------------------------------------------------------- #
+# straggler naming on a skewed 2-rank record
+# ---------------------------------------------------------------------- #
+class TestStraggler:
+    def test_compute_skew_names_rank_and_phase(self):
+        rec = {"ranks": {"0": {"status": "serving"},
+                         "1": {"status": "serving"}},
+               "profile": {"0": {"phases": {"serve": 1.0}},
+                           "1": {"phases": {"serve": 3.0,
+                                            "apply": 9.0}}}}
+        st = slo.straggler(rec)
+        assert st["rank"] == 1 and st["attribution"] == "compute"
+        assert st["top_phase"] == "apply"
+
+    def test_wire_skew_names_the_backlogged_rank(self):
+        rec = {"ranks": {"0": {"status": "serving", "queue_depth": 0},
+                         "1": {"status": "serving", "queue_depth": 64,
+                               "oldest_inflight_s": 2.0}}}
+        st = slo.straggler(rec)
+        assert st["rank"] == 1 and st["attribution"] == "wire"
+
+    def test_quiet_or_single_rank_has_no_straggler(self):
+        assert slo.straggler({"ranks": {"0": {"status": "serving"}}}) \
+            is None
+        quiet = {"ranks": {"0": {"status": "serving"},
+                           "1": {"status": "serving"}}}
+        assert slo.straggler(quiet) is None   # nothing moved: no blame
+
+    def test_real_merged_record_shape(self, tmp_path):
+        """The detector runs on the aggregator's ACTUAL merged record
+        (key spellings, health-entry fields), skewed on the real
+        record rather than a hand-built lookalike."""
+        ctx0, ctx1 = _live_world(tmp_path)
+        try:
+            agg = aggregator.ClusterAggregator(ctx0.service)
+            rec = agg.poll_once()
+            ranks = rec.get("ranks") or {}
+            assert len(ranks) == 2
+            slow = sorted(ranks)[1]
+            ranks[slow]["queue_depth"] = 128     # skew the real record
+            st = slo.straggler(rec)
+            assert st is not None
+            assert str(st["rank"]) == str(slow)
+            assert st["attribution"] in ("wire", "compute", "stall")
+        finally:
+            ctx0.close()
+            ctx1.close()
+
+
+# ---------------------------------------------------------------------- #
+# signal bus + mvautoscale on a live pool with a warm spare
+# ---------------------------------------------------------------------- #
+def _live_world(tmp_path, table=False):
+    for k, v in dict(ps_native=False, ps_timeout=30.0,
+                     ps_connect_timeout=5.0, ps_replay=False,
+                     ps_reconnect_backoff=0.2).items():
+        config.set_flag(k, v)
+    rdv = FileRendezvous(str(tmp_path / "rdv"))
+    ctx0 = PSContext(0, 2, PSService(0, 2, rdv))
+    ctx1 = PSContext(1, 2, PSService(1, 2, rdv))
+    if not table:
+        return ctx0, ctx1
+    t0 = AsyncMatrixTable(16, 4, name="pl", ctx=ctx0)
+    AsyncMatrixTable(16, 4, name="pl", ctx=ctx1)
+    return ctx0, ctx1, t0
+
+
+class TestSignalsAndAutoscale:
+    def _mvautoscale(self):
+        if TOOLS not in sys.path:
+            sys.path.insert(0, TOOLS)
+        import mvautoscale
+        return mvautoscale
+
+    def test_bus_subscribe_latest_and_filter(self):
+        bus = signals.SignalBus()
+        seen, shed_only = [], []
+        unsub = bus.subscribe(seen.append)
+        bus.subscribe(shed_only.append, name="shed_rate")
+        sigs = [signals.Signal("shed_rate", "pl", 0.5, 1.0, {}),
+                signals.Signal("queue_depth", "pl", 3.0, 1.0, {})]
+        bus.publish(sigs)
+        assert [s.name for s in seen] == ["shed_rate", "queue_depth"]
+        assert [s.name for s in shed_only] == ["shed_rate"]
+        assert bus.latest("queue_depth", "pl").value == 3.0
+        snap = bus.snapshot()
+        assert snap["shed_rate"]["pl"]["value"] == 0.5
+        unsub()
+        bus.publish([signals.Signal("shed_rate", "pl", 0.1, 2.0, {})])
+        assert len(seen) == 2            # unsubscribed: no new delivery
+
+    def test_from_record_burn_rate_rides_the_slo_block(self):
+        rec = {"ts": 5.0, "slo": {
+            "objectives": {"a": {"burn_fast": 3.0},
+                           "b": {"burn_fast": 7.0}},
+            "firing": ["b"]}}
+        sigs = {s.name: s for s in signals.from_record(rec)}
+        assert sigs["burn_rate"].value == 7.0
+        assert sigs["burn_rate"].detail["objective"] == "b"
+        assert sigs["burn_rate"].detail["firing"] == ["b"]
+
+    def test_live_pool_publishes_and_recommends(self, tmp_path):
+        """The whole seam on a real 2-rank world: pool with one warm
+        spare -> aggregator polls publish typed signals on the process
+        bus -> mvautoscale.recommend turns the snapshot into a
+        verdict. Quiet 2-active pool = an actionable shrink; injected
+        shed pressure = grow (spare available) or a non-actionable
+        hold (spares exhausted)."""
+        mvautoscale = self._mvautoscale()
+        ctx0, ctx1, t0 = _live_world(tmp_path, table=True)
+        pool = ReplicaPool(t0, replicas=2, spares=1, refresh_s=0.1,
+                           probe_s=0.1, staleness_s=5.0, start=True)
+        try:
+            t0.add_rows(np.arange(16),
+                        np.ones((16, 4), np.float32))
+            t0.flush()
+            time.sleep(0.25)
+            pool.get_rows([1, 2, 3])
+            agg = aggregator.ClusterAggregator(ctx0.service)
+            agg.poll_once()
+            time.sleep(0.15)
+            rec = agg.poll_once()       # second poll: windowed rates
+            snap = signals.snapshot()   # the aggregator published it
+            assert snap["spares_left"]["pl"]["value"] == 1.0
+            assert snap["active_replicas"]["pl"]["value"] == 2.0
+            assert "queue_depth" in snap
+            # the CLI's derivation is the same pure path
+            cli_snap = mvautoscale.snapshot_from_record(rec)
+            assert cli_snap["spares_left"]["pl"]["value"] == 1.0
+            verdict = mvautoscale.recommend(snap)
+            assert verdict["action"] == "shrink"    # quiet 2>1 pool
+            assert verdict["actionable"]
+            # inject shed pressure: grow while the warm spare lasts
+            snap["shed_rate"] = {"pl": {"value": 0.4, "ts": 0.0,
+                                        "detail": {}}}
+            grow = mvautoscale.recommend(snap)
+            assert grow["action"] == "grow" and grow["actionable"]
+            assert "shed_rate[pl]" in grow["reason"]
+            snap["spares_left"]["pl"]["value"] = 0.0
+            starved = mvautoscale.recommend(snap)
+            assert starved["action"] == "hold"
+            assert not starved["actionable"]
+            assert "no warm spares" in starved["reason"]
+        finally:
+            pool.close()
+            ctx0.close()
+            ctx1.close()
+
+    def test_recommend_is_conservative_without_signals(self):
+        mvautoscale = self._mvautoscale()
+        verdict = mvautoscale.recommend({})
+        assert verdict["action"] == "hold"
+        assert not verdict["actionable"]
+
+    def test_cli_refuses_without_dry_run(self, capsys):
+        mvautoscale = self._mvautoscale()
+        assert mvautoscale.main(["--rdv", "/nonexistent"]) == 2
+        assert "dry-run" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------- #
+# mvtop SLO panel + --assert-slo
+# ---------------------------------------------------------------------- #
+class TestMvtopSlo:
+    def _mvtop(self):
+        if TOOLS not in sys.path:
+            sys.path.insert(0, TOOLS)
+        import mvtop
+        return mvtop
+
+    def test_render_shows_objectives_straggler_and_signals(self,
+                                                          tmp_path):
+        mvtop = self._mvtop()
+        ctx0, ctx1 = _live_world(tmp_path)
+        try:
+            agg = aggregator.ClusterAggregator(ctx0.service)
+            rec = agg.poll_once()
+            rec["slo"] = {
+                "objectives": {"embed-avail": {
+                    "kind": "availability", "table": "embed",
+                    "firing": True, "episodes": 2, "burn_fast": 6.1,
+                    "burn_slow": 1.4, "value": 0.0}},
+                "firing": ["embed-avail"], "episodes": 2, "evals": 40,
+                "straggler": {"rank": 1, "attribution": "wire",
+                              "top_phase": None, "score": 1.7,
+                              "components": {}},
+                "recent": [{"kind": "slo.fired",
+                            "objective": "embed-avail", "episode": 2,
+                            "ts": 9.5}]}
+            out = mvtop.render(rec)
+            assert "slo:" in out and "embed-avail" in out
+            assert "FIRING" in out
+            assert "straggler" in out and "wire" in out
+        finally:
+            ctx0.close()
+            ctx1.close()
+
+    def test_assert_slo_exit_codes(self, tmp_path, capsys):
+        """``--once --assert-slo`` against a LIVE world: exit 0 while
+        the (armed) sentinel is clean, 3 the moment an objective
+        fires — the per-rank stats payload carries the sentinel block
+        through mvtop's one-shot merge."""
+        mvtop = self._mvtop()
+        ctx0, ctx1 = _live_world(tmp_path)
+        rdv_dir = str(tmp_path / "rdv")
+        try:
+            slo.arm({"objectives": [_stall_obj()]})
+            argv = ["--rdv", rdv_dir, "--once", "--assert-slo"]
+            assert mvtop.main(argv) == 0         # armed but clean
+            # drive the global sentinel into firing on synthetic polls
+            hist = [_stall_rec(t, 0.9) for t in range(5)]
+            for i in range(len(hist)):
+                slo.SENTINEL.on_poll(hist[i], hist[:i + 1])
+            assert slo.stats_snapshot()["firing"] == ["stall"]
+            assert mvtop.main(argv) == 3
+            assert "SLO firing" in capsys.readouterr().err
+        finally:
+            ctx0.close()
+            ctx1.close()
+
+
+# ---------------------------------------------------------------------- #
+# run_bench: an objective that fired now-but-not-before is flagged
+# ---------------------------------------------------------------------- #
+class TestRunBenchFlag:
+    def _flag(self, old_eps, new_eps):
+        if TOOLS not in sys.path:
+            sys.path.insert(0, TOOLS)
+        import run_bench
+        mk = lambda eps: {"extra": {"slo": {"episodes": eps}}}  # noqa
+        return [f for f in run_bench.flag_regressions(
+            mk(old_eps), mk(new_eps)) if "SLO objective" in f]
+
+    def test_new_episode_flags_by_name(self):
+        out = self._flag({"avail": 0}, {"avail": 2})
+        assert len(out) == 1 and "'avail'" in out[0]
+
+    def test_known_or_absent_episodes_stay_silent(self):
+        assert self._flag({"avail": 1}, {"avail": 3}) == []
+        assert self._flag({"avail": 0}, {"avail": 0}) == []
+
+
+# ---------------------------------------------------------------------- #
+# check_obs_surface lint 7: no dark kinds, no dark signals
+# ---------------------------------------------------------------------- #
+class TestLint7:
+    def _lint(self):
+        if TOOLS not in sys.path:
+            sys.path.insert(0, TOOLS)
+        import check_obs_surface
+        return check_obs_surface
+
+    def test_repo_surface_is_clean(self):
+        assert self._lint().slo_surface_findings() == []
+
+    def test_registries_read_by_ast_match_the_modules(self):
+        lint = self._lint()
+        assert tuple(lint.module_tuple(
+            "multiverso_tpu/telemetry/slo.py", "OBJECTIVE_KINDS")) \
+            == slo.OBJECTIVE_KINDS
+        assert tuple(lint.module_tuple(
+            "multiverso_tpu/telemetry/signals.py", "SIGNAL_NAMES")) \
+            == signals.SIGNAL_NAMES
+
+    def test_dark_kind_and_dark_signal_are_caught(self):
+        lint = self._lint()
+        found = lint.slo_surface_findings(
+            kinds=["made_up_dark_kind"], signal_names=["shed_rate"])
+        assert len(found) == 1 and "made_up_dark_kind" in found[0]
+        # against an empty renderer EVERYTHING goes dark
+        dark = lint.slo_surface_findings(renderer_text="")
+        assert len(dark) == (len(slo.OBJECTIVE_KINDS)
+                             + len(signals.SIGNAL_NAMES))

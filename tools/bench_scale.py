@@ -331,10 +331,17 @@ def run_point(n: int, seconds: float, rows: int, dim: int):
 
         agg = aggregator.global_aggregator()
         skew = None
+        straggler = None
         if agg is not None:
             rec = agg.poll_once()
             tbl = rec.get("tables", {}).get("scale") or {}
             skew = tbl.get("skew")
+            # per-point straggler attribution (telemetry/slo.py): the
+            # slowest rank at this shard count, named with its dominant
+            # component (compute/wire/stall) — the scale curve's E_n
+            # drop gets a who, not just a how-much
+            from multiverso_tpu.telemetry import slo as _slo
+            straggler = _slo.straggler(rec)
         summary = prof.summary()
         snap = devstats.stats_snapshot() or {}
         compiles = (snap.get("compiles_by_mesh") or {}).get(
@@ -346,6 +353,7 @@ def run_point(n: int, seconds: float, rows: int, dim: int):
             "workers": workers,
             "batch_rows": batch,
             "skew": skew,
+            "straggler": straggler,
             "stall_fraction": summary.get("stall_fraction"),
             "steps": summary.get("steps"),
             # zero steady-state recompiles is an ACCEPTANCE gate: the

@@ -65,6 +65,16 @@ def main():
     # the band assertion then proves the whole memory-observability
     # plane is also free at the PR-2 latency floor
     config.set_flag("memstats_interval_s", 1.0)
+    # ISSUE 19 acceptance config: the SLO sentinel arms from the
+    # declarative `slo_spec` flag (the production path — lazy arm on
+    # the first aggregator poll) with a quiet availability objective on
+    # the window-on table. Burn-rate math then runs on EVERY poll the
+    # band is measured under, and the run must end with zero episodes:
+    # a sentinel that pages on a healthy microbench is a broken
+    # sentinel, and one that never evaluated proves nothing
+    config.set_flag("slo_spec", json.dumps({"objectives": [
+        {"name": "small_add_availability", "kind": "availability",
+         "table": "sa_on", "target": 0.99}]}))
 
     rows, cols = 1024, 32
     rng = np.random.default_rng(5)
@@ -194,8 +204,31 @@ def main():
                 for arm in ("sa_on", "sa_off")}
         # cluster record: the final poll carries the merged 2-rank shard
         # stats, skew, and the hot-row sketch heads into the record
-        cluster = aggregator.compact_record(agg.poll_once())
+        final_rec = agg.poll_once()
+        cluster = aggregator.compact_record(final_rec)
         cluster["polls"] = len(agg.history())
+        # ISSUE 19 acceptance, asserted in-run like parity: the flag-
+        # armed sentinel must have actually judged the polls the band
+        # was measured under (evals > 0 proves the lazy arm fired and
+        # burn-rate math ran), and a healthy microbench must end with
+        # ZERO episodes — the false-fire guard at the latency floor
+        slo_snap = final_rec.get("slo") or {}
+        if int(slo_snap.get("evals") or 0) < 1:
+            raise AssertionError(
+                "slo_spec flag never armed the sentinel: the band "
+                "above would be measured without the SLO plane")
+        if int(slo_snap.get("episodes") or 0) > 0:
+            raise AssertionError(
+                "SLO sentinel fired %r on a healthy small-add bench: "
+                "false alarm at the latency floor" % (
+                    slo_snap.get("recent"),))
+        slo_extra = {
+            "evals": int(slo_snap.get("evals") or 0),
+            "episodes": {name: int(o.get("episodes") or 0)
+                         for name, o in (slo_snap.get("objectives")
+                                         or {}).items()},
+            "firing": list(slo_snap.get("firing") or []),
+        }
         # memory plane, asserted live like the aggregator above: the
         # sampler must have actually sampled during the timed loops
         # (memstats_interval_s=1 was the acceptance config, and the
@@ -216,6 +249,7 @@ def main():
         memstats_samples=mem_samples, memory=mem,
         devstats_live=devstats.enabled(),
         tenant_default_ops=tenant_default_ops,
+        slo=slo_extra,
         # ISSUE 14 acceptance evidence: the fault-injection plane is
         # COMPILED IN (ps/service.py imports it unconditionally; its
         # hook guards ran on every timed add above) but DISARMED —

@@ -283,6 +283,72 @@ def tenant_surface_findings(keys_by_src: Dict[str, List[str]] = None,
 
 
 # ---------------------------------------------------------------------- #
+# slo-surface rule (lint 7): every objective kind and bus signal renders
+# ---------------------------------------------------------------------- #
+# module-level string-tuple registries that ARE the SLO surface: the
+# sentinel's objective kinds and the signal bus's published names. Read
+# by ast (no import — slo.py pulls in the config plane, and this lint
+# must run on a bare host).
+_SLO_REGISTRIES = (
+    ("multiverso_tpu/telemetry/slo.py", "OBJECTIVE_KINDS",
+     "SLO objective kind"),
+    ("multiverso_tpu/telemetry/signals.py", "SIGNAL_NAMES",
+     "signal-bus name"),
+)
+
+
+def module_tuple(rel_path: str, name: str,
+                 repo: str = _REPO) -> List[str]:
+    """The strings of a module-level ``NAME = ("a", "b", ...)`` tuple
+    assignment, read by ast so the lint sees the registry the moment
+    it is committed, importable or not."""
+    with open(os.path.join(repo, rel_path)) as f:
+        tree = ast.parse(f.read())
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if any(isinstance(t, ast.Name) and t.id == name
+               for t in node.targets):
+            return [str(v) for v in ast.literal_eval(node.value)]
+    return []
+
+
+def slo_surface_findings(kinds: List[str] = None,
+                         signal_names: List[str] = None,
+                         renderer_text: str = None) -> List[str]:
+    """Lint 7: every objective kind ``telemetry/slo.py`` can judge and
+    every signal name ``telemetry/signals.py`` can publish must appear
+    quoted in ``tools/mvtop.py`` or ``tools/dump_metrics.py`` — the
+    lint-3 rule applied to the SLO plane with NO allowlist: an
+    objective kind no pane can show is a verdict into the void, and a
+    bus signal nothing renders is an autoscaling input no operator can
+    audit. Injectable so tests can prove the rule catches a fabricated
+    dark kind."""
+    if kinds is None:
+        kinds = module_tuple(*_SLO_REGISTRIES[0][:2])
+    if signal_names is None:
+        signal_names = module_tuple(*_SLO_REGISTRIES[1][:2])
+    if renderer_text is None:
+        renderer_text = ""
+        for rel in _RENDERERS:
+            with open(os.path.join(_REPO, rel)) as f:
+                renderer_text += f.read()
+    findings = []
+    for label, (rel, _reg, what) in (("kind", _SLO_REGISTRIES[0]),
+                                     ("signal", _SLO_REGISTRIES[1])):
+        names = kinds if label == "kind" else signal_names
+        for key in names:
+            if f'"{key}"' in renderer_text or f"'{key}'" in renderer_text:
+                continue
+            findings.append(
+                f"{what} {key!r} (declared in {rel}): rendered by "
+                "neither tools/mvtop.py nor tools/dump_metrics.py — "
+                "add it to the SLO panel / _slo_lines table so the "
+                "sentinel's verdicts cannot go dark")
+    return findings
+
+
+# ---------------------------------------------------------------------- #
 # regression-key rule (lint 5): every tracked bench key has a producer
 # ---------------------------------------------------------------------- #
 def regression_paths(repo: str = _REPO) -> List[tuple]:
@@ -383,6 +449,7 @@ def check() -> List[str]:
     findings.extend(collective_coverage_findings())
     findings.extend(regression_key_findings())
     findings.extend(tenant_surface_findings())
+    findings.extend(slo_surface_findings())
     return findings
 
 

@@ -223,6 +223,63 @@ def _tenants_lines(ten: Dict) -> List[str]:
     return lines
 
 
+# objective kind -> SLI unit for the value column (check_obs_surface
+# lint 7: every telemetry/slo.py objective kind must render here or in
+# mvtop — an objective no renderer can show is a verdict into the void)
+_SLO_KIND_UNITS = {
+    "serve_latency_p99": "ms", "add_latency_p99": "ms",
+    "staleness": "s", "shed_rate": "", "availability": "",
+    "stall_fraction": "", "steady_recompiles": "",
+    "recovery_s": "s", "scale_efficiency": "",
+}
+
+
+def _slo_lines(slo: Dict) -> List[str]:
+    """MSG_STATS ``slo`` block (telemetry/slo.py sentinel snapshot) ->
+    the per-objective burn-rate table + straggler + recent episodes.
+    One renderer for both the per-rank payload and the aggregator's
+    merged cluster record (identical shape — the merge passes the
+    armed rank's snapshot through)."""
+    firing = slo.get("firing") or []
+    lines = ["slo: evals=%s episodes=%s %s" % (
+        slo.get("evals", 0), slo.get("episodes", 0),
+        ("FIRING " + ",".join(firing)) if firing else "ok")]
+    objs = slo.get("objectives") or {}
+    if objs:
+        lines.append(f"  {'objective':<26} {'kind':<19} {'state':<7} "
+                     f"{'value':>12} {'burn_f':>7} {'burn_s':>7} "
+                     f"{'eps':>4}")
+        for name in sorted(objs):
+            o = objs[name]
+            kind = o.get("kind") or "?"
+            val = o.get("value")
+            unit = _SLO_KIND_UNITS.get(kind, "")
+            cell = "-" if val is None else f"{val:.4g}{unit}"
+            bf, bs = o.get("burn_fast"), o.get("burn_slow")
+            lines.append(
+                f"  {name:<26} {kind:<19} "
+                f"{'FIRING' if o.get('firing') else 'ok':<7} "
+                f"{cell:>12} "
+                f"{'-' if bf is None else format(bf, '.1f'):>7} "
+                f"{'-' if bs is None else format(bs, '.1f'):>7} "
+                f"{o.get('episodes', 0):>4}")
+    s = slo.get("straggler")
+    if isinstance(s, dict):
+        lines.append(
+            "  straggler: rank %s (%s%s) score=%.2f" % (
+                s.get("rank"), s.get("attribution"),
+                ", top phase " + s["top_phase"]
+                if s.get("top_phase") else "",
+                s.get("score") or 0.0))
+    for ev in (slo.get("recent") or [])[-6:]:
+        lines.append(
+            "  %s: %s ep%s value=%s burn=%s/%s" % (
+                ev.get("kind"), ev.get("objective"), ev.get("episode"),
+                ev.get("value"), ev.get("burn_fast"),
+                ev.get("burn_slow")))
+    return lines
+
+
 def format_record(rec: Dict) -> str:
     """One record -> the human table (pure function; tested directly).
     Cluster records (``kind: "cluster"``) dispatch to
@@ -279,6 +336,9 @@ def format_record(rec: Dict) -> str:
     ten = rec.get("tenants")
     if isinstance(ten, dict):
         lines.extend(_tenants_lines(ten))
+    slo = rec.get("slo")
+    if isinstance(slo, dict):
+        lines.extend(_slo_lines(slo))
     for name in sorted(rec.get("notes", {})):
         lines.append(f"note[{name}] {rec['notes'][name]}")
     return "\n".join(lines)
@@ -411,6 +471,9 @@ def format_cluster_record(rec: Dict) -> str:
     ten = rec.get("tenants")
     if isinstance(ten, dict):
         lines.extend(_tenants_lines(ten))
+    slo = rec.get("slo")
+    if isinstance(slo, dict):
+        lines.extend(_slo_lines(slo))
     for tname in sorted(rec.get("hotkeys", {})):
         h = rec["hotkeys"][tname]
         head = "  ".join(f"{k}:{c}" for k, c, _ in h.get("top", [])[:8])

@@ -21,9 +21,15 @@ top-K), and renders:
 * the cluster hot-key table with the estimated
   cache-hit-rate-if-cached curve.
 
+* the SLO panel (when a rank carries an armed ``telemetry/slo.py``
+  sentinel): per-objective burn rates + firing state, recent episodes,
+  the named straggler, and the typed autoscaling signal bus.
+
 ``--once`` prints a single snapshot and exits 0 when at least one rank
 answered (scripts/tests); ``--watch`` refreshes in place until ^C.
 ``--json`` emits the raw merged cluster record instead of the table.
+``--assert-slo`` (with ``--once``) exits 3 iff any SLO objective is
+firing — the one-line CI gate on the sentinel's verdict.
 """
 
 from __future__ import annotations
@@ -99,6 +105,49 @@ def _fmt(v, nd: int = 3) -> str:
     if isinstance(v, float):
         return f"{v:.{nd}f}"
     return str(v)
+
+
+# objective kind -> the unit its SLI value renders in (the SLO panel's
+# value column; check_obs_surface lint 7 requires every slo.py kind to
+# appear here or in dump_metrics — a kind no pane can show is a verdict
+# into the void)
+_SLO_KIND_UNITS = {
+    "serve_latency_p99": "ms", "add_latency_p99": "ms",
+    "staleness": "s", "shed_rate": "frac", "availability": "frac",
+    "stall_fraction": "frac", "steady_recompiles": "n",
+    "recovery_s": "s", "scale_efficiency": "E",
+}
+
+# signal name -> cell formatter for the SLO panel's signal-bus line
+# (telemetry/signals.py; same lint-7 rule — every bus signal renders)
+_SIGNAL_FMT = {
+    "shed_rate": lambda v: f"{v * 100:.1f}%",
+    "hot_key_mass": lambda v: f"{v * 100:.0f}%",
+    "replica_lag_epochs": lambda v: f"{v:.0f}ep",
+    "replica_lag_s": lambda v: f"{v:.2f}s",
+    "queue_depth": lambda v: f"{v:.0f}",
+    "burn_rate": lambda v: f"{v:.1f}x",
+    "spares_left": lambda v: f"{v:.0f}",
+    "active_replicas": lambda v: f"{v:.0f}",
+    "stall_fraction": lambda v: f"{v * 100:.1f}%",
+}
+
+
+def _signal_cells(rec: Dict) -> list:
+    """The typed signal bus derived from THIS record (pure — the same
+    signals.from_record the aggregator publishes each poll), rendered
+    as "name[table]=value" cells in the bus's declared name order."""
+    from multiverso_tpu.telemetry import signals as _signals
+    cells = []
+    by_name: Dict[str, list] = {}
+    for s in _signals.from_record(rec):
+        by_name.setdefault(s.name, []).append(s)
+    for name in _signals.SIGNAL_NAMES:
+        fmt = _SIGNAL_FMT.get(name, _fmt)
+        for s in by_name.get(name, []):
+            scope = f"[{s.table}]" if s.table else ""
+            cells.append(f"{name}{scope}={fmt(s.value)}")
+    return cells
 
 
 def _mb(v) -> str:
@@ -277,6 +326,56 @@ def render(rec: Dict, prev: Optional[Dict] = None,
                 f"{(w.get('add_bytes', 0) + w.get('get_bytes', 0)) / 1e6:.2f}MB"
                 for tn, w in sorted(wire.items())]
             lines.append("  wire ops: " + "  ".join(cells[:topk]))
+    # SLO panel (telemetry/slo.py, MSG_STATS "slo" block): per-objective
+    # burn-rate verdicts (fast/slow window), firing state, episode
+    # counts, the named straggler, and the typed signal bus — the
+    # objective-first line an operator reads before any raw gauge.
+    # ADDITIVE like the device block: a cluster with no slo_spec
+    # renders nothing.
+    slo = rec.get("slo")
+    if slo:
+        firing = slo.get("firing") or []
+        lines.append("")
+        lines.append(
+            f"slo: objectives {len(slo.get('objectives') or {})}"
+            f"  episodes {slo.get('episodes', 0)}"
+            f"  evals {slo.get('evals', 0)}"
+            + (f"  FIRING {','.join(firing)}" if firing else "  ok"))
+        objs = slo.get("objectives") or {}
+        if objs:
+            lines.append(f"  {'objective':<26} {'kind':<19} {'state':<7} "
+                         f"{'value':>10} {'burn_f':>7} {'burn_s':>7} "
+                         f"{'eps':>4}")
+            for name in sorted(objs):
+                o = objs[name]
+                kind = o.get("kind") or "?"
+                unit = _SLO_KIND_UNITS.get(kind, "")
+                val = o.get("value")
+                cell = ("-" if val is None
+                        else f"{_fmt(val)}{unit and ' ' + unit}")
+                lines.append(
+                    f"  {name:<26} {kind:<19} "
+                    f"{'FIRING' if o.get('firing') else 'ok':<7} "
+                    f"{cell:>10} {_fmt(o.get('burn_fast'), 1):>7} "
+                    f"{_fmt(o.get('burn_slow'), 1):>7} "
+                    f"{o.get('episodes', 0):>4}")
+        s = slo.get("straggler")
+        if s:
+            lines.append(
+                f"  straggler: rank {s.get('rank')} "
+                f"({s.get('attribution')}"
+                + (f", top phase {s['top_phase']}"
+                   if s.get("top_phase") else "")
+                + f")  score {_fmt(s.get('score'), 2)}")
+        for ev in (slo.get("recent") or [])[-4:]:
+            lines.append(
+                f"  {ev.get('kind')}: {ev.get('objective')} "
+                f"ep{ev.get('episode')} value={_fmt(ev.get('value'))} "
+                f"burn={_fmt(ev.get('burn_fast'), 1)}"
+                f"/{_fmt(ev.get('burn_slow'), 1)}")
+        cells = _signal_cells(rec)
+        if cells:
+            lines.append("  signals: " + "  ".join(cells[:topk]))
     mons = rec.get("monitors", {})
     rates = rec.get("rates", {})
     serving = rec.get("serving", {})
@@ -431,6 +530,9 @@ def main(argv=None) -> int:
                     help="per-rank probe timeout seconds")
     ap.add_argument("--topk", type=int, default=8,
                     help="hot keys shown per table")
+    ap.add_argument("--assert-slo", action="store_true",
+                    help="with --once: exit 3 iff any SLO objective is "
+                         "firing (CI gate on the sentinel verdict)")
     args = ap.parse_args(argv)
 
     addrs = read_addrs(args.rdv, args.world)
@@ -444,6 +546,12 @@ def main(argv=None) -> int:
               else render(rec, topk=args.topk))
         up = sum(1 for e in rec.get("ranks", {}).values()
                  if e.get("status") not in (None, "unreachable"))
+        if args.assert_slo:
+            firing = (rec.get("slo") or {}).get("firing") or []
+            if firing:
+                print("mvtop: SLO firing: " + ",".join(firing),
+                      file=sys.stderr)
+                return 3
         return 0 if up else 1
     prev = None
     try:

@@ -301,6 +301,17 @@ def tenant_timeline(dumps: List[Dict]) -> List[Dict]:
             if r.get("ev") in ("tenant.shed", "tenant.verdict")]
 
 
+def slo_timeline(dumps: List[Dict]) -> List[Dict]:
+    """The SLO sentinel's episodes (telemetry/slo.py): every
+    ``slo.fired`` / ``slo.cleared`` event across the merged dumps, on
+    one wall clock — rendered beside the injected faults and tenant
+    verdicts so an objective's burn reads against the scenario that
+    caused it. The note carries the objective name, episode number,
+    and the burn rates at the transition."""
+    return [r for r in timeline(dumps)
+            if r.get("ev") in ("slo.fired", "slo.cleared")]
+
+
 def render_report(dumps: List[Dict], log_lines: List[Dict] = (),
                   tail: int = 40) -> str:
     names = _msg_names()
@@ -360,6 +371,17 @@ def render_report(dumps: List[Dict], log_lines: List[Dict] = (),
             lines.append(
                 f"  {e.get('ts', 0.0):.6f} rank{e.get('rank', -1)} "
                 f"VERDICT {e.get('note') or ''}")
+    tslo = slo_timeline(dumps)
+    if tslo:
+        fired = sum(1 for e in tslo if e["ev"] == "slo.fired")
+        lines.append(
+            f"SLO episodes (telemetry/slo.py): {fired} fired, "
+            f"{len(tslo) - fired} cleared")
+        for e in tslo:
+            lines.append(
+                f"  {e.get('ts', 0.0):.6f} rank{e.get('rank', -1)} "
+                f"{'FIRED' if e['ev'] == 'slo.fired' else 'cleared'} "
+                f"{e.get('note') or ''}")
     rec = recovery_timeline(dumps, log_lines)
     if rec:
         lines.append("recovery timeline (failover plane):")
@@ -466,6 +488,7 @@ def main(argv=None) -> int:
             "recovery": recovery_timeline(dumps, log_lines),
             "injected_faults": injected_faults(dumps),
             "tenant_timeline": tenant_timeline(dumps),
+            "slo_timeline": slo_timeline(dumps),
             "memory": memory_report(dumps),
             "timeline": timeline(dumps, log_lines)[-args.tail:],
         }, indent=1))

@@ -283,6 +283,30 @@ def flag_regressions(prev_headline, new_headline, factor: float = 2.0):
             out.append(f"table[{t}] shard skew: {new} vs {old} "
                        f"previously ({new / old:.1f}x, flag threshold "
                        f"{factor}x)")
+    # SLO sentinel episodes (ISSUE 19, telemetry/slo.py): an objective
+    # that FIRED this run but not in the previous recorded run is a new
+    # burn — flagged by objective name so the next session reads which
+    # promise broke, never failed (chaos scenarios fire objectives on
+    # purpose; the comparison is run-over-run drift, not a veto). Both
+    # sides need an extra.slo block (older records are skipped).
+    def _slo_episodes(headline):
+        node = ((headline or {}).get("extra", {}) or {}).get("slo")
+        eps = node.get("episodes") if isinstance(node, dict) else None
+        return eps if isinstance(eps, dict) else None
+
+    old_eps, new_eps = (_slo_episodes(prev_headline),
+                        _slo_episodes(new_headline))
+    if old_eps is not None and new_eps is not None:
+        for name in sorted(new_eps):
+            n = new_eps[name]
+            if not isinstance(n, (int, float)) or isinstance(n, bool) \
+                    or n <= 0:
+                continue
+            if not old_eps.get(name):
+                out.append(
+                    f"SLO objective '{name}': {int(n)} episode(s) fired "
+                    "this run, none in the previous recorded run (see "
+                    "extra.slo and metrics alerts.jsonl)")
     return out
 
 
